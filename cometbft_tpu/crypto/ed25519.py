@@ -1,0 +1,150 @@
+"""Host-side Ed25519 API: keys, signing, and the TPU batch-verify bridge.
+
+Mirrors the seam of the reference's crypto/ed25519 package
+(/root/reference/crypto/ed25519/ed25519.go: PrivKey.Sign :45,
+PubKey.VerifySignature :181, BatchVerifier :208) but the batch path packs
+signatures into uint32 device arrays and runs one jitted TPU program
+(ops/ed25519.verify_kernel) instead of per-signature CPU verification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import ed25519_ref as ref
+from .hash import sum_sha256
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64          # seed || pubkey, like the reference golang layout
+SIGNATURE_SIZE = 64
+L = ref.L
+
+
+@dataclass(frozen=True)
+class PubKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("ed25519 pubkey must be 32 bytes")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def address(self) -> bytes:
+        """First 20 bytes of SHA-256, the reference's address rule."""
+        return sum_sha256(self.data)[:20]
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return ref.verify(self.data, msg, sig)
+
+    def __bytes__(self):
+        return self.data
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    data: bytes              # seed(32) || pubkey(32)
+
+    def __post_init__(self):
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("ed25519 privkey must be 64 bytes")
+
+    @staticmethod
+    def generate(seed: bytes | None = None) -> "PrivKey":
+        seed, pub = ref.keygen(seed)
+        return PrivKey(seed + pub)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def pub_key(self) -> PubKey:
+        return PubKey(self.data[32:])
+
+    def sign(self, msg: bytes) -> bytes:
+        # Prefer the constant-time OpenSSL path (the pure-Python reference
+        # signer is variable-time and only safe for tests/tools).
+        try:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey)
+            return Ed25519PrivateKey.from_private_bytes(
+                self.data[:32]).sign(msg)
+        except ImportError:  # pragma: no cover
+            return ref.sign(self.data[:32], msg)
+
+
+def parse_signature(sig: bytes) -> tuple[bytes, int] | None:
+    """Split sig into (R_enc, s) and range-check s < L (RFC 8032 / ZIP-215)."""
+    if len(sig) != SIGNATURE_SIZE:
+        return None
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return None
+    return sig[:32], s
+
+
+def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
+               batch_size: int, max_blocks: int):
+    """Pack a signature batch into device-ready numpy arrays.
+
+    Entries that fail host-side structural checks (bad lengths, s >= L) get
+    a pre-determined False verdict via the `valid` mask; their slots are
+    filled with benign data so the kernel stays branch-free.
+    Returns (a_words, r_words, s_limbs, msg_hi, msg_lo, n_blocks, valid).
+    """
+    from ..ops import limbs as lb
+    from ..ops import sha2
+
+    n = len(pubkeys)
+    assert batch_size >= n
+    valid = np.zeros(batch_size, dtype=bool)
+    a_words = np.zeros((batch_size, 8), dtype=np.uint32)
+    r_words = np.zeros((batch_size, 8), dtype=np.uint32)
+    s_limbs = np.zeros((batch_size, 16), dtype=np.uint32)
+    hash_msgs = []
+    dummy = ref.point_compress(ref.B)
+    for i in range(batch_size):
+        if i >= n:
+            hash_msgs.append(b"")
+            continue
+        pk, msg, sig = pubkeys[i], msgs[i], sigs[i]
+        parsed = parse_signature(sig) if len(pk) == PUBKEY_SIZE else None
+        if parsed is None:
+            hash_msgs.append(b"")
+            continue
+        r_enc, s = parsed
+        valid[i] = True
+        a_words[i] = np.frombuffer(pk, dtype=np.uint32)
+        r_words[i] = np.frombuffer(r_enc, dtype=np.uint32)
+        s_limbs[i] = lb.int_to_limbs(s, 16)
+        hash_msgs.append(r_enc + pk + msg)
+    # benign filler so decompression of invalid slots still succeeds
+    filler = np.frombuffer(dummy, dtype=np.uint32)
+    a_words[~valid] = filler
+    r_words[~valid] = filler
+    msg_hi, msg_lo, n_blocks = sha2.pad_sha512(hash_msgs, max_blocks)
+    return a_words, r_words, s_limbs, msg_hi, msg_lo, n_blocks, valid
+
+
+_BLOCK_BUCKETS = (2, 4, 8, 16, 32, 64)
+
+
+def max_blocks_for(msgs: list[bytes]) -> int:
+    """SHA-512 block count for the longest R||A||M input, rounded up to a
+    bucket so the jitted kernel compiles once per (batch, blocks) bucket
+    rather than once per distinct message length."""
+    longest = max((len(m) for m in msgs), default=0) + 64
+    need = (longest + 1 + 16 + 127) // 128
+    for b in _BLOCK_BUCKETS:
+        if need <= b:
+            return b
+    return need

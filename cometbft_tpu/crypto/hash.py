@@ -1,0 +1,17 @@
+"""tmhash equivalent: SHA-256 with the 20-byte truncated variant.
+
+Reference: /root/reference/crypto/tmhash/hash.go (Sum, SumTruncated).
+Host path uses hashlib; bulk device hashing lives in ops/sha2.py.
+"""
+
+import hashlib
+
+TRUNCATED_SIZE = 20
+
+
+def sum_sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

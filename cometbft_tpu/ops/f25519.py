@@ -1,0 +1,156 @@
+"""GF(2**255 - 19) arithmetic for TPU: 16 x 16-bit limbs in uint32 lanes.
+
+Elements are arrays of shape (..., 16), limbs little-endian in [0, 2**16)
+("normalized"), representing values in [0, 2**256) that are congruent to
+the intended field element (lazy reduction; `freeze` produces the canonical
+representative < p).  Everything is branch-free and vmappable.
+
+Reference analog: field ops inside curve25519-voi consumed by
+/root/reference/crypto/ed25519/ed25519.go; this is a from-scratch
+TPU-oriented design, not a translation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import limbs as lb
+
+NLIMBS = 16
+P = (1 << 255) - 19
+
+# canonical limb constants (host numpy, captured as jit constants)
+P_LIMBS = lb.int_to_limbs(P, NLIMBS)
+P2_LIMBS = lb.int_to_limbs(2 * P, NLIMBS)
+
+# 4p in a redundant per-limb-padded form: every limb >= 0xFFFF so that
+# (a + PAD_4P - b) never underflows in uint32 when a, b are normalized.
+_pad = np.full(NLIMBS, (1 << 18) - 4, dtype=np.uint64)
+_pad[15] -= 1 << 17
+_pad[0] -= 72
+assert sum(int(v) << (16 * i) for i, v in enumerate(_pad)) == 4 * P
+assert (_pad >= 0xFFFF).all() and (_pad < (1 << 19)).all()
+PAD_4P = _pad.astype(np.uint32)
+
+# curve constants as field elements
+D_INT = (-121665 * pow(121666, P - 2, P)) % P
+D2_INT = (2 * D_INT) % P
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+D_LIMBS = lb.int_to_limbs(D_INT, NLIMBS)
+D2_LIMBS = lb.int_to_limbs(D2_INT, NLIMBS)
+SQRT_M1_LIMBS = lb.int_to_limbs(SQRT_M1_INT, NLIMBS)
+ONE_LIMBS = lb.int_to_limbs(1, NLIMBS)
+ZERO_LIMBS = lb.int_to_limbs(0, NLIMBS)
+
+
+def _fold_carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Carry-propagate and fold 2**256 overflow back via 2**256 = 2p + 38."""
+    x, c = lb.carry_prop(x)
+    x = x.at[..., 0].add(c * jnp.uint32(38))
+    x, c = lb.carry_prop(x)
+    x = x.at[..., 0].add(c * jnp.uint32(38))
+    # after two folds the value is < 2**256 and limb 0 gained at most 38;
+    # one last propagation cannot carry out of the top limb.
+    x, _ = lb.carry_prop(x)
+    return x
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _fold_carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _fold_carry(a + jnp.asarray(PAD_4P) - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _fold_carry(jnp.asarray(PAD_4P) - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    wide = lb.mul_raw(a, b)                     # (..., 32) limbs < 2**21
+    # fold the high 256 bits: 2**256 = 2p + 38  =>  hi*2**256 == hi*38
+    folded = wide[..., :NLIMBS] + wide[..., NLIMBS:] * jnp.uint32(38)
+    return _fold_carry(folded)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_word(a: jnp.ndarray, w: int) -> jnp.ndarray:
+    """Multiply by small constant w < 2**11 (so 16-bit limb * w < 2**27)."""
+    return _fold_carry(a * jnp.uint32(w))
+
+
+def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """x**(2**n) via n squarings (rolled loop keeps the HLO graph small)."""
+    return jax.lax.fori_loop(0, n, lambda i, v: sqr(v), x)
+
+
+def _pow_22501(z: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared prefix of the p-2 and (p-5)/8 addition chains.
+
+    Returns (z**(2**250 - 1), z**11).
+    """
+    z2 = sqr(z)
+    z9 = mul(_sq_n(z2, 2), z)
+    z11 = mul(z9, z2)
+    z2_5_0 = mul(sqr(z11), z9)          # 2**5 - 2**0
+    z2_10_0 = mul(_sq_n(z2_5_0, 5), z2_5_0)
+    z2_20_0 = mul(_sq_n(z2_10_0, 10), z2_10_0)
+    z2_40_0 = mul(_sq_n(z2_20_0, 20), z2_20_0)
+    z2_50_0 = mul(_sq_n(z2_40_0, 10), z2_10_0)
+    z2_100_0 = mul(_sq_n(z2_50_0, 50), z2_50_0)
+    z2_200_0 = mul(_sq_n(z2_100_0, 100), z2_100_0)
+    z2_250_0 = mul(_sq_n(z2_200_0, 50), z2_50_0)
+    return z2_250_0, z11
+
+
+def invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z**(p-2) = z**(2**255 - 21); returns 0 for z == 0."""
+    z2_250_0, z11 = _pow_22501(z)
+    return mul(_sq_n(z2_250_0, 5), z11)
+
+
+def pow_p58(z: jnp.ndarray) -> jnp.ndarray:
+    """z**((p-5)/8) = z**(2**252 - 3)."""
+    z2_250_0, _ = _pow_22501(z)
+    return mul(_sq_n(z2_250_0, 2), z)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p) from any normalized element."""
+    a = lb.cond_sub(a, jnp.asarray(P2_LIMBS))
+    return lb.cond_sub(a, jnp.asarray(P_LIMBS))
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return lb.is_zero(freeze(a))
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return lb.eq(freeze(a), freeze(b))
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical representative (uint32 0/1)."""
+    return freeze(a)[..., 0] & jnp.uint32(1)
+
+
+def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """sqrt(u/v) per RFC 8032 decompression; returns (x, ok).
+
+    ok is False when u/v is not a square.  x satisfies v*x**2 == u when ok.
+    """
+    v3 = mul(sqr(v), v)
+    v7 = mul(sqr(v3), v)
+    r = mul(mul(u, v3), pow_p58(mul(u, v7)))    # (u v^3) (u v^7)^((p-5)/8)
+    check = mul(v, sqr(r))
+    correct = eq(check, u)
+    flipped = eq(check, neg(u))
+    r_alt = mul(r, jnp.asarray(SQRT_M1_LIMBS))
+    x = jnp.where(flipped[..., None], r_alt, r)
+    return x, correct | flipped

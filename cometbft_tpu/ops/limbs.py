@@ -1,0 +1,164 @@
+"""Multi-limb big-integer arithmetic on TPU-friendly uint32 lanes.
+
+TPU VPUs operate natively on 32-bit integers; there is no native 64-bit
+multiply.  We therefore represent big integers in radix 2**16: an n-limb
+number is an array of n uint32 values, each in [0, 2**16), little-endian
+limb order.  A 16x16-bit product fits exactly in a uint32, and partial
+products are accumulated as (lo16, hi16) pairs so no intermediate ever
+overflows 32 bits.  All functions are shape-polymorphic over leading batch
+dimensions and contain only static control flow, so they can be freely
+`jax.vmap`-ed and `jax.jit`-ed (reference's analog: the 64-bit limb field
+arithmetic inside curve25519-voi used by /root/reference/crypto/ed25519).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+MASK16 = jnp.uint32(0xFFFF)
+LIMB_BITS = 16
+LIMB_RADIX = 1 << LIMB_BITS
+
+
+# ---------------------------------------------------------------------------
+# host <-> limb conversion (numpy, host side)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n: int) -> np.ndarray:
+    """Python int -> n uint32 limbs (radix 2**16, little-endian)."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros(n, dtype=np.uint32)
+    for i in range(n):
+        out[i] = x & 0xFFFF
+        x >>= 16
+    if x:
+        raise ValueError("value does not fit in %d limbs" % n)
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """uint32 limb array -> Python int (host side, accepts un-normalized)."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (16 * i) for i, v in enumerate(arr))
+
+
+def bytes_le_to_limbs(b: bytes, n: int) -> np.ndarray:
+    return int_to_limbs(int.from_bytes(b, "little"), n)
+
+
+def limbs_to_bytes_le(limbs, nbytes: int) -> bytes:
+    return limbs_to_int(limbs).to_bytes(nbytes, "little")
+
+
+# ---------------------------------------------------------------------------
+# device ops
+# ---------------------------------------------------------------------------
+
+def carry_prop(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full carry propagation.  Input limbs may be up to 2**32-1.
+
+    Returns (normalized limbs in [0, 2**16), carry out of the top limb).
+    Sequential over limbs (n is small and static: 16..50), vectorized over
+    the batch.
+    """
+    n = x.shape[-1]
+    out = []
+    carry = jnp.zeros(x.shape[:-1], dtype=jnp.uint32)
+    for i in range(n):
+        v = x[..., i] + carry
+        out.append(v & MASK16)
+        carry = v >> LIMB_BITS
+    return jnp.stack(out, axis=-1), carry
+
+
+def mul_raw(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product of an na-limb and nb-limb number.
+
+    Returns na+nb limbs, each < 2**21 (un-normalized but overflow-free):
+    every 16x16 partial product is split into (lo, hi) halves, and at most
+    ~2*min(na,nb) halves (< 2**16 each) land on any output limb.
+    Inputs must be normalized (< 2**16 per limb).
+    """
+    na, nb = a.shape[-1], b.shape[-1]
+    p = a[..., :, None] * b[..., None, :]          # (..., na, nb) each < 2**32
+    lo = p & MASK16
+    hi = p >> LIMB_BITS
+    # anti-diagonal sums via the skew-reshape trick: pad each row i to width
+    # nb+na, flatten, drop the last na elements, reshape to rows of width
+    # nb+na-1 -- row i is now the original row right-shifted by i columns.
+    t_lo = _antidiag_sum(lo)                       # (..., na+nb-1), < 2**20
+    t_hi = _antidiag_sum(hi)
+    zero = jnp.zeros_like(t_lo[..., :1])
+    return jnp.concatenate([t_lo, zero], axis=-1) + \
+        jnp.concatenate([zero, t_hi], axis=-1)
+
+
+def _antidiag_sum(p: jnp.ndarray) -> jnp.ndarray:
+    """Sum p[..., i, j] over equal i+j -> (..., na+nb-1)."""
+    na, nb = p.shape[-2], p.shape[-1]
+    w = na + nb
+    pad = [(0, 0)] * (p.ndim - 1) + [(0, na)]
+    skew = jnp.pad(p, pad).reshape(p.shape[:-2] + (na * w,))
+    skew = skew[..., :na * (w - 1)].reshape(p.shape[:-2] + (na, w - 1))
+    return skew.sum(axis=-2, dtype=jnp.uint32)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Normalized product: na+nb limbs in [0, 2**16)."""
+    out, carry = carry_prop(mul_raw(a, b))
+    # carry out of the top limb of an exact-width product is always zero
+    return out
+
+
+def ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a >= b for normalized equal-width limb arrays; returns bool array."""
+    # lexicographic compare from the top limb down
+    n = a.shape[-1]
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq = jnp.ones(a.shape[:-1], dtype=bool)
+    for i in range(n - 1, -1, -1):
+        gt = gt | (eq & (a[..., i] > b[..., i]))
+        eq = eq & (a[..., i] == b[..., i])
+    return gt | eq
+
+
+def sub_exact(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b for normalized limbs with a >= b (borrow chain)."""
+    n = a.shape[-1]
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    for i in range(n):
+        v = a[..., i] + jnp.uint32(LIMB_RADIX) - b[..., i] - borrow
+        out.append(v & MASK16)
+        borrow = jnp.uint32(1) - (v >> LIMB_BITS)
+    return jnp.stack(out, axis=-1)
+
+
+def cond_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b if a >= b else a (branch-free select)."""
+    take = ge(a, b)
+    return jnp.where(take[..., None], sub_exact(a, b), a)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def words32_to_limbs(words: jnp.ndarray) -> jnp.ndarray:
+    """(..., n) uint32 little-endian words -> (..., 2n) radix-2**16 limbs."""
+    lo = words & MASK16
+    hi = words >> LIMB_BITS
+    return jnp.stack([lo, hi], axis=-1).reshape(words.shape[:-1] + (2 * words.shape[-1],))
+
+
+def limbs_to_words32(limbs: jnp.ndarray) -> jnp.ndarray:
+    """(..., 2n) normalized limbs -> (..., n) uint32 little-endian words."""
+    n2 = limbs.shape[-1]
+    pairs = limbs.reshape(limbs.shape[:-1] + (n2 // 2, 2))
+    return pairs[..., 0] | (pairs[..., 1] << LIMB_BITS)

@@ -1,0 +1,68 @@
+"""Arithmetic mod the Ed25519 group order L, TPU limb representation.
+
+L = 2**252 + 27742317777372353535851937790883648493.  The verify kernel
+needs h = SHA512(R || A || M) reduced mod L; the 512-bit digest is reduced
+with a Barrett division entirely in radix-2**16 uint32 limbs (see limbs.py).
+
+Reference analog: scalar reduction inside curve25519-voi used by
+/root/reference/crypto/ed25519; re-derived for 32-bit lanes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import limbs as lb
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+NLIMBS = 16          # L fits in 253 bits -> 16 limbs
+WIDE = 32            # 512-bit inputs
+
+L_LIMBS = lb.int_to_limbs(L, NLIMBS)
+# Barrett constant: mu = floor(2**512 / L), 260 bits -> 17 limbs
+MU = (1 << 512) // L
+MU_LIMBS = lb.int_to_limbs(MU, 17)
+L_LIMBS_18 = lb.int_to_limbs(L, 18)
+
+
+def barrett_reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a 512-bit value (32 normalized limbs) mod L -> 16 limbs.
+
+    Classic Barrett with base b = 2**16, k = 16:
+      q = floor( floor(x / b**(k-1)) * mu / b**(k+1) );  r = x - q*L
+    with r < 3L, fixed by two conditional subtractions.
+    """
+    q1 = x[..., 15:]                                  # floor(x / b^15), 17 limbs
+    q2 = lb.mul(q1, jnp.asarray(MU_LIMBS))            # 34 limbs
+    q3 = q2[..., 17:]                                 # floor(q2 / b^17), 17 limbs
+    # r = x - q3*L computed mod b^18 (r < 3L < b^18 guarantees exactness);
+    # sub_exact's limb output is (a - b) mod b^n regardless of the borrow out
+    ql = lb.mul(q3[..., :18], jnp.asarray(L_LIMBS_18))[..., :18]
+    diff = lb.sub_exact(x[..., :18], ql)
+    diff = lb.cond_sub(diff, jnp.asarray(L_LIMBS_18))
+    diff = lb.cond_sub(diff, jnp.asarray(L_LIMBS_18))
+    return diff[..., :NLIMBS]
+
+
+def digest512_to_wide_limbs(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    """SHA-512 digest words (8 hi + 8 lo, big-endian word order) -> 32 limbs.
+
+    Ed25519 interprets the 64-byte digest as a little-endian integer.  The
+    digest byte stream is word0..word7, each emitted big-endian, so the
+    first bytes on the wire are word0's HI half.  Reading the stream as a
+    little-endian integer therefore makes bswap32(hi0) the least
+    significant 32-bit group, then bswap32(lo0), bswap32(hi1), ...
+    """
+    def bswap32(w):
+        return ((w & 0xFF) << 24) | ((w & 0xFF00) << 8) | \
+               ((w >> 8) & 0xFF00) | (w >> 24)
+
+    hs = bswap32(hi)
+    ls = bswap32(lo)
+    words = jnp.stack([hs, ls], axis=-1).reshape(hi.shape[:-1] + (16,))
+    return lb.words32_to_limbs(words)
+
+
+def host_reduce(x: int) -> int:
+    return x % L

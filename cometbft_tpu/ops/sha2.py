@@ -1,0 +1,284 @@
+"""Batched SHA-256 / SHA-512 for TPU in pure uint32 JAX.
+
+SHA-256 words are native uint32.  SHA-512's 64-bit words are emulated as
+(hi, lo) uint32 pairs — TPUs have no native 64-bit integer datapath, so
+this keeps everything on the 32-bit VPU lanes.
+
+Layout: a batch of pre-padded messages is shaped (N, B, W) where B is the
+(static) max number of blocks and W the words per block (16 for SHA-256,
+32 for SHA-512 as hi/lo interleaved).  Per-message block counts mask the
+scan so one compiled kernel serves ragged batches.
+
+Reference analog: crypto/tmhash (SHA-256 truncation) and the SHA-512
+message hashing inside Ed25519 verification
+(/root/reference/crypto/tmhash/hash.go, crypto/ed25519/ed25519.go).
+Host-side padding helpers live at the bottom (numpy, vectorized).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# SHA-256
+# ---------------------------------------------------------------------------
+
+_K256 = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H256 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                  0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+                 dtype=np.uint32)
+
+
+def _rotr32(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _sha256_block(state: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """One compression round.  state (..., 8), w (..., 16) big-endian words."""
+
+    def sched(i, ws):
+        w15 = ws[..., (i - 15) % 16]
+        w2 = ws[..., (i - 2) % 16]
+        s0 = _rotr32(w15, 7) ^ _rotr32(w15, 18) ^ (w15 >> 3)
+        s1 = _rotr32(w2, 17) ^ _rotr32(w2, 19) ^ (w2 >> 10)
+        nw = ws[..., i % 16] + s0 + ws[..., (i - 7) % 16] + s1
+        return ws.at[..., i % 16].set(nw)
+
+    def round_fn(i, carry):
+        a, b, c, d, e, f, g, h, ws = carry
+        ws = jax.lax.cond(i >= 16, lambda: sched(i, ws), lambda: ws)
+        kw = jnp.asarray(_K256)[i] + ws[..., i % 16]
+        s1 = _rotr32(e, 6) ^ _rotr32(e, 11) ^ _rotr32(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kw
+        s0 = _rotr32(a, 2) ^ _rotr32(a, 13) ^ _rotr32(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g, ws)
+
+    init = tuple(state[..., i] for i in range(8)) + (w,)
+    out = jax.lax.fori_loop(0, 64, round_fn, init)
+    return state + jnp.stack(out[:8], axis=-1)
+
+
+def sha256_blocks(blocks: jnp.ndarray, n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Digest pre-padded messages.
+
+    blocks: (N, B, 16) uint32 big-endian words; n_blocks: (N,) int32.
+    Returns (N, 8) uint32 big-endian digest words.
+    """
+    B = blocks.shape[-2]
+    state = jnp.broadcast_to(jnp.asarray(_H256), blocks.shape[:-2] + (8,))
+
+    def step(carry, xs):
+        st = carry
+        blk, idx = xs
+        new = _sha256_block(st, blk)
+        keep = (idx < n_blocks)[..., None]
+        return jnp.where(keep, new, st), None
+
+    xs = (jnp.moveaxis(blocks, -2, 0), jnp.arange(B, dtype=jnp.int32))
+    state, _ = jax.lax.scan(step, state, xs)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# SHA-512 (64-bit words as hi/lo uint32 pairs)
+# ---------------------------------------------------------------------------
+
+_K512 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f, 0xe9b5dba58189dbbc,
+    0x3956c25bf348b538, 0x59f111f1b605d019, 0x923f82a4af194f9b, 0xab1c5ed5da6d8118,
+    0xd807aa98a3030242, 0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235, 0xc19bf174cf692694,
+    0xe49b69c19ef14ad2, 0xefbe4786384f25e3, 0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65,
+    0x2de92c6f592b0275, 0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f, 0xbf597fc7beef0ee4,
+    0xc6e00bf33da88fc2, 0xd5a79147930aa725, 0x06ca6351e003826f, 0x142929670a0e6e70,
+    0x27b70a8546d22ffc, 0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6, 0x92722c851482353b,
+    0xa2bfe8a14cf10364, 0xa81a664bbc423001, 0xc24b8b70d0f89791, 0xc76c51a30654be30,
+    0xd192e819d6ef5218, 0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99, 0x34b0bcb5e19b48a8,
+    0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb, 0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3,
+    0x748f82ee5defb2fc, 0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915, 0xc67178f2e372532b,
+    0xca273eceea26619c, 0xd186b8c721c0c207, 0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178,
+    0x06f067aa72176fba, 0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc, 0x431d67c49c100d4c,
+    0x4cc5d4becb3e42b6, 0x597f299cfc657e2a, 0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+_K512_HI = np.array([k >> 32 for k in _K512], dtype=np.uint32)
+_K512_LO = np.array([k & 0xFFFFFFFF for k in _K512], dtype=np.uint32)
+
+_H512 = [0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b,
+         0xa54ff53a5f1d36f1, 0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+         0x1f83d9abfb41bd6b, 0x5be0cd19137e2179]
+_H512_HI = np.array([h >> 32 for h in _H512], dtype=np.uint32)
+_H512_LO = np.array([h & 0xFFFFFFFF for h in _H512], dtype=np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _rotr64(h, l, n):
+    if n == 0:
+        return h, l
+    if n < 32:
+        return (h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n))
+    if n == 32:
+        return l, h
+    n -= 32
+    return (l >> n) | (h << (32 - n)), (h >> n) | (l << (32 - n))
+
+
+def _shr64(h, l, n):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _sha512_block(sh, sl, wh, wl):
+    """One compression.  sh/sl (..., 8); wh/wl (..., 16)."""
+
+    def sched(i, whs, wls):
+        i15, i2, i7, i16 = (i - 15) % 16, (i - 2) % 16, (i - 7) % 16, i % 16
+        a_h, a_l = whs[..., i15], wls[..., i15]
+        s0 = _xor3(_rotr64(a_h, a_l, 1), _rotr64(a_h, a_l, 8), _shr64(a_h, a_l, 7))
+        b_h, b_l = whs[..., i2], wls[..., i2]
+        s1 = _xor3(_rotr64(b_h, b_l, 19), _rotr64(b_h, b_l, 61), _shr64(b_h, b_l, 6))
+        th, tl = _add64(whs[..., i16], wls[..., i16], s0[0], s0[1])
+        th, tl = _add64(th, tl, whs[..., i7], wls[..., i7])
+        th, tl = _add64(th, tl, s1[0], s1[1])
+        return whs.at[..., i16].set(th), wls.at[..., i16].set(tl)
+
+    def round_fn(i, carry):
+        (ah, al, bh, bl, ch_, cl, dh, dl,
+         eh, el, fh, fl, gh, gl, hh, hl, whs, wls) = carry
+        whs, wls = jax.lax.cond(i >= 16, lambda: sched(i, whs, wls),
+                                lambda: (whs, wls))
+        s1 = _xor3(_rotr64(eh, el, 14), _rotr64(eh, el, 18), _rotr64(eh, el, 41))
+        chh = (eh & fh) ^ (~eh & gh)
+        chl = (el & fl) ^ (~el & gl)
+        t1h, t1l = _add64(hh, hl, s1[0], s1[1])
+        t1h, t1l = _add64(t1h, t1l, chh, chl)
+        t1h, t1l = _add64(t1h, t1l, jnp.asarray(_K512_HI)[i], jnp.asarray(_K512_LO)[i])
+        t1h, t1l = _add64(t1h, t1l, whs[..., i % 16], wls[..., i % 16])
+        s0 = _xor3(_rotr64(ah, al, 28), _rotr64(ah, al, 34), _rotr64(ah, al, 39))
+        majh = (ah & bh) ^ (ah & ch_) ^ (bh & ch_)
+        majl = (al & bl) ^ (al & cl) ^ (bl & cl)
+        t2h, t2l = _add64(s0[0], s0[1], majh, majl)
+        ndh, ndl = _add64(dh, dl, t1h, t1l)
+        nah, nal = _add64(t1h, t1l, t2h, t2l)
+        return (nah, nal, ah, al, bh, bl, ch_, cl,
+                ndh, ndl, eh, el, fh, fl, gh, gl, whs, wls)
+
+    init = ()
+    for i in range(8):
+        init = init + (sh[..., i], sl[..., i])
+    init = init + (wh, wl)
+    out = jax.lax.fori_loop(0, 80, round_fn, init)
+    nh, nl = [], []
+    for i in range(8):
+        h, l = _add64(sh[..., i], sl[..., i], out[2 * i], out[2 * i + 1])
+        nh.append(h)
+        nl.append(l)
+    return jnp.stack(nh, axis=-1), jnp.stack(nl, axis=-1)
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def sha512_blocks(blocks_hi: jnp.ndarray, blocks_lo: jnp.ndarray,
+                  n_blocks: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Digest pre-padded SHA-512 messages.
+
+    blocks_hi/lo: (N, B, 16) uint32 (hi/lo halves of big-endian 64-bit words);
+    n_blocks: (N,).  Returns (N, 8) hi and lo digest words.
+    """
+    B = blocks_hi.shape[-2]
+    sh = jnp.broadcast_to(jnp.asarray(_H512_HI), blocks_hi.shape[:-2] + (8,))
+    sl = jnp.broadcast_to(jnp.asarray(_H512_LO), blocks_lo.shape[:-2] + (8,))
+
+    def step(carry, xs):
+        csh, csl = carry
+        bh, bl, idx = xs
+        nh, nl = _sha512_block(csh, csl, bh, bl)
+        keep = (idx < n_blocks)[..., None]
+        return (jnp.where(keep, nh, csh), jnp.where(keep, nl, csl)), None
+
+    xs = (jnp.moveaxis(blocks_hi, -2, 0), jnp.moveaxis(blocks_lo, -2, 0),
+          jnp.arange(B, dtype=jnp.int32))
+    (sh, sl), _ = jax.lax.scan(step, (sh, sl), xs)
+    return sh, sl
+
+
+# ---------------------------------------------------------------------------
+# host-side padding (numpy)
+# ---------------------------------------------------------------------------
+
+def pad_sha256(msgs: list[bytes], max_blocks: int | None = None):
+    """Pad a batch of messages; returns (blocks (N,B,16) u32, n_blocks (N,))."""
+    return _pad(msgs, 64, max_blocks)
+
+
+def pad_sha512(msgs: list[bytes], max_blocks: int | None = None):
+    """Returns (blocks_hi, blocks_lo (N,B,16) u32, n_blocks (N,))."""
+    blocks, n = _pad(msgs, 128, max_blocks)
+    # blocks: (N, B, 32) u32 big-endian words; split into 64-bit hi/lo
+    hi = blocks[..., 0::2]
+    lo = blocks[..., 1::2]
+    return hi, lo, n
+
+
+def _pad(msgs: list[bytes], block_bytes: int, max_blocks: int | None):
+    lenbytes = 16 if block_bytes == 128 else 8
+    n_blocks = np.array(
+        [(len(m) + 1 + lenbytes + block_bytes - 1) // block_bytes for m in msgs],
+        dtype=np.int32)
+    B = int(max_blocks or n_blocks.max(initial=1))
+    out = np.zeros((len(msgs), B * block_bytes), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        if n_blocks[i] > B:
+            raise ValueError("message exceeds max_blocks")
+        out[i, :len(m)] = np.frombuffer(m, dtype=np.uint8)
+        out[i, len(m)] = 0x80
+        bitlen = (8 * len(m)).to_bytes(lenbytes, "big")
+        end = n_blocks[i] * block_bytes
+        out[i, end - lenbytes:end] = np.frombuffer(bitlen, dtype=np.uint8)
+    words = out.reshape(len(msgs), B, block_bytes // 4, 4)
+    w32 = (words[..., 0].astype(np.uint32) << 24) | \
+          (words[..., 1].astype(np.uint32) << 16) | \
+          (words[..., 2].astype(np.uint32) << 8) | \
+          words[..., 3].astype(np.uint32)
+    return w32, n_blocks
+
+
+def digest256_to_bytes(words: np.ndarray) -> bytes:
+    """(8,) uint32 big-endian digest words -> 32 bytes."""
+    return b"".join(int(w).to_bytes(4, "big") for w in np.asarray(words))
+
+
+def digest512_to_bytes(hi: np.ndarray, lo: np.ndarray) -> bytes:
+    out = b""
+    for h, l in zip(np.asarray(hi), np.asarray(lo)):
+        out += int(h).to_bytes(4, "big") + int(l).to_bytes(4, "big")
+    return out

@@ -1,0 +1,25 @@
+"""Test harness config: run JAX on CPU with 8 virtual devices.
+
+Multi-chip sharding (jax.sharding.Mesh over 8 devices) is exercised on a
+virtual CPU mesh, mirroring how the driver's dryrun validates the
+multi-chip path without real hardware.
+
+NOTE: this image pre-imports jax with the remote-TPU ("axon") platform via
+sitecustomize, so setting os.environ after import is not enough — the
+platform must be switched through jax.config.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/cometbft_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

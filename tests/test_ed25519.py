@@ -1,0 +1,148 @@
+"""Ed25519: device kernel vs pure-Python reference vs the cryptography lib.
+
+Covers RFC 8032 test vector 1, random sign/verify round-trips, tampered
+signatures, structural rejects (s >= L), and ZIP-215 acceptance of
+non-canonical encodings.
+"""
+
+import random
+
+import numpy as np
+import jax
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.crypto import batch as cb
+from cometbft_tpu.ops import ed25519 as dev
+from cometbft_tpu.ops import scalar25519 as sc
+from cometbft_tpu.ops import limbs as lb
+
+rng = random.Random(99)
+
+# RFC 8032 §7.1 TEST 1
+RFC_SEED = bytes.fromhex(
+    "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60")
+RFC_PUB = bytes.fromhex(
+    "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a")
+RFC_SIG = bytes.fromhex(
+    "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+    "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b")
+
+
+def test_rfc8032_vector1():
+    assert ref.pubkey_from_seed(RFC_SEED) == RFC_PUB
+    assert ref.sign(RFC_SEED, b"") == RFC_SIG
+    assert ref.verify(RFC_PUB, b"", RFC_SIG)
+    assert not ref.verify(RFC_PUB, b"x", RFC_SIG)
+
+
+def test_against_cryptography_lib():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    for _ in range(4):
+        sk = Ed25519PrivateKey.generate()
+        seed = sk.private_bytes_raw()
+        msg = rng.randbytes(rng.randrange(0, 200))
+        lib_sig = sk.sign(msg)
+        assert ref.pubkey_from_seed(seed) == sk.public_key().public_bytes_raw()
+        assert ref.sign(seed, msg) == lib_sig
+        assert ref.verify(sk.public_key().public_bytes_raw(), msg, lib_sig)
+
+
+def _batch(n, msg_len=100):
+    pks, msgs, sigs = [], [], []
+    for _ in range(n):
+        priv = ed.PrivKey.generate(rng.randbytes(32))
+        m = rng.randbytes(msg_len)
+        pks.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    return pks, msgs, sigs
+
+
+def test_device_kernel_verdicts():
+    pks, msgs, sigs = _batch(6)
+    # corrupt: flip a byte in sig 1, wrong msg for 3, s >= L for 4
+    sigs[1] = sigs[1][:10] + bytes([sigs[1][10] ^ 0xFF]) + sigs[1][11:]
+    msgs[3] = msgs[3] + b"!"
+    bad_s = sigs[4][:32] + (ref.L + 5).to_bytes(32, "little")
+    sigs[4] = bad_s
+    expected = [True, False, True, False, False, True]
+
+    bv = cb.TpuEd25519BatchVerifier()
+    for pk, m, s in zip(pks, msgs, sigs):
+        bv.add(pk, m, s)
+    ok, verdicts = bv.verify()
+    assert verdicts == expected
+    assert not ok
+
+    cpu = cb.CpuEd25519BatchVerifier()
+    for pk, m, s in zip(pks, msgs, sigs):
+        cpu.add(pk, m, s)
+    assert cpu.verify()[1] == expected
+
+
+def test_device_kernel_all_good():
+    pks, msgs, sigs = _batch(5, msg_len=180)
+    bv = cb.create_batch_verifier("ed25519", provider="tpu")
+    for pk, m, s in zip(pks, msgs, sigs):
+        bv.add(pk, m, s)
+    ok, verdicts = bv.verify()
+    assert ok and all(verdicts)
+
+
+def test_zip215_noncanonical_y():
+    """A pubkey with y >= p must be accepted by ZIP-215 decompression."""
+    # y = p + 3 encodes non-canonically; find a valid curve y
+    y_can = 3
+    pt = ref.point_decompress(y_can.to_bytes(32, "little"))
+    if pt is None:
+        pytest.skip("y=3 not on curve")  # pragma: no cover
+    noncanon = (ref.P + y_can).to_bytes(32, "little")
+    assert ref.point_decompress(noncanon) is not None
+    assert ref.point_decompress(noncanon, zip215=False) is None
+    # device decompression agrees
+    words = np.frombuffer(noncanon, dtype=np.uint32)[None, :]
+    _, ok = jax.jit(dev.decompress)(words)
+    assert bool(np.asarray(ok)[0])
+
+
+def test_barrett_reduce():
+    f = jax.jit(sc.barrett_reduce_wide)
+    vals = [0, 1, sc.L - 1, sc.L, sc.L + 1, 2 * sc.L, (1 << 512) - 1,
+            (sc.L << 259) + 12345]
+    vals += [rng.randrange(0, 1 << 512) for _ in range(8)]
+    x = np.stack([lb.int_to_limbs(v, 32) for v in vals])
+    out = np.asarray(f(x))
+    for row, v in zip(out, vals):
+        assert lb.limbs_to_int(row) == v % sc.L
+
+
+def test_point_ops_match_reference():
+    """Device add/double vs Python ints on random points."""
+    from cometbft_tpu.ops import f25519 as fe
+    pts = []
+    for _ in range(3):
+        k = rng.randrange(1, ref.L)
+        pts.append(ref.point_mul(k, ref.B))
+
+    def to_dev(p):
+        return np.stack([lb.int_to_limbs(c, 16) for c in p])[None]
+
+    add = jax.jit(dev.point_add)
+    dbl = jax.jit(dev.point_double)
+    for p in pts:
+        for q in pts:
+            got = np.asarray(add(to_dev(p), to_dev(q)))[0]
+            want = ref.point_add(p, q)
+            gx, gy, gz, gt = [lb.limbs_to_int(row) % ref.P for row in got]
+            assert (gx * want[2] - want[0] * gz) % ref.P == 0
+            assert (gy * want[2] - want[1] * gz) % ref.P == 0
+        got = np.asarray(dbl(to_dev(p)))[0]
+        want = ref.point_double(p)
+        gx, gy, gz, gt = [lb.limbs_to_int(row) % ref.P for row in got]
+        assert (gx * want[2] - want[0] * gz) % ref.P == 0
+        assert (gy * want[2] - want[1] * gz) % ref.P == 0
+        # T consistency: T*Z == X*Y
+        assert (gt * gz - gx * gy) % ref.P == 0

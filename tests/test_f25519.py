@@ -1,0 +1,135 @@
+"""Field arithmetic tests: limb ops and GF(2**255-19) against Python ints."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cometbft_tpu.ops import limbs as lb
+from cometbft_tpu.ops import f25519 as fe
+
+P = fe.P
+rng = random.Random(1234)
+
+
+def rand_fe(n=1):
+    """(n, 16) normalized limbs of random values < 2**256 (lazy domain)."""
+    vals = [rng.randrange(0, 1 << 256) for _ in range(n)]
+    arr = np.stack([lb.int_to_limbs(v, 16) for v in vals])
+    return jnp.asarray(arr), vals
+
+
+def to_ints(x):
+    x = np.asarray(x)
+    if x.ndim == 1:
+        return lb.limbs_to_int(x)
+    return [lb.limbs_to_int(row) for row in x]
+
+
+def test_limb_roundtrip():
+    for _ in range(20):
+        v = rng.randrange(0, 1 << 256)
+        assert lb.limbs_to_int(lb.int_to_limbs(v, 16)) == v
+
+
+def test_words32_limb_roundtrip():
+    v = rng.randrange(0, 1 << 256)
+    words = jnp.asarray(np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint32))
+    limbs = lb.words32_to_limbs(words)
+    assert lb.limbs_to_int(np.asarray(limbs)) == v
+    back = np.asarray(lb.limbs_to_words32(limbs))
+    assert back.tolist() == np.asarray(words).tolist()
+
+
+def test_mul_raw_exact():
+    for _ in range(10):
+        a = rng.randrange(0, 1 << 256)
+        b = rng.randrange(0, 1 << 256)
+        al = jnp.asarray(lb.int_to_limbs(a, 16))
+        bl = jnp.asarray(lb.int_to_limbs(b, 16))
+        assert lb.limbs_to_int(np.asarray(lb.mul_raw(al, bl))) == a * b
+        assert lb.limbs_to_int(np.asarray(lb.mul(al, bl))) == a * b
+
+
+def test_sub_exact_and_cond_sub():
+    a = rng.randrange(1 << 200, 1 << 256)
+    b = rng.randrange(0, 1 << 200)
+    al = jnp.asarray(lb.int_to_limbs(a, 16))
+    bl = jnp.asarray(lb.int_to_limbs(b, 16))
+    assert lb.limbs_to_int(np.asarray(lb.sub_exact(al, bl))) == a - b
+    assert lb.limbs_to_int(np.asarray(lb.cond_sub(al, bl))) == a - b
+    assert lb.limbs_to_int(np.asarray(lb.cond_sub(bl, al))) == b
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (fe.add, lambda a, b: (a + b) % P),
+    (fe.sub, lambda a, b: (a - b) % P),
+    (fe.mul, lambda a, b: (a * b) % P),
+])
+def test_field_binops(op, pyop):
+    a, av = rand_fe(8)
+    b, bv = rand_fe(8)
+    out = to_ints(op(a, b))
+    for got, x, y in zip(out, av, bv):
+        assert got % P == pyop(x, y) % P
+
+
+def test_field_edge_values():
+    edge = [0, 1, 19, P - 1, P, P + 1, 2 * P - 1, 2 * P, (1 << 256) - 1,
+            (1 << 255) - 19, (1 << 255)]
+    arr = jnp.asarray(np.stack([lb.int_to_limbs(v, 16) for v in edge]))
+    frozen = to_ints(fe.freeze(arr))
+    for got, v in zip(frozen, edge):
+        assert got == v % P
+    sq = to_ints(fe.sqr(arr))
+    for got, v in zip(sq, edge):
+        assert got % P == (v * v) % P
+
+
+def test_invert_and_pow():
+    a, av = rand_fe(4)
+    inv = to_ints(fe.invert(a))
+    for got, v in zip(inv, av):
+        assert got % P == pow(v, P - 2, P)
+    p58 = to_ints(fe.pow_p58(a))
+    for got, v in zip(p58, av):
+        assert got % P == pow(v, (P - 5) // 8, P)
+
+
+def test_sqrt_ratio():
+    # squares: u = x^2 * v for random x, v
+    xs = [rng.randrange(1, P) for _ in range(6)]
+    vs = [rng.randrange(1, P) for _ in range(6)]
+    us = [(x * x * v) % P for x, v in zip(xs, vs)]
+    u = jnp.asarray(np.stack([lb.int_to_limbs(v, 16) for v in us]))
+    v = jnp.asarray(np.stack([lb.int_to_limbs(x, 16) for x in vs]))
+    root, ok = fe.sqrt_ratio(u, v)
+    assert bool(jnp.all(ok))
+    for got, uu, vv in zip(to_ints(root), us, vs):
+        assert (got * got * vv) % P == uu % P
+
+    # non-squares: multiply u by a non-square factor
+    nonsq = 2  # 2 is a non-square mod 2**255-19
+    assert pow(nonsq, (P - 1) // 2, P) == P - 1
+    u2 = jnp.asarray(np.stack([lb.int_to_limbs((x * nonsq) % P, 16) for x in us]))
+    _, ok2 = fe.sqrt_ratio(u2, v)
+    assert not bool(jnp.any(ok2))
+
+
+def test_parity_and_eq():
+    a, av = rand_fe(4)
+    par = np.asarray(fe.parity(a))
+    for got, v in zip(par, av):
+        assert int(got) == (v % P) & 1
+    assert bool(jnp.all(fe.eq(a, a)))
+
+
+def test_vmap_and_jit_compose():
+    a, av = rand_fe(8)
+    b, bv = rand_fe(8)
+    f = jax.jit(jax.vmap(fe.mul))
+    out = to_ints(f(a, b))
+    for got, x, y in zip(out, av, bv):
+        assert got % P == (x * y) % P

@@ -1,0 +1,43 @@
+"""Device SHA-256/512 vs hashlib, including ragged batches."""
+
+import hashlib
+import random
+
+import numpy as np
+import jax
+
+from cometbft_tpu.ops import sha2
+
+rng = random.Random(7)
+
+
+def _msgs():
+    sizes = [0, 1, 55, 56, 63, 64, 65, 111, 112, 119, 127, 128, 129, 200, 500]
+    return [rng.randbytes(s) for s in sizes]
+
+
+def test_sha256_batch():
+    msgs = _msgs()
+    blocks, n = sha2.pad_sha256(msgs)
+    digs = np.asarray(jax.jit(sha2.sha256_blocks)(blocks, n))
+    for i, m in enumerate(msgs):
+        assert sha2.digest256_to_bytes(digs[i]) == hashlib.sha256(m).digest(), i
+
+
+def test_sha512_batch():
+    msgs = _msgs()
+    hi, lo, n = sha2.pad_sha512(msgs)
+    dh, dl = jax.jit(sha2.sha512_blocks)(hi, lo, n)
+    dh, dl = np.asarray(dh), np.asarray(dl)
+    for i, m in enumerate(msgs):
+        assert sha2.digest512_to_bytes(dh[i], dl[i]) == hashlib.sha512(m).digest(), i
+
+
+def test_sha512_fixed_max_blocks():
+    msgs = [b"abc", b"x" * 300]
+    hi, lo, n = sha2.pad_sha512(msgs, max_blocks=5)
+    assert hi.shape == (2, 5, 16)
+    dh, dl = jax.jit(sha2.sha512_blocks)(hi, lo, n)
+    for i, m in enumerate(msgs):
+        assert sha2.digest512_to_bytes(np.asarray(dh)[i], np.asarray(dl)[i]) == \
+            hashlib.sha512(m).digest()
