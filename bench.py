@@ -1,0 +1,72 @@
+"""Benchmark: Ed25519 batch-verify throughput on one TPU chip.
+
+Metric of record (BASELINE.json): sig-verifies/sec/chip, Ed25519 batch.
+Baseline: the reference's Go CPU batch verifier (curve25519-voi behind
+crypto/ed25519 BatchVerifier, /root/reference/crypto/ed25519/ed25519.go:208,
+bench harness crypto/ed25519/bench_test.go:31-67). The reference publishes
+no absolute number; Go single verify is ~70-100 µs/op on server x86 and
+voi's batch path roughly halves per-sig cost at batch >= 64, so we take
+25,000 sigs/s (40 µs/sig) as the CPU baseline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "sigs/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+GO_CPU_BASELINE_SIGS_PER_SEC = 25_000.0
+
+
+def main() -> None:
+    import jax
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    batch = int(os.environ.get("BENCH_BATCH", "4096"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+    msg_len = 128  # vote sign-bytes are ~120 bytes (canonical proto)
+
+    import __graft_entry__ as ge
+    pks, msgs, sigs = [], [], []
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    keys = [ref.keygen(bytes([i + 1]) * 32) for i in range(64)]
+    for i in range(batch):
+        seed, pub = keys[i % 64]
+        msg = i.to_bytes(8, "little") * (msg_len // 8)
+        pks.append(pub)
+        msgs.append(msg)
+        sigs.append(ge._sign(seed, msg))
+
+    max_blocks = ed.max_blocks_for(msgs)
+    bucket = dev.bucket_size(batch)
+    a, r, s, mh, ml, nb, valid = ed.pack_batch(pks, msgs, sigs, bucket,
+                                               max_blocks)
+    assert valid.all()
+
+    # compile + correctness
+    verdict = np.asarray(dev.verify_batch_device(a, r, s, mh, ml, nb))
+    assert verdict[:batch].all(), "benchmark batch failed to verify"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dev.verify_batch_device(a, r, s, mh, ml, nb)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    sigs_per_sec = batch / dt
+    print(json.dumps({
+        "metric": "ed25519_batch_verify_throughput",
+        "value": round(sigs_per_sec, 1),
+        "unit": "sigs/sec/chip",
+        "vs_baseline": round(sigs_per_sec / GO_CPU_BASELINE_SIGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,8 +5,13 @@ Host path uses hashlib; bulk device hashing lives in ops/sha2.py.
 """
 
 import hashlib
+import os
 
 TRUNCATED_SIZE = 20
+
+# below this many messages the device round-trip costs more than hashlib
+DEVICE_HASH_THRESHOLD = int(os.environ.get(
+    "COMETBFT_TPU_HASH_THRESHOLD", "512"))
 
 
 def sum_sha256(data: bytes) -> bytes:
@@ -15,3 +20,16 @@ def sum_sha256(data: bytes) -> bytes:
 
 def sum_truncated(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]
+
+
+def sum_sha256_many(msgs: list[bytes]) -> list[bytes]:
+    """Batched SHA-256: device kernel for big batches, hashlib below the
+    threshold (a 10k-validator set hash is ~10k leaf hashes in one
+    launch; a 4-item header field hash is not worth a transfer)."""
+    if len(msgs) < DEVICE_HASH_THRESHOLD:
+        return [hashlib.sha256(m).digest() for m in msgs]
+    import numpy as np
+    from ..ops import sha2
+    blocks, n_blocks = sha2.pad_sha256(msgs)
+    digests = np.asarray(sha2.sha256_blocks(blocks, n_blocks))
+    return [sha2.digest256_to_bytes(digests[i]) for i in range(len(msgs))]

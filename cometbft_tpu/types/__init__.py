@@ -1,0 +1,9 @@
+"""Core datatypes: blocks, votes, validators, commits (types/ analog)."""
+
+from .timestamp import Timestamp  # noqa: F401
+from .block import (  # noqa: F401
+    BlockID, PartSetHeader, BlockIDFlag, CommitSig, Commit, Header, Data,
+    Block,
+)
+from .vote import Vote, PRECOMMIT_TYPE, PREVOTE_TYPE, PROPOSAL_TYPE  # noqa: F401
+from .validator_set import Validator, ValidatorSet  # noqa: F401

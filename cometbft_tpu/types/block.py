@@ -1,0 +1,493 @@
+"""Block, Header, Commit, and friends (types/block.go analog).
+
+Proto layouts follow /root/reference/proto/cometbft/types/v1/types.proto;
+hashing rules follow types/block.go (Header.Hash :446-481 merkle over 14
+proto-encoded fields, Commit.Hash :964 merkle over CommitSig protos,
+Data.Hash :1331 merkle over tx hashes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from ..crypto import merkle
+from ..crypto.hash import sum_sha256
+from ..libs import protowire as pw
+from .timestamp import Timestamp
+
+MAX_HEADER_BYTES = 626
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+
+class BlockIDFlag(IntEnum):
+    ABSENT = 1
+    COMMIT = 2
+    NIL = 3
+
+
+def _cdc_bytes(v: bytes) -> bytes:
+    """cdcEncode for bytes: BytesValue wrapper, nil when empty
+    (types/encoding_helper.go:11-43)."""
+    if not v:
+        return b""
+    return pw.Writer().bytes_field(1, v).bytes()
+
+
+def _cdc_string(v: str) -> bytes:
+    if not v:
+        return b""
+    return pw.Writer().string_field(1, v).bytes()
+
+
+def _cdc_int64(v: int) -> bytes:
+    if v == 0:
+        return b""
+    return pw.Writer().int_field(1, v).bytes()
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """Version info (proto/cometbft/version/v1/types.proto:19)."""
+
+    block: int = 11        # BlockProtocol, version/version.go:21
+    app: int = 0
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().uvarint_field(1, self.block)
+                .uvarint_field(2, self.app).bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Consensus":
+        r = pw.Reader(payload)
+        block = app = 0
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                block = r.read_uvarint()
+            elif f == 2 and w == pw.VARINT:
+                app = r.read_uvarint()
+            else:
+                r.skip(w)
+        return Consensus(block, app)
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and not self.hash
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().uvarint_field(1, self.total)
+                .bytes_field(2, self.hash).bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "PartSetHeader":
+        r = pw.Reader(payload)
+        total, h = 0, b""
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                total = r.read_uvarint()
+            elif f == 2 and w == pw.BYTES:
+                h = r.read_bytes()
+            else:
+                r.skip(w)
+        return PartSetHeader(total, h)
+
+
+@dataclass(frozen=True)
+class BlockID:
+    hash: bytes = b""
+    part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+
+    def is_nil(self) -> bool:
+        """IsNil in the reference: the zero BlockID (block.go:1286)."""
+        return not self.hash and self.part_set_header.is_zero()
+
+    def is_complete(self) -> bool:
+        return (len(self.hash) == 32 and self.part_set_header.total > 0
+                and len(self.part_set_header.hash) == 32)
+
+    def key(self) -> bytes:
+        return self.hash + self.part_set_header.hash + \
+            self.part_set_header.total.to_bytes(4, "big")
+
+    def to_proto(self) -> bytes:
+        # part_set_header is nullable=false: always emitted
+        return (pw.Writer().bytes_field(1, self.hash)
+                .message_field(2, self.part_set_header.to_proto()).bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "BlockID":
+        r = pw.Reader(payload)
+        h, psh = b"", PartSetHeader()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.BYTES:
+                h = r.read_bytes()
+            elif f == 2 and w == pw.BYTES:
+                psh = PartSetHeader.from_proto(r.read_bytes())
+            else:
+                r.skip(w)
+        return BlockID(h, psh)
+
+
+@dataclass(frozen=True)
+class CommitSig:
+    """One validator's precommit inside a Commit (block.go:602)."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    signature: bytes = b""
+
+    @staticmethod
+    def absent() -> "CommitSig":
+        return CommitSig()
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this sig signed over (block.go:640-653)."""
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> None:
+        if self.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            if self.validator_address or self.signature \
+                    or not self.timestamp.is_zero():
+                raise ValueError("absent CommitSig must be empty")
+            return
+        if self.block_id_flag not in (BLOCK_ID_FLAG_COMMIT,
+                                      BLOCK_ID_FLAG_NIL):
+            raise ValueError(f"unknown BlockIDFlag {self.block_id_flag}")
+        if len(self.validator_address) != 20:
+            raise ValueError("expected 20-byte validator address")
+        if not self.signature:
+            raise ValueError("signature is missing")
+        if len(self.signature) > 64:
+            raise ValueError("signature too big")
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().int_field(1, self.block_id_flag)
+                .bytes_field(2, self.validator_address)
+                .message_field(3, self.timestamp.to_proto())
+                .bytes_field(4, self.signature).bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "CommitSig":
+        r = pw.Reader(payload)
+        flag, addr, ts, sig = 0, b"", Timestamp.zero(), b""
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                flag = r.read_int()
+            elif f == 2 and w == pw.BYTES:
+                addr = r.read_bytes()
+            elif f == 3 and w == pw.BYTES:
+                ts = Timestamp.from_proto(r.read_bytes())
+            elif f == 4 and w == pw.BYTES:
+                sig = r.read_bytes()
+            else:
+                r.skip(w)
+        return CommitSig(flag, addr, ts, sig)
+
+
+@dataclass
+class Commit:
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    signatures: list[CommitSig] = field(default_factory=list)
+    _hash: bytes | None = None
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
+        """Canonical sign-bytes for validator val_idx's precommit
+        (block.go:897, vote.go:150)."""
+        from . import canonical
+        sig = self.signatures[val_idx]
+        return canonical.vote_sign_bytes(
+            chain_id, PRECOMMIT, self.height, self.round,
+            sig.block_id(self.block_id), sig.timestamp)
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [s.to_proto() for s in self.signatures])
+        return self._hash
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise ValueError("negative Height")
+        if self.round < 0:
+            raise ValueError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_nil():
+                raise ValueError("commit cannot be for nil block")
+            if not self.signatures:
+                raise ValueError("no signatures in commit")
+            for sig in self.signatures:
+                sig.validate_basic()
+
+    def to_proto(self) -> bytes:
+        w = (pw.Writer().int_field(1, self.height)
+             .int_field(2, self.round)
+             .message_field(3, self.block_id.to_proto()))
+        for sig in self.signatures:
+            w.message_field(4, sig.to_proto())
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Commit":
+        r = pw.Reader(payload)
+        c = Commit()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                c.height = r.read_int()
+            elif f == 2 and w == pw.VARINT:
+                c.round = r.read_int()
+            elif f == 3 and w == pw.BYTES:
+                c.block_id = BlockID.from_proto(r.read_bytes())
+            elif f == 4 and w == pw.BYTES:
+                c.signatures.append(CommitSig.from_proto(r.read_bytes()))
+            else:
+                r.skip(w)
+        return c
+
+
+# avoid circular import at module load: canonical.py imports BlockID
+PRECOMMIT = 2
+
+
+@dataclass
+class Header:
+    version: Consensus = field(default_factory=Consensus)
+    chain_id: str = ""
+    height: int = 0
+    time: Timestamp = field(default_factory=Timestamp.zero)
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes | None:
+        """Merkle root of the 14 proto-encoded fields (block.go:446-481)."""
+        if not self.validators_hash:
+            return None
+        return merkle.hash_from_byte_slices([
+            self.version.to_proto(),
+            _cdc_string(self.chain_id),
+            _cdc_int64(self.height),
+            self.time.to_proto(),
+            self.last_block_id.to_proto(),
+            _cdc_bytes(self.last_commit_hash),
+            _cdc_bytes(self.data_hash),
+            _cdc_bytes(self.validators_hash),
+            _cdc_bytes(self.next_validators_hash),
+            _cdc_bytes(self.consensus_hash),
+            _cdc_bytes(self.app_hash),
+            _cdc_bytes(self.last_results_hash),
+            _cdc_bytes(self.evidence_hash),
+            _cdc_bytes(self.proposer_address),
+        ])
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer()
+                .message_field(1, self.version.to_proto())
+                .string_field(2, self.chain_id)
+                .int_field(3, self.height)
+                .message_field(4, self.time.to_proto())
+                .message_field(5, self.last_block_id.to_proto())
+                .bytes_field(6, self.last_commit_hash)
+                .bytes_field(7, self.data_hash)
+                .bytes_field(8, self.validators_hash)
+                .bytes_field(9, self.next_validators_hash)
+                .bytes_field(10, self.consensus_hash)
+                .bytes_field(11, self.app_hash)
+                .bytes_field(12, self.last_results_hash)
+                .bytes_field(13, self.evidence_hash)
+                .bytes_field(14, self.proposer_address)
+                .bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Header":
+        r = pw.Reader(payload)
+        h = Header()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                h.version = Consensus.from_proto(r.read_bytes())
+            elif f == 2:
+                h.chain_id = r.read_string()
+            elif f == 3:
+                h.height = r.read_int()
+            elif f == 4:
+                h.time = Timestamp.from_proto(r.read_bytes())
+            elif f == 5:
+                h.last_block_id = BlockID.from_proto(r.read_bytes())
+            elif 6 <= f <= 14 and w == pw.BYTES:
+                v = r.read_bytes()
+                attr = ("last_commit_hash", "data_hash", "validators_hash",
+                        "next_validators_hash", "consensus_hash", "app_hash",
+                        "last_results_hash", "evidence_hash",
+                        "proposer_address")[f - 6]
+                setattr(h, attr, v)
+            else:
+                r.skip(w)
+        return h
+
+    def validate_basic(self) -> None:
+        if len(self.chain_id) > 50:
+            raise ValueError("chain_id too long")
+        if self.height < 0:
+            raise ValueError("negative Height")
+        for name in ("last_commit_hash", "data_hash", "validators_hash",
+                     "next_validators_hash", "consensus_hash",
+                     "last_results_hash", "evidence_hash"):
+            v = getattr(self, name)
+            if v and len(v) != 32:
+                raise ValueError(f"wrong {name} size")
+        if self.proposer_address and len(self.proposer_address) != 20:
+            raise ValueError("invalid proposer address size")
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return sum_sha256(tx)
+
+
+@dataclass
+class Data:
+    txs: list[bytes] = field(default_factory=list)
+    _hash: bytes | None = None
+
+    def hash(self) -> bytes:
+        """Merkle root over per-tx SHA-256 (types/tx.go:47, leaves are
+        TxIDs per block.go:1336)."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [tx_hash(tx) for tx in self.txs])
+        return self._hash
+
+    def to_proto(self) -> bytes:
+        w = pw.Writer()
+        for tx in self.txs:
+            w.bytes_field(1, tx)
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Data":
+        r = pw.Reader(payload)
+        txs = []
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.BYTES:
+                txs.append(r.read_bytes())
+            else:
+                r.skip(w)
+        return Data(txs)
+
+
+@dataclass
+class Block:
+    header: Header = field(default_factory=Header)
+    data: Data = field(default_factory=Data)
+    evidence: list = field(default_factory=list)
+    last_commit: Commit | None = None
+
+    def hash(self) -> bytes | None:
+        return self.header.hash()
+
+    def fill_header(self) -> None:
+        """Populate derived header hashes (block.go fillHeader)."""
+        if not self.header.last_commit_hash and self.last_commit:
+            self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = evidence_hash(self.evidence)
+
+    def to_proto(self) -> bytes:
+        w = (pw.Writer()
+             .message_field(1, self.header.to_proto())
+             .message_field(2, self.data.to_proto())
+             .message_field(3, evidence_list_proto(self.evidence)))
+        if self.last_commit is not None:
+            w.message_field(4, self.last_commit.to_proto())
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Block":
+        r = pw.Reader(payload)
+        b = Block()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1:
+                b.header = Header.from_proto(r.read_bytes())
+            elif f == 2:
+                b.data = Data.from_proto(r.read_bytes())
+            elif f == 3:
+                b.evidence = evidence_list_from_proto(r.read_bytes())
+            elif f == 4:
+                b.last_commit = Commit.from_proto(r.read_bytes())
+            else:
+                r.skip(w)
+        return b
+
+    def validate_basic(self) -> None:
+        """block.go:66-100: LastCommit is required at every height
+        (height 1 carries an empty Commit) and its hash must match."""
+        self.header.validate_basic()
+        if self.last_commit is None:
+            raise ValueError("nil LastCommit")
+        self.last_commit.validate_basic()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("wrong LastCommitHash")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("wrong DataHash")
+        if self.header.evidence_hash != evidence_hash(self.evidence):
+            raise ValueError("wrong EvidenceHash")
+
+
+def evidence_hash(evidence: list) -> bytes:
+    """Merkle root over per-evidence proto bytes (types/evidence.go:451
+    EvidenceList.Hash uses Evidence.Bytes() as leaf data)."""
+    return merkle.hash_from_byte_slices([ev.bytes_() for ev in evidence])
+
+
+def evidence_list_proto(evidence: list) -> bytes:
+    from .evidence import evidence_to_proto_wrapped
+    w = pw.Writer()
+    for ev in evidence:
+        w.message_field(1, evidence_to_proto_wrapped(ev))
+    return w.bytes()
+
+
+def evidence_list_from_proto(payload: bytes) -> list:
+    from . import evidence as ev_mod
+    r = pw.Reader(payload)
+    out = []
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == 1 and w == pw.BYTES:
+            out.append(ev_mod.evidence_from_proto_wrapped(r.read_bytes()))
+        else:
+            r.skip(w)
+    return out

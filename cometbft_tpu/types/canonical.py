@@ -1,0 +1,70 @@
+"""Canonical sign-bytes (types/canonical.go + vote.go:142-171 analog).
+
+These bytes are what validators sign — byte-for-byte compatibility with
+the reference is consensus-critical. Layouts from
+/root/reference/proto/cometbft/types/v1/canonical.proto:
+- CanonicalVote: type=1 varint, height=2 sfixed64, round=3 sfixed64,
+  block_id=4 (nullable: omitted for nil votes), timestamp=5 (always),
+  chain_id=6.
+- CanonicalProposal: type=1, height=2 sfixed64, round=3 sfixed64,
+  pol_round=4 varint, block_id=5, timestamp=6, chain_id=7.
+- CanonicalVoteExtension: extension=1, height=2 sfixed64,
+  round=3 sfixed64, chain_id=4.
+The result is length-delimited (varint size prefix, vote.go:150-158).
+"""
+
+from __future__ import annotations
+
+from ..libs import protowire as pw
+from .block import BlockID
+from .timestamp import Timestamp
+
+PREVOTE = 1
+PRECOMMIT = 2
+PROPOSAL = 32
+
+
+def canonical_block_id(block_id: BlockID) -> bytes | None:
+    """nil for zero BlockIDs (canonical.go:18-35)."""
+    if block_id.is_nil():
+        return None
+    psh = (pw.Writer().uvarint_field(1, block_id.part_set_header.total)
+           .bytes_field(2, block_id.part_set_header.hash).bytes())
+    return (pw.Writer().bytes_field(1, block_id.hash)
+            .message_field(2, psh).bytes())
+
+
+def vote_sign_bytes(chain_id: str, msg_type: int, height: int, round_: int,
+                    block_id: BlockID, timestamp: Timestamp) -> bytes:
+    w = (pw.Writer()
+         .int_field(1, msg_type)
+         .sfixed64_field(2, height)
+         .sfixed64_field(3, round_)
+         .optional_message_field(4, canonical_block_id(block_id))
+         .message_field(5, timestamp.to_proto())
+         .string_field(6, chain_id))
+    return pw.marshal_delimited(w.bytes())
+
+
+def proposal_sign_bytes(chain_id: str, height: int, round_: int,
+                        pol_round: int, block_id: BlockID,
+                        timestamp: Timestamp) -> bytes:
+    w = (pw.Writer()
+         .int_field(1, PROPOSAL)
+         .sfixed64_field(2, height)
+         .sfixed64_field(3, round_)
+         .int_field(4, pol_round)
+         .optional_message_field(5, canonical_block_id(block_id))
+         .message_field(6, timestamp.to_proto())
+         .string_field(7, chain_id))
+    return pw.marshal_delimited(w.bytes())
+
+
+def vote_extension_sign_bytes(chain_id: str, height: int, round_: int,
+                              extension: bytes) -> bytes:
+    w = (pw.Writer()
+         .bytes_field(1, extension)
+         .sfixed64_field(2, height)
+         .sfixed64_field(3, round_)
+         .string_field(4, chain_id))
+    return pw.marshal_delimited(w.bytes())

@@ -1,0 +1,220 @@
+"""Commit verification — THE hot path (types/validation.go analog).
+
+verify_commit / verify_commit_light / verify_commit_light_trusting
+reproduce the reference's ignore/count/threshold semantics
+(/root/reference/types/validation.go:28,63,129,220-324,333-408) with the
+batch routed to the TPU BatchVerifier (crypto/batch.py). Differences by
+design:
+- the batch threshold is higher than the reference's 2 because the
+  device round-trip has fixed cost (crypto/batch.DEVICE_THRESHOLD);
+- mixed-keytype commits batch through MixedBatchVerifier instead of
+  falling back to per-signature CPU verification (BASELINE.json target).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import batch as crypto_batch
+from .block import (
+    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BlockID, Commit,
+)
+from .validator_set import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2
+
+
+@dataclass(frozen=True)
+class Fraction:
+    numerator: int
+    denominator: int
+
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class CommitVerificationError(Exception):
+    pass
+
+
+class ErrNotEnoughVotingPowerSigned(CommitVerificationError):
+    def __init__(self, got: int, needed: int):
+        super().__init__(
+            f"invalid commit -- insufficient voting power: got {got}, "
+            f"needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+class ErrInvalidSignature(CommitVerificationError):
+    pass
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    if len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        return False
+    if vals.all_keys_have_same_type():
+        proposer = vals.get_proposer()
+        return proposer is not None and \
+            crypto_batch.supports_batch_verifier(proposer.pub_key.type())
+    # mixed keytypes: our device path handles them (reference refuses,
+    # types/validation.go:18)
+    return True
+
+
+def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
+                  height: int, commit: Commit) -> None:
+    """+2/3 signed; checks ALL signatures (validation.go:28-56)."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda cs: cs.block_id_flag == BLOCK_ID_FLAG_ABSENT  # noqa: E731
+    count = lambda cs: cs.block_id_flag == BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    _verify(chain_id, vals, commit, needed, ignore, count,
+            count_all=True, lookup_by_index=True)
+
+
+def verify_commit_light(chain_id: str, vals: ValidatorSet,
+                        block_id: BlockID, height: int,
+                        commit: Commit) -> None:
+    """+2/3 signed; stops as soon as the tally crosses (validation.go:63)."""
+    _verify_commit_light(chain_id, vals, block_id, height, commit,
+                         count_all=False)
+
+
+def verify_commit_light_all_signatures(chain_id: str, vals: ValidatorSet,
+                                       block_id: BlockID, height: int,
+                                       commit: Commit) -> None:
+    _verify_commit_light(chain_id, vals, block_id, height, commit,
+                         count_all=True)
+
+
+def _verify_commit_light(chain_id, vals, block_id, height, commit,
+                         count_all):
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda cs: cs.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    count = lambda cs: True  # noqa: E731
+    _verify(chain_id, vals, commit, needed, ignore, count,
+            count_all=count_all, lookup_by_index=True)
+
+
+def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet,
+                                 commit: Commit,
+                                 trust_level: Fraction) -> None:
+    """trust_level of the (possibly different) valset signed
+    (validation.go:129-204); lookup by address, early exit."""
+    _verify_commit_light_trusting(chain_id, vals, commit, trust_level,
+                                  count_all=False)
+
+
+def verify_commit_light_trusting_all_signatures(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        trust_level: Fraction) -> None:
+    _verify_commit_light_trusting(chain_id, vals, commit, trust_level,
+                                  count_all=True)
+
+
+def _verify_commit_light_trusting(chain_id, vals, commit, trust_level,
+                                  count_all):
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if trust_level.denominator == 0:
+        raise CommitVerificationError("trustLevel has zero Denominator")
+    total = vals.total_voting_power()
+    if total * trust_level.numerator > (1 << 63) - 1:
+        raise CommitVerificationError("int64 overflow in voting power")
+    needed = total * trust_level.numerator // trust_level.denominator
+    ignore = lambda cs: cs.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    count = lambda cs: True  # noqa: E731
+    _verify(chain_id, vals, commit, needed, ignore, count,
+            count_all=count_all, lookup_by_index=False)
+
+
+def _verify_basic(vals, commit, height, block_id):
+    if vals is None:
+        raise CommitVerificationError("nil validator set")
+    if commit is None:
+        raise CommitVerificationError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise CommitVerificationError(
+            f"invalid commit -- wrong set size: {vals.size()} vs "
+            f"{len(commit.signatures)}")
+    if height != commit.height:
+        raise CommitVerificationError(
+            f"invalid commit -- wrong height: {height} vs {commit.height}")
+    if block_id != commit.block_id:
+        raise CommitVerificationError(
+            f"invalid commit -- wrong block ID: want {block_id}, "
+            f"got {commit.block_id}")
+
+
+def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
+            lookup_by_index):
+    """Unified batch/single verification.
+
+    Mirrors verifyCommitBatch/verifyCommitSingle (validation.go:220-408):
+    collect the non-ignored sigs (resolving validators by index or
+    address), tally counted voting power with early exit, then verify —
+    on device when batching is worthwhile, else host-by-host.
+    """
+    use_batch = _should_batch_verify(vals, commit)
+
+    entries = []          # (commit_idx, validator, sign_bytes, signature)
+    seen: dict[int, int] = {}
+    tallied = 0
+
+    for idx, cs in enumerate(commit.signatures):
+        if ignore(cs):
+            continue
+        if lookup_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen:
+                raise CommitVerificationError(
+                    f"double vote from {val.address.hex()} "
+                    f"({seen[val_idx]} and {idx})")
+            seen[val_idx] = idx
+        if not use_batch:
+            cs.validate_basic()
+            if val.pub_key is None:
+                raise CommitVerificationError(
+                    f"validator {val.address.hex()} has nil pubkey")
+        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        entries.append((idx, val, sign_bytes, cs.signature))
+        if count(cs):
+            tallied += val.voting_power
+        if not count_all and tallied > needed:
+            break
+
+    if tallied <= needed:
+        raise ErrNotEnoughVotingPowerSigned(tallied, needed)
+
+    if not entries:
+        raise CommitVerificationError("BUG: no signatures to verify")
+
+    if use_batch:
+        bv = crypto_batch.MixedBatchVerifier() \
+            if not vals.all_keys_have_same_type() \
+            else crypto_batch.create_batch_verifier(
+                vals.get_proposer().pub_key.type(), n_hint=len(entries))
+        for _, val, sign_bytes, sig in entries:
+            bv.add(val.pub_key, sign_bytes, sig)
+        ok, verdicts = bv.verify()
+        if ok:
+            return
+        for (idx, _, _, sig), valid in zip(entries, verdicts):
+            if not valid:
+                raise ErrInvalidSignature(
+                    f"wrong signature (#{idx}): {sig.hex()}")
+        raise CommitVerificationError(
+            "BUG: batch verification failed with no invalid signatures")
+
+    for idx, val, sign_bytes, sig in entries:
+        if not val.pub_key.verify_signature(sign_bytes, sig):
+            raise ErrInvalidSignature(
+                f"wrong signature (#{idx}): {sig.hex()}")

@@ -1,0 +1,53 @@
+"""Merkle tree tests against independently-computed RFC6962 hashes."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+
+
+def h(b):
+    return hashlib.sha256(b).digest()
+
+
+def test_empty_tree():
+    assert merkle.hash_from_byte_slices([]) == h(b"")
+
+
+def test_single_leaf():
+    assert merkle.hash_from_byte_slices([b"abc"]) == h(b"\x00abc")
+
+
+def test_two_leaves():
+    expected = h(b"\x01" + h(b"\x00" + b"a") + h(b"\x00" + b"b"))
+    assert merkle.hash_from_byte_slices([b"a", b"b"]) == expected
+
+
+def test_three_leaves_split_point():
+    # split = 2: inner(inner(l0, l1), l2)
+    l0, l1, l2 = (h(b"\x00" + x) for x in (b"a", b"b", b"c"))
+    expected = h(b"\x01" + h(b"\x01" + l0 + l1) + l2)
+    assert merkle.hash_from_byte_slices([b"a", b"b", b"c"]) == expected
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 32])
+def test_proofs_verify(n):
+    items = [bytes([i]) * 4 for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, proof in enumerate(proofs):
+        assert proof.index == i and proof.total == n
+        proof.verify(root, items[i])
+        with pytest.raises(ValueError):
+            proof.verify(root, items[i] + b"x")
+        if n > 1:
+            bad = bytes(32)
+            with pytest.raises(ValueError):
+                merkle.Proof(n, i, proof.leaf_hash,
+                             [bad] * len(proof.aunts)).verify(root, items[i])
+
+
+def test_split_point():
+    assert [merkle.split_point(n) for n in (2, 3, 4, 5, 8, 9)] == \
+        [1, 2, 2, 4, 4, 8]

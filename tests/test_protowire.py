@@ -1,0 +1,74 @@
+"""Wire-format unit tests with hand-computed vectors."""
+
+from cometbft_tpu.libs import protowire as pw
+
+
+def test_uvarint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 64 - 1):
+        enc = pw.encode_uvarint(v)
+        dec, pos = pw.decode_uvarint(enc)
+        assert dec == v and pos == len(enc)
+
+
+def test_uvarint_known():
+    assert pw.encode_uvarint(1) == b"\x01"
+    assert pw.encode_uvarint(300) == b"\xac\x02"
+
+
+def test_negative_int_is_ten_bytes():
+    w = pw.Writer().int_field(1, -1)
+    enc = w.bytes()
+    # tag 0x08 + 10-byte varint of 2^64-1
+    assert enc == b"\x08" + b"\xff" * 9 + b"\x01"
+    r = pw.Reader(enc)
+    f, wt = r.read_tag()
+    assert (f, wt) == (1, pw.VARINT)
+    assert r.read_int() == -1
+
+
+def test_sfixed64():
+    enc = pw.Writer().sfixed64_field(2, 1).bytes()
+    assert enc == b"\x11\x01\x00\x00\x00\x00\x00\x00\x00"
+    r = pw.Reader(enc)
+    r.read_tag()
+    assert r.read_sfixed64() == 1
+
+
+def test_zero_scalars_omitted():
+    w = (pw.Writer().int_field(1, 0).uvarint_field(2, 0)
+         .bytes_field(3, b"").string_field(4, ""))
+    assert w.bytes() == b""
+
+
+def test_message_field_always_emitted():
+    # gogo nullable=false: empty embedded message still writes tag+len
+    assert pw.Writer().message_field(5, b"").bytes() == b"\x2a\x00"
+
+
+def test_timestamp():
+    enc = pw.encode_timestamp(5, 7)
+    assert enc == b"\x08\x05\x10\x07"
+    assert pw.decode_timestamp(enc) == (5, 7)
+    assert pw.encode_timestamp(0, 0) == b""
+
+
+def test_delimited():
+    payload = b"hello"
+    framed = pw.marshal_delimited(payload)
+    assert framed == b"\x05hello"
+    out, pos = pw.unmarshal_delimited(framed)
+    assert out == payload and pos == len(framed)
+
+
+def test_reader_skips_unknown():
+    w = (pw.Writer().int_field(1, 9).bytes_field(2, b"xy")
+         .sfixed64_field(3, 4).uvarint_field(4, 2))
+    r = pw.Reader(w.bytes())
+    seen = {}
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f == 4:
+            seen[f] = r.read_uvarint()
+        else:
+            r.skip(wt)
+    assert seen == {4: 2}

@@ -1,0 +1,318 @@
+"""Types-layer tests: sign-bytes vectors, hashing, commit verification.
+
+Signature verification here runs the CPU provider (fast, no device);
+the device batch path is covered by test_ed25519.py and
+test_validation_device.py.
+"""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.types import (
+    Block, BlockID, Commit, CommitSig, Data, Header, PartSetHeader,
+    Timestamp, Validator, ValidatorSet, Vote,
+)
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL, Consensus,
+)
+from cometbft_tpu.types import canonical, validation
+from cometbft_tpu.types.validation import (
+    ErrInvalidSignature, ErrNotEnoughVotingPowerSigned, Fraction,
+)
+
+CHAIN_ID = "test-chain"
+
+
+# ---------------------------------------------------------------------------
+# canonical sign bytes
+# ---------------------------------------------------------------------------
+
+def test_canonical_vote_sign_bytes_nil_block():
+    # type=2(precommit), height=1, round=0, nil block, zero ts, chain "test"
+    got = canonical.vote_sign_bytes("test", 2, 1, 0, BlockID(),
+                                    Timestamp.zero())
+    expected = bytes.fromhex("13") + \
+        b"\x08\x02" + \
+        b"\x11\x01\x00\x00\x00\x00\x00\x00\x00" + \
+        b"\x2a\x00" + \
+        b"\x32\x04test"
+    assert got == expected
+
+
+def test_canonical_vote_sign_bytes_with_block():
+    bid = BlockID(hash=b"\xaa" * 32,
+                  part_set_header=PartSetHeader(1, b"\xbb" * 32))
+    got = canonical.vote_sign_bytes("test", 2, 3, 2, bid,
+                                    Timestamp(1, 500))
+    # canonical block id: hash=1, psh=2{total=1,hash}
+    psh = b"\x08\x01" + b"\x12\x20" + b"\xbb" * 32
+    cbid = b"\x0a\x20" + b"\xaa" * 32 + b"\x12" + bytes([len(psh)]) + psh
+    body = (b"\x08\x02"
+            + b"\x11\x03\x00\x00\x00\x00\x00\x00\x00"
+            + b"\x19\x02\x00\x00\x00\x00\x00\x00\x00"
+            + b"\x22" + bytes([len(cbid)]) + cbid
+            + b"\x2a\x05\x08\x01\x10\xf4\x03"
+            + b"\x32\x04test")
+    assert got == bytes([len(body)]) + body
+
+
+def test_vote_sign_verify_roundtrip():
+    priv = ed25519.PrivKey.generate(b"\x01" * 32)
+    vote = Vote(type=2, height=5, round=1,
+                block_id=BlockID(b"\xcc" * 32, PartSetHeader(2, b"\xdd" * 32)),
+                timestamp=Timestamp(100, 5),
+                validator_address=priv.pub_key().address(),
+                validator_index=0)
+    vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+    vote.verify(CHAIN_ID, priv.pub_key())
+    with pytest.raises(ValueError):
+        vote.verify("other-chain", priv.pub_key())
+
+
+# ---------------------------------------------------------------------------
+# block / header
+# ---------------------------------------------------------------------------
+
+def test_header_hash_structure():
+    hdr = Header(version=Consensus(11, 1), chain_id=CHAIN_ID, height=3,
+                 time=Timestamp(1000, 0),
+                 validators_hash=b"\x01" * 32,
+                 next_validators_hash=b"\x02" * 32,
+                 consensus_hash=b"\x03" * 32,
+                 proposer_address=b"\x04" * 20)
+    h1 = hdr.hash()
+    assert h1 is not None and len(h1) == 32
+    hdr2 = Header(**{**hdr.__dict__})
+    hdr2.height = 4
+    assert hdr2.hash() != h1
+    # headers without validators_hash have no hash (block.go:447)
+    assert Header().hash() is None
+
+
+def test_header_proto_roundtrip():
+    hdr = Header(version=Consensus(11, 7), chain_id=CHAIN_ID, height=9,
+                 time=Timestamp(5, 6),
+                 last_block_id=BlockID(b"\xee" * 32,
+                                       PartSetHeader(4, b"\xff" * 32)),
+                 last_commit_hash=b"\x11" * 32, data_hash=b"\x12" * 32,
+                 validators_hash=b"\x13" * 32,
+                 next_validators_hash=b"\x14" * 32,
+                 consensus_hash=b"\x15" * 32, app_hash=b"\x16" * 32,
+                 last_results_hash=b"\x17" * 32, evidence_hash=b"\x18" * 32,
+                 proposer_address=b"\x19" * 20)
+    assert Header.from_proto(hdr.to_proto()) == hdr
+
+
+def test_commit_hash_and_roundtrip():
+    commit = Commit(
+        height=10, round=1,
+        block_id=BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32)),
+        signatures=[
+            CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x01" * 20, Timestamp(9, 0),
+                      b"\x02" * 64),
+            CommitSig.absent(),
+            CommitSig(BLOCK_ID_FLAG_NIL, b"\x03" * 20, Timestamp(9, 1),
+                      b"\x04" * 64),
+        ])
+    h = commit.hash()
+    assert len(h) == 32
+    rt = Commit.from_proto(commit.to_proto())
+    assert rt.height == commit.height and rt.round == commit.round
+    assert rt.block_id == commit.block_id
+    assert rt.signatures == commit.signatures
+    assert rt.hash() == h
+
+
+def test_data_hash_is_merkle_of_tx_hashes():
+    txs = [b"tx1", b"tx2-longer"]
+    from cometbft_tpu.crypto import merkle
+    expected = merkle.hash_from_byte_slices(
+        [hashlib.sha256(tx).digest() for tx in txs])
+    assert Data(txs).hash() == expected
+
+
+def test_block_roundtrip_and_validate():
+    commit = Commit(height=1, round=0,
+                    block_id=BlockID(b"\x01" * 32,
+                                     PartSetHeader(1, b"\x02" * 32)),
+                    signatures=[CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x05" * 20,
+                                          Timestamp(3, 0), b"\x06" * 64)])
+    block = Block(header=Header(chain_id=CHAIN_ID, height=2,
+                                validators_hash=b"\x0a" * 32,
+                                proposer_address=b"\x0b" * 20),
+                  data=Data([b"tx"]), last_commit=commit)
+    block.fill_header()
+    block.validate_basic()
+    rt = Block.from_proto(block.to_proto())
+    assert rt.header == block.header
+    assert rt.data.txs == block.data.txs
+    assert rt.last_commit.hash() == commit.hash()
+    assert rt.hash() == block.hash()
+
+
+# ---------------------------------------------------------------------------
+# validator set
+# ---------------------------------------------------------------------------
+
+def _val(seed: int, power: int) -> Validator:
+    priv = ed25519.PrivKey.generate(bytes([seed]) * 32)
+    return Validator(priv.pub_key(), power)
+
+
+def _valset_with_keys(powers):
+    privs = [ed25519.PrivKey.generate(bytes([i + 1]) * 32)
+             for i in range(len(powers))]
+    vals = [Validator(p.pub_key(), pw) for p, pw in zip(privs, powers)]
+    vs = ValidatorSet(vals)
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vs, by_addr
+
+
+def test_valset_sorted_by_address():
+    vs = ValidatorSet([_val(3, 10), _val(1, 20), _val(2, 30)])
+    addrs = [v.address for v in vs.validators]
+    assert addrs == sorted(addrs)
+    assert vs.total_voting_power() == 60
+
+
+def test_valset_hash_changes_with_power():
+    a = ValidatorSet([_val(1, 10), _val(2, 20)])
+    b = ValidatorSet([_val(1, 10), _val(2, 21)])
+    assert a.hash() != b.hash()
+    assert len(a.hash()) == 32
+
+
+def test_proposer_rotation_proportional():
+    vs = ValidatorSet([_val(1, 1), _val(2, 2), _val(3, 5)])
+    counts = {}
+    for _ in range(800):
+        p = vs.get_proposer()
+        counts[p.address] = counts.get(p.address, 0) + 1
+        vs.increment_proposer_priority(1)
+    by_power = sorted(counts.values())
+    assert by_power[0] == pytest.approx(100, abs=5)
+    assert by_power[1] == pytest.approx(200, abs=5)
+    assert by_power[2] == pytest.approx(500, abs=5)
+
+
+def test_valset_update_add_remove():
+    vs = ValidatorSet([_val(1, 10), _val(2, 20)])
+    v3 = _val(3, 30)
+    vs.update_with_change_set([v3])
+    assert vs.size() == 3 and vs.total_voting_power() == 60
+    # fresh validator gets -1.125*total priority before rescale/shift
+    vs.update_with_change_set([Validator(v3.pub_key, 0)])
+    assert vs.size() == 2 and vs.total_voting_power() == 30
+    with pytest.raises(ValueError):
+        vs.update_with_change_set([Validator(v3.pub_key, 0)])
+
+
+def test_valset_proto_roundtrip():
+    vs = ValidatorSet([_val(1, 10), _val(2, 20)])
+    rt = ValidatorSet.from_proto(vs.to_proto())
+    assert [v.address for v in rt.validators] == \
+        [v.address for v in vs.validators]
+    assert rt.hash() == vs.hash()
+
+
+# ---------------------------------------------------------------------------
+# commit verification (CPU provider)
+# ---------------------------------------------------------------------------
+
+def _make_commit(vs, by_addr, height=5, chain_id=CHAIN_ID,
+                 absent=(), nil=()):
+    bid = BlockID(b"\xab" * 32, PartSetHeader(1, b"\xcd" * 32))
+    commit = Commit(height=height, round=0, block_id=bid, signatures=[])
+    for i, val in enumerate(vs.validators):
+        if i in absent:
+            commit.signatures.append(CommitSig.absent())
+            continue
+        flag = BLOCK_ID_FLAG_NIL if i in nil else BLOCK_ID_FLAG_COMMIT
+        ts = Timestamp(1000 + i, 0)
+        cs = CommitSig(flag, val.address, ts, b"")
+        sign_bid = bid if flag == BLOCK_ID_FLAG_COMMIT else BlockID()
+        sb = canonical.vote_sign_bytes(chain_id, 2, height, 0, sign_bid, ts)
+        priv = by_addr[val.address]
+        commit.signatures.append(
+            CommitSig(flag, val.address, ts, priv.sign(sb)))
+    return bid, commit
+
+
+@pytest.fixture(autouse=True)
+def _cpu_provider(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_PROVIDER", "cpu")
+
+
+def test_verify_commit_ok():
+    vs, by_addr = _valset_with_keys([10, 20, 30, 40])
+    bid, commit = _make_commit(vs, by_addr)
+    validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_verify_commit_light_ok_with_absents():
+    vs, by_addr = _valset_with_keys([10, 20, 30, 40])
+    bid, commit = _make_commit(vs, by_addr, absent=(0,))
+    validation.verify_commit_light(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_verify_commit_insufficient_power():
+    vs, by_addr = _valset_with_keys([10, 20, 30, 40])
+    bid, commit = _make_commit(vs, by_addr, absent=(2, 3))
+    with pytest.raises(ErrNotEnoughVotingPowerSigned):
+        validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_verify_commit_bad_signature():
+    vs, by_addr = _valset_with_keys([10, 20, 30])
+    bid, commit = _make_commit(vs, by_addr)
+    s = commit.signatures[1]
+    bad = bytes(64)
+    commit.signatures[1] = CommitSig(s.block_id_flag, s.validator_address,
+                                     s.timestamp, bad)
+    with pytest.raises(ErrInvalidSignature):
+        validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_verify_commit_wrong_height_or_blockid():
+    vs, by_addr = _valset_with_keys([10, 20])
+    bid, commit = _make_commit(vs, by_addr)
+    with pytest.raises(validation.CommitVerificationError):
+        validation.verify_commit(CHAIN_ID, vs, bid, 6, commit)
+    with pytest.raises(validation.CommitVerificationError):
+        validation.verify_commit(CHAIN_ID, vs, BlockID(), 5, commit)
+
+
+def test_verify_commit_nil_votes_counted_light_not_full():
+    # nil votes verify but only count in the light variant
+    vs, by_addr = _valset_with_keys([10, 10, 10])
+    bid, commit = _make_commit(vs, by_addr, nil=(0, 1))
+    with pytest.raises(ErrNotEnoughVotingPowerSigned):
+        validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
+    with pytest.raises(ErrNotEnoughVotingPowerSigned):
+        validation.verify_commit_light(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_verify_commit_light_trusting():
+    vs, by_addr = _valset_with_keys([10, 20, 30, 40])
+    bid, commit = _make_commit(vs, by_addr)
+    validation.verify_commit_light_trusting(CHAIN_ID, vs, commit,
+                                            Fraction(1, 3))
+    # a superset valset: lookup by address still works
+    extra = _val(9, 100)
+    vs2 = ValidatorSet([*(v.copy() for v in vs.validators), extra])
+    with pytest.raises(ErrNotEnoughVotingPowerSigned):
+        # 100/200 needed with 2/3 trust level? signed=100 > 2/3*200=133? no
+        validation.verify_commit_light_trusting(CHAIN_ID, vs2, commit,
+                                                Fraction(2, 3))
+    validation.verify_commit_light_trusting(CHAIN_ID, vs2, commit,
+                                            Fraction(1, 3))
+
+
+def test_verify_commit_size_mismatch():
+    vs, by_addr = _valset_with_keys([10, 20, 30])
+    bid, commit = _make_commit(vs, by_addr)
+    commit.signatures.pop()
+    with pytest.raises(validation.CommitVerificationError):
+        validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
