@@ -1,0 +1,297 @@
+"""Light client with bisection and witness cross-checking
+(light/client.go analog).
+
+Sync strategies (client.go:612,705,932):
+- sequential: verify every header from trusted to target;
+- skipping (default): trust-propagation bisection — try the target
+  directly against the latest trusted block; on 1/3-overlap failure
+  fetch a pivot at 9/16 of the span and recurse, caching fetched blocks;
+- backwards: hash-chain walk for heights below the trusted root.
+
+Every commit verification lands on the TPU batch verifier, so one
+bisection hop = one or two device launches regardless of valset size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..types.timestamp import Timestamp
+from ..types.validation import Fraction
+from . import verifier
+from .provider import (
+    ErrHeightTooHigh, ErrLightBlockNotFound, ErrNoResponse, Provider,
+    ProviderError,
+)
+from .store import MemoryStore, Store
+from .types import LightBlock
+from .verifier import (
+    DEFAULT_TRUST_LEVEL, ErrNewValSetCantBeTrusted, LightClientError, SECOND,
+)
+
+SEQUENTIAL = "sequential"
+SKIPPING = "skipping"
+
+# pivot ratio for bisection (client.go:31-32)
+_SKIP_NUM = 9
+_SKIP_DEN = 16
+
+DEFAULT_PRUNING_SIZE = 1000
+
+
+@dataclass
+class TrustOptions:
+    """Trust root: (period, height, hash) (light/client.go TrustOptions)."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+    def validate_basic(self) -> None:
+        if self.period_ns <= 0:
+            raise ValueError("trusting period must be > 0")
+        if self.height <= 0:
+            raise ValueError("trusted height must be > 0")
+        if len(self.hash) != 32:
+            raise ValueError("expected 32-byte trusted hash")
+
+
+class ErrLightClientAttack(LightClientError):
+    def __init__(self, evidence):
+        super().__init__("light client attack detected")
+        self.evidence = evidence
+
+
+class Client:
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 primary: Provider, witnesses: list[Provider] | None = None,
+                 trusted_store: Store | None = None,
+                 verification_mode: str = SKIPPING,
+                 trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                 max_clock_drift_ns: int = 10 * SECOND,
+                 pruning_size: int = DEFAULT_PRUNING_SIZE,
+                 now_fn=Timestamp.now):
+        verifier.validate_trust_level(trust_level)
+        trust_options.validate_basic()
+        self.chain_id = chain_id
+        self.trusting_period_ns = trust_options.period_ns
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.verification_mode = verification_mode
+        self.primary = primary
+        self.witnesses = list(witnesses or [])
+        self.store: Store = trusted_store or MemoryStore()
+        self.pruning_size = pruning_size
+        self._now = now_fn
+        self._initialize(trust_options)
+
+    # -- initialization ----------------------------------------------------
+
+    def _initialize(self, opts: TrustOptions) -> None:
+        """client.go initializeWithTrustOptions: fetch the root block,
+        check hash + self-consistency, persist."""
+        existing = self.store.light_block(opts.height)
+        if existing is not None:
+            if existing.hash() != opts.hash:
+                raise LightClientError(
+                    "trusted store block hash does not match trust options")
+            return
+        lb = self._from_primary(opts.height)
+        if lb.hash() != opts.hash:
+            raise LightClientError(
+                f"primary's header hash {lb.hash().hex()} does not match "
+                f"trust options' {opts.hash.hex()}")
+        lb.validate_basic(self.chain_id)
+        # 2/3 of that height's valset must have signed (self-consistent root)
+        from ..types.validation import verify_commit_light
+        verify_commit_light(self.chain_id, lb.validator_set,
+                            lb.signed_header.commit.block_id, lb.height,
+                            lb.signed_header.commit)
+        self.store.save_light_block(lb)
+
+    # -- public API --------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> LightBlock | None:
+        return self.store.light_block(height)
+
+    def latest_trusted(self) -> LightBlock | None:
+        return self.store.latest_light_block()
+
+    def update(self, now: Timestamp | None = None) -> LightBlock | None:
+        """Fetch + verify the primary's latest block (client.go:447)."""
+        now = now or self._now()
+        latest = self._from_primary(0)
+        trusted = self.store.latest_light_block()
+        if trusted is not None and latest.height <= trusted.height:
+            return None
+        return self.verify_light_block_at_height(latest.height, now, latest)
+
+    def verify_light_block_at_height(self, height: int,
+                                     now: Timestamp | None = None,
+                                     prefetched: LightBlock | None = None
+                                     ) -> LightBlock:
+        """client.go:473 VerifyLightBlockAtHeight."""
+        if height <= 0:
+            raise ValueError("height must be positive")
+        now = now or self._now()
+        existing = self.store.light_block(height)
+        if existing is not None:
+            return existing
+        latest = self.store.latest_light_block()
+        if latest is None:
+            raise LightClientError("no trusted state: initialize first")
+        target = prefetched if prefetched is not None and \
+            prefetched.height == height else self._from_primary(height)
+        if target.height != height:
+            raise LightClientError(
+                f"provider returned height {target.height}, wanted {height}")
+        self.verify_header(target, now)
+        return target
+
+    def verify_header(self, new_block: LightBlock, now: Timestamp) -> None:
+        """client.go:563 VerifyHeader (already-fetched block path).
+
+        Verifies forward from the closest trusted block below the target
+        (client.go:594-600); heights below the first trusted block go
+        through backwards hash-chaining."""
+        latest = self.store.latest_light_block()
+        if latest is None:
+            raise LightClientError("no trusted state")
+        if new_block.height < self.store.first_light_block().height:
+            self._backwards(new_block, now)
+            return
+        anchor = self.store.light_block_before(new_block.height + 1)
+        if anchor is not None and anchor.height == new_block.height:
+            return  # already trusted (caller checked, but be safe)
+        new_block.validate_basic(self.chain_id)
+        if self.verification_mode == SEQUENTIAL:
+            trace = self._verify_sequential(anchor, new_block, now)
+        else:
+            trace = self._verify_skipping(self.primary, anchor, new_block,
+                                          now)
+        self._detect_divergence(trace, now)
+        for lb in trace[1:]:
+            self.store.save_light_block(lb)
+        self.store.prune(self.pruning_size)
+
+    # -- strategies --------------------------------------------------------
+
+    def _verify_sequential(self, trusted: LightBlock, target: LightBlock,
+                           now: Timestamp) -> list[LightBlock]:
+        """client.go:612 verifySequential."""
+        trace = [trusted]
+        verified = trusted
+        for h in range(trusted.height + 1, target.height + 1):
+            interim = target if h == target.height else \
+                self._from_primary(h)
+            verifier.verify_adjacent(
+                verified.signed_header, interim.signed_header,
+                interim.validator_set, self.trusting_period_ns, now,
+                self.max_clock_drift_ns)
+            verified = interim
+            trace.append(interim)
+        return trace
+
+    def _verify_skipping(self, source: Provider, trusted: LightBlock,
+                         target: LightBlock, now: Timestamp
+                         ) -> list[LightBlock]:
+        """client.go:705 verifySkipping (bisection with block cache)."""
+        block_cache = [target]
+        depth = 0
+        verified = trusted
+        trace = [trusted]
+        while True:
+            try:
+                verifier.verify_light_block(
+                    verified, block_cache[depth], self.trusting_period_ns,
+                    now, self.max_clock_drift_ns, self.trust_level)
+            except ErrNewValSetCantBeTrusted:
+                if depth == len(block_cache) - 1:
+                    pivot = verified.height + (
+                        block_cache[depth].height - verified.height
+                    ) * _SKIP_NUM // _SKIP_DEN
+                    try:
+                        interim = source.light_block(pivot)
+                    except (ErrLightBlockNotFound, ErrNoResponse,
+                            ErrHeightTooHigh) as pe:
+                        raise LightClientError(
+                            f"cannot get pivot block {pivot}: {pe}") from pe
+                    block_cache.append(interim)
+                depth += 1
+                continue
+            if depth == 0:
+                return trace + [target] if trace[-1] is not target else trace
+            verified = block_cache[depth]
+            block_cache = block_cache[:depth]
+            depth = 0
+            trace.append(verified)
+
+    def _backwards(self, target: LightBlock, now: Timestamp) -> None:
+        """client.go:932 backwards: hash-chain below the trusted root.
+
+        Interim headers are NOT saved (client.go:507) — only the fully
+        validated target, after the whole chain of hashes checks out."""
+        target.validate_basic(self.chain_id)
+        first = self.store.first_light_block()
+        verified_header = first.signed_header.header
+        while verified_header.height > target.height:
+            h = verified_header.height - 1
+            interim = target if h == target.height else self._from_primary(h)
+            verifier.verify_backwards(interim.signed_header.header,
+                                      verified_header)
+            verified_header = interim.signed_header.header
+        self.store.save_light_block(target)
+
+    # -- witnesses ---------------------------------------------------------
+
+    def _detect_divergence(self, trace: list[LightBlock],
+                           now: Timestamp) -> None:
+        """detector.go: compare the newly-verified header against every
+        witness; a witness with a conflicting verified header means a
+        light-client attack."""
+        if not self.witnesses:
+            return
+        target = trace[-1]
+        for w in list(self.witnesses):
+            try:
+                other = w.light_block(target.height)
+            except ProviderError:
+                continue
+            if other.hash() != target.hash():
+                evidence = self._examine_divergence(w, trace, other, now)
+                raise ErrLightClientAttack(evidence)
+
+    def _examine_divergence(self, witness: Provider,
+                            trace: list[LightBlock],
+                            conflicting: LightBlock, now: Timestamp):
+        """Build LightClientAttackEvidence against whichever side
+        produced an invalid-but-verifiable header (detector.go
+        examineConflictingHeaderAgainstTrace, simplified: the witness's
+        block diverging from a verified trace is the evidence)."""
+        from ..types.evidence import LightClientAttackEvidence
+        common = trace[0]
+        return LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=common.height,
+            byzantine_validators=[],
+            total_voting_power=common.validator_set.total_voting_power(),
+            timestamp=common.signed_header.header.time)
+
+    # -- provider plumbing -------------------------------------------------
+
+    def _from_primary(self, height: int) -> LightBlock:
+        try:
+            return self.primary.light_block(height)
+        except ProviderError:
+            # primary failover: promote the first working witness
+            # (client.go:1045 findNewPrimary)
+            for i, w in enumerate(self.witnesses):
+                try:
+                    lb = w.light_block(height)
+                except ProviderError:
+                    continue
+                self.witnesses.pop(i)
+                self.witnesses.append(self.primary)
+                self.primary = w
+                return lb
+            raise

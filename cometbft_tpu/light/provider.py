@@ -1,0 +1,134 @@
+"""Light-block providers (light/provider analog).
+
+Provider is the seam the client fetches LightBlocks through
+(/root/reference/light/provider/provider.go:15-40). HttpProvider speaks
+the CometBFT JSON-RPC /commit + /validators endpoints of a full node, so
+this client can sync against real reference chains; tests use in-memory
+providers.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Protocol
+
+from .types import LightBlock
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ErrLightBlockNotFound(ProviderError):
+    pass
+
+
+class ErrNoResponse(ProviderError):
+    pass
+
+
+class ErrHeightTooHigh(ProviderError):
+    pass
+
+
+class ErrBadLightBlock(ProviderError):
+    pass
+
+
+class Provider(Protocol):
+    def light_block(self, height: int) -> LightBlock:
+        """Fetch the light block at height (0 = latest).
+
+        Raises ProviderError subclasses on failure."""
+        ...
+
+    def chain_id(self) -> str: ...
+
+
+class MemoryProvider:
+    """In-memory provider for tests and local verification."""
+
+    def __init__(self, chain_id: str,
+                 blocks: dict[int, LightBlock] | None = None):
+        self._chain_id = chain_id
+        self._blocks: dict[int, LightBlock] = dict(blocks or {})
+
+    def add(self, lb: LightBlock) -> None:
+        self._blocks[lb.height] = lb
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            if not self._blocks:
+                raise ErrLightBlockNotFound("no blocks")
+            height = max(self._blocks)
+        lb = self._blocks.get(height)
+        if lb is None:
+            if self._blocks and height > max(self._blocks):
+                raise ErrHeightTooHigh(str(height))
+            raise ErrLightBlockNotFound(str(height))
+        return lb
+
+
+class HttpProvider:
+    """JSON-RPC provider over a CometBFT full node's RPC
+    (light/provider/http/http.go analog: /commit + /validators with
+    pagination)."""
+
+    def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
+        self._chain_id = chain_id
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def _rpc(self, path: str, params: dict) -> dict:
+        qs = "&".join(f"{k}={v}" for k, v in params.items())
+        url = f"{self._base}/{path}?{qs}" if qs else f"{self._base}/{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=self._timeout) as resp:
+                body = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - network failures map to ErrNoResponse
+            raise ErrNoResponse(str(e)) from e
+        if "error" in body and body["error"]:
+            msg = str(body["error"])
+            if "height" in msg and "must be less" in msg:
+                raise ErrHeightTooHigh(msg)
+            raise ErrLightBlockNotFound(msg)
+        return body["result"]
+
+    def light_block(self, height: int) -> LightBlock:
+        from .rpc_decode import signed_header_from_rpc, validators_from_rpc
+
+        hparam = {} if height == 0 else {"height": height}
+        commit_res = self._rpc("commit", hparam)
+        sh = signed_header_from_rpc(commit_res["signed_header"])
+        # pin the validators query to the commit's height: with height=0
+        # ("latest") a new block could land between the two RPCs
+        vparam = {"height": sh.height}
+        vals = []
+        page, per_page = 1, 100
+        while True:
+            res = self._rpc("validators", {**vparam, "page": page,
+                                           "per_page": per_page})
+            batch = validators_from_rpc(res["validators"])
+            if not batch:
+                raise ErrBadLightBlock(
+                    f"validators page {page} empty with "
+                    f"{len(vals)}/{res['total']} fetched")
+            vals.extend(batch)
+            if len(vals) >= int(res["total"]):
+                break
+            page += 1
+        from ..types.validator_set import ValidatorSet
+        vs = ValidatorSet.from_validated(vals)
+        lb = LightBlock(sh, vs)
+        try:
+            lb.validate_basic(self._chain_id)
+        except ValueError as e:
+            raise ErrBadLightBlock(str(e)) from e
+        return lb

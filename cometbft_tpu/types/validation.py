@@ -55,7 +55,7 @@ def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
         return False
     if vals.all_keys_have_same_type():
         proposer = vals.get_proposer()
-        return proposer is not None and \
+        return proposer is not None and proposer.pub_key is not None and \
             crypto_batch.supports_batch_verifier(proposer.pub_key.type())
     # mixed keytypes: our device path handles them (reference refuses,
     # types/validation.go:18)
@@ -179,11 +179,12 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
                     f"double vote from {val.address.hex()} "
                     f"({seen[val_idx]} and {idx})")
             seen[val_idx] = idx
+        if val.pub_key is None:
+            raise CommitVerificationError(
+                f"validator {val.address.hex()} has nil pubkey at "
+                f"index {idx}")
         if not use_batch:
             cs.validate_basic()
-            if val.pub_key is None:
-                raise CommitVerificationError(
-                    f"validator {val.address.hex()} has nil pubkey")
         sign_bytes = commit.vote_sign_bytes(chain_id, idx)
         entries.append((idx, val, sign_bytes, cs.signature))
         if count(cs):

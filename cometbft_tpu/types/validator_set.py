@@ -113,6 +113,20 @@ class ValidatorSet:
             if validators:
                 self.increment_proposer_priority(1)
 
+    @staticmethod
+    def from_validated(validators: list[Validator],
+                       proposer: Validator | None = None) -> "ValidatorSet":
+        """Adopt an already-correct validator list verbatim (priorities
+        included) — for sets received from RPC/storage where re-running
+        the update rules would corrupt the priorities."""
+        out = ValidatorSet()
+        out.validators = list(validators)
+        if validators:
+            out._update_total_voting_power()
+            out.proposer = proposer if proposer is not None \
+                else out._find_proposer()
+        return out
+
     # -- basic accessors ---------------------------------------------------
 
     def is_nil_or_empty(self) -> bool:
@@ -171,7 +185,8 @@ class ValidatorSet:
         self._total_voting_power = total
 
     def all_keys_have_same_type(self) -> bool:
-        types = {v.pub_key.type() for v in self.validators}
+        types = {v.pub_key.type() if v.pub_key is not None else None
+                 for v in self.validators}
         return len(types) <= 1
 
     # -- proposer rotation -------------------------------------------------

@@ -109,7 +109,8 @@ class Vote:
     @staticmethod
     def from_proto(payload: bytes) -> "Vote":
         r = pw.Reader(payload)
-        v = Vote()
+        # proto3: omitted scalars are zero (not the dataclass default -1)
+        v = Vote(validator_index=0)
         while not r.at_end():
             f, w = r.read_tag()
             if f == 1:
@@ -182,7 +183,8 @@ class Proposal:
     @staticmethod
     def from_proto(payload: bytes) -> "Proposal":
         r = pw.Reader(payload)
-        p = Proposal()
+        # proto3: omitted scalars are zero (not the dataclass default -1)
+        p = Proposal(pol_round=0)
         while not r.at_end():
             f, w = r.read_tag()
             if f == 1:

@@ -1,0 +1,102 @@
+"""Test factories: deterministic chains of signed headers.
+
+The analog of the reference's internal/test block/commit factories
+(internal/test/block.go): builds a chain of LightBlocks with real
+Ed25519 signatures, evolving validator sets, and consistent hashes, for
+light-client / blocksync / consensus tests.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.light.types import LightBlock, SignedHeader
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT, BlockID, Commit, CommitSig, Consensus, Data,
+    Header, PartSetHeader,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+CHAIN_ID = "test-chain"
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+def gen_privkeys(n: int, salt: int = 0) -> list[ed25519.PrivKey]:
+    return [ed25519.PrivKey.generate(bytes([salt + i + 1]) * 32)
+            for i in range(n)]
+
+
+def valset_from_privs(privs, power: int = 10) -> ValidatorSet:
+    return ValidatorSet(
+        [Validator(p.pub_key(), power) for p in privs])
+
+
+class ChainBuilder:
+    """Grows a chain height by height, signing every commit for real."""
+
+    def __init__(self, privs=None, chain_id: str = CHAIN_ID,
+                 power: int = 10):
+        self.chain_id = chain_id
+        self.privs = privs if privs is not None else gen_privkeys(4)
+        self.by_addr = {p.pub_key().address(): p for p in self.privs}
+        self.valset = valset_from_privs(self.privs, power)
+        self.blocks: list[LightBlock] = []
+        self.last_block_id = BlockID()
+        self.last_commit: Commit | None = None
+
+    @property
+    def height(self) -> int:
+        return len(self.blocks)
+
+    def advance(self, next_privs=None, time_step_ns: int = 1_000_000_000
+                ) -> LightBlock:
+        """Produce the next signed block. next_privs changes the
+        validator set FOR THE BLOCK AFTER NEXT (next_validators_hash of
+        this block points at it, matching the one-height lag of
+        types.Header)."""
+        height = self.height + 1
+        next_valset = self.valset if next_privs is None else \
+            valset_from_privs(next_privs)
+        header = Header(
+            version=Consensus(11, 1),
+            chain_id=self.chain_id,
+            height=height,
+            time=GENESIS_TIME.add_ns(height * time_step_ns),
+            last_block_id=self.last_block_id,
+            last_commit_hash=(self.last_commit.hash() if self.last_commit
+                              else Commit().hash()),
+            data_hash=Data([]).hash(),
+            validators_hash=self.valset.hash(),
+            next_validators_hash=next_valset.hash(),
+            consensus_hash=b"\x01" * 32,
+            app_hash=height.to_bytes(32, "big"),
+            last_results_hash=b"\x02" * 32,
+            evidence_hash=Data([]).hash(),
+            proposer_address=self.valset.get_proposer().address,
+        )
+        block_id = BlockID(header.hash(), PartSetHeader(1, b"\x03" * 32))
+        commit = Commit(height=height, round=0, block_id=block_id,
+                        signatures=[])
+        for v in self.valset.validators:
+            ts = header.time
+            sb = canonical.vote_sign_bytes(self.chain_id, 2, height, 0,
+                                           block_id, ts)
+            commit.signatures.append(CommitSig(
+                BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                self.by_addr[v.address].sign(sb)))
+        lb = LightBlock(SignedHeader(header, commit), self.valset.copy())
+        self.blocks.append(lb)
+        self.last_block_id = block_id
+        self.last_commit = commit
+        if next_privs is not None:
+            self.privs = list(next_privs)
+            for p in self.privs:
+                self.by_addr.setdefault(p.pub_key().address(), p)
+            self.valset = next_valset
+        return lb
+
+    def build(self, n: int) -> list[LightBlock]:
+        for _ in range(n):
+            self.advance()
+        return self.blocks

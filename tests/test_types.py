@@ -316,3 +316,21 @@ def test_verify_commit_size_mismatch():
     commit.signatures.pop()
     with pytest.raises(validation.CommitVerificationError):
         validation.verify_commit(CHAIN_ID, vs, bid, 5, commit)
+
+
+def test_vote_proposal_proto_zero_defaults():
+    # proto3-omitted zeros must decode as 0, not the dataclass -1
+    from cometbft_tpu.types.vote import Proposal
+    v = Vote(type=2, height=1, round=0,
+             block_id=BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32)),
+             validator_address=b"\x01" * 20, validator_index=0,
+             signature=b"\x02" * 64)
+    rt = Vote.from_proto(v.to_proto())
+    assert rt.validator_index == 0 and rt.round == 0
+    p = Proposal(height=1, round=1, pol_round=0,
+                 block_id=BlockID(b"\xaa" * 32,
+                                  PartSetHeader(1, b"\xbb" * 32)),
+                 signature=b"\x03" * 64)
+    rt2 = Proposal.from_proto(p.to_proto())
+    assert rt2.pol_round == 0
+    assert rt2.sign_bytes(CHAIN_ID) == p.sign_bytes(CHAIN_ID)
