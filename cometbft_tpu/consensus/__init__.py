@@ -1,0 +1,7 @@
+"""Consensus engine: WAL, state machine, reactor
+(reference internal/consensus/)."""
+
+from .wal import (  # noqa: F401
+    WAL, WALMessage, EndHeightMessage, MsgInfo, TimeoutInfo,
+    EventRoundState, DataCorruptionError,
+)

@@ -104,6 +104,34 @@ class Proof:
                 f"invalid root hash: wanted {root_hash.hex()} "
                 f"got {computed.hex() if computed else None}")
 
+    def to_proto(self) -> bytes:
+        """Wire format of crypto.Proof (proto/cometbft/crypto/v1/proof.proto)."""
+        from ..libs import protowire as pw
+        w = (pw.Writer().int_field(1, self.total).int_field(2, self.index)
+             .bytes_field(3, self.leaf_hash))
+        for aunt in self.aunts:
+            w.bytes_field(4, aunt)
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "Proof":
+        from ..libs import protowire as pw
+        r = pw.Reader(payload)
+        total, index, leaf, aunts = 0, 0, b"", []
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                total = r.read_int()
+            elif f == 2 and w == pw.VARINT:
+                index = r.read_int()
+            elif f == 3 and w == pw.BYTES:
+                leaf = r.read_bytes()
+            elif f == 4 and w == pw.BYTES:
+                aunts.append(r.read_bytes())
+            else:
+                r.skip(w)
+        return Proof(total=total, index=index, leaf_hash=leaf, aunts=aunts)
+
 
 def _compute_hash_from_aunts(index: int, total: int, leaf: bytes,
                              aunts: list[bytes]) -> bytes | None:

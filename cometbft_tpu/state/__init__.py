@@ -1,0 +1,5 @@
+"""Execution state: sm.State value, persistent store, block executor
+(reference state/ package)."""
+
+from .state import State, make_genesis_state  # noqa: F401
+from .store import StateStore  # noqa: F401

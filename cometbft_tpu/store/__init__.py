@@ -1,0 +1,5 @@
+"""Persistence layer: ordered KV backends + BlockStore
+(reference store/ + cometbft-db)."""
+
+from .kv import KVStore, MemDB, SQLiteDB, open_db  # noqa: F401
+from .blockstore import BlockMeta, BlockStore  # noqa: F401

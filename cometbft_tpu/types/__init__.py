@@ -7,3 +7,6 @@ from .block import (  # noqa: F401
 )
 from .vote import Vote, PRECOMMIT_TYPE, PREVOTE_TYPE, PROPOSAL_TYPE  # noqa: F401
 from .validator_set import Validator, ValidatorSet  # noqa: F401
+from .part_set import Part, PartSet, BLOCK_PART_SIZE  # noqa: F401
+from .params import ConsensusParams  # noqa: F401
+from .genesis import GenesisDoc, GenesisValidator  # noqa: F401

@@ -1,0 +1,371 @@
+"""Storage layer tests: PartSet, KV backends, BlockStore, StateStore,
+WAL (reference store/store_test.go, state/store_test.go, wal_test.go)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from cometbft_tpu.consensus.wal import (
+    WAL, DataCorruptionError, EndHeightMessage, EventRoundState, MsgInfo,
+    TimeoutInfo, decode_records)
+from cometbft_tpu.state import State, StateStore, make_genesis_state
+from cometbft_tpu.store import BlockStore, MemDB, SQLiteDB
+from cometbft_tpu.types.block import Block, Commit, Data
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.params import ConsensusParams, FeatureParams
+from cometbft_tpu.types.part_set import Part, PartSet, PartSetError
+from cometbft_tpu.types.timestamp import Timestamp
+
+from helpers import ChainBuilder, gen_privkeys
+
+
+# -- PartSet ----------------------------------------------------------------
+
+def test_part_set_roundtrip():
+    data = os.urandom(200_000)  # 4 parts at 64 KiB
+    ps = PartSet.from_data(data)
+    assert ps.header.total == 4
+    assert ps.is_complete()
+    assert ps.assemble() == data
+
+    # rebuild from gossiped parts, shuffled order
+    ps2 = PartSet.new_from_header(ps.header)
+    for i in (2, 0, 3, 1):
+        part = Part.from_proto(ps.get_part(i).to_proto())
+        assert ps2.add_part(part)
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+    # duplicate add is a no-op
+    assert not ps2.add_part(ps.get_part(0))
+
+
+def test_part_set_rejects_tampered_part():
+    ps = PartSet.from_data(os.urandom(100_000))
+    ps2 = PartSet.new_from_header(ps.header)
+    bad = Part(index=0, bytes_=b"evil" * 100, proof=ps.get_part(0).proof)
+    with pytest.raises(PartSetError):
+        ps2.add_part(bad)
+
+
+def test_single_small_part():
+    ps = PartSet.from_data(b"tiny block")
+    assert ps.header.total == 1
+    ps2 = PartSet.new_from_header(ps.header)
+    assert ps2.add_part(ps.get_part(0))
+    assert ps2.assemble() == b"tiny block"
+
+
+# -- params / genesis -------------------------------------------------------
+
+def test_consensus_params_proto_roundtrip():
+    p = ConsensusParams()
+    p.block.max_bytes = 2 * 1024 * 1024
+    p.feature = FeatureParams(vote_extensions_enable_height=10,
+                              pbts_enable_height=5)
+    q = ConsensusParams.from_proto(p.to_proto())
+    assert q.block.max_bytes == 2 * 1024 * 1024
+    assert q.feature.vote_extensions_enable_height == 10
+    assert q.vote_extensions_enabled(10)
+    assert not q.vote_extensions_enabled(9)
+    assert q.pbts_enabled(7)
+    assert p.hash() == q.hash()
+    p.validate()
+
+
+def test_genesis_roundtrip(tmp_path):
+    privs = gen_privkeys(3)
+    doc = GenesisDoc(
+        chain_id="test-chain",
+        genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[GenesisValidator(p.pub_key(), power=10 + i)
+                    for i, p in enumerate(privs)],
+        app_state={"accounts": [1, 2, 3]},
+    )
+    doc.validate_and_complete()
+    path = str(tmp_path / "genesis.json")
+    doc.save_as(path)
+    doc2 = GenesisDoc.from_file(path)
+    assert doc2.chain_id == doc.chain_id
+    assert doc2.initial_height == 1
+    assert len(doc2.validators) == 3
+    assert doc2.validators[0].pub_key.bytes() == privs[0].pub_key().bytes()
+    assert doc2.app_state == {"accounts": [1, 2, 3]}
+    assert doc.hash() == doc2.hash()
+    assert doc.validator_hash() == doc2.validator_hash()
+
+
+def test_genesis_rejects_zero_power():
+    privs = gen_privkeys(1)
+    doc = GenesisDoc(chain_id="c",
+                     validators=[GenesisValidator(privs[0].pub_key(), 0)])
+    with pytest.raises(ValueError):
+        doc.validate_and_complete()
+
+
+# -- KV ---------------------------------------------------------------------
+
+@pytest.fixture(params=["mem", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "mem":
+        yield MemDB()
+    else:
+        d = SQLiteDB(str(tmp_path / "kv.db"))
+        yield d
+        d.close()
+
+
+def test_kv_ordered_iteration(db):
+    for i in (3, 1, 4, 1, 5, 9, 2, 6):
+        db.set(bytes([i]), bytes([i * 2]))
+    keys = [k for k, _ in db.iterate()]
+    assert keys == sorted(set(keys))
+    # range [2, 6)
+    keys = [k[0] for k, _ in db.iterate(b"\x02", b"\x06")]
+    assert keys == [2, 3, 4, 5]
+    # reverse
+    keys = [k[0] for k, _ in db.iterate(b"\x02", b"\x06", reverse=True)]
+    assert keys == [5, 4, 3, 2]
+    db.delete(b"\x03")
+    assert db.get(b"\x03") is None
+    db.write_batch([(b"a", b"1"), (b"b", b"2")], [b"\x01"])
+    assert db.get(b"a") == b"1" and db.get(b"\x01") is None
+
+
+# -- BlockStore -------------------------------------------------------------
+
+def _block_from_light(lb, last_commit) -> Block:
+    return Block(header=lb.signed_header.header, data=Data([b"tx-1", b"tx-2"]),
+                 last_commit=last_commit)
+
+
+def test_block_store_save_load(db):
+    bs = BlockStore(db)
+    assert bs.height() == 0 and bs.base() == 0
+
+    chain = ChainBuilder()
+    chain.build(3)
+    last_commit = Commit()
+    for lb in chain.blocks:
+        block = _block_from_light(lb, last_commit)
+        parts = PartSet.from_data(block.to_proto())
+        bs.save_block(block, parts, lb.signed_header.commit)
+        last_commit = lb.signed_header.commit
+
+    assert bs.height() == 3 and bs.base() == 1 and bs.size() == 3
+
+    b2 = bs.load_block(2)
+    assert b2 is not None
+    assert b2.header.hash() == chain.blocks[1].signed_header.header.hash()
+    assert b2.data.txs == [b"tx-1", b"tx-2"]
+
+    meta = bs.load_block_meta(2)
+    assert meta.header.height == 2
+    assert meta.num_txs == 2
+    assert bs.load_block_meta_by_hash(b2.header.hash()).header.height == 2
+    assert bs.load_block_by_hash(b2.header.hash()).header.height == 2
+
+    # commit FOR height 2 came from block 3's last_commit
+    c2 = bs.load_block_commit(2)
+    assert c2.height == 2
+    sc3 = bs.load_seen_commit(3)
+    assert sc3.height == 3
+
+    part = bs.load_block_part(2, 0)
+    assert part is not None and part.index == 0
+
+    # reload extent from a fresh store over the same db
+    bs2 = BlockStore(db)
+    assert bs2.height() == 3 and bs2.base() == 1
+
+
+def test_block_store_contiguity(db):
+    bs = BlockStore(db)
+    chain = ChainBuilder()
+    chain.build(3)
+    b1 = _block_from_light(chain.blocks[0], Commit())
+    bs.save_block(b1, PartSet.from_data(b1.to_proto()),
+                  chain.blocks[0].signed_header.commit)
+    b3 = _block_from_light(chain.blocks[2],
+                           chain.blocks[1].signed_header.commit)
+    with pytest.raises(ValueError, match="contiguous"):
+        bs.save_block(b3, PartSet.from_data(b3.to_proto()),
+                      chain.blocks[2].signed_header.commit)
+
+
+def test_block_store_prune(db):
+    bs = BlockStore(db)
+    chain = ChainBuilder()
+    chain.build(5)
+    last_commit = Commit()
+    for lb in chain.blocks:
+        block = _block_from_light(lb, last_commit)
+        bs.save_block(block, PartSet.from_data(block.to_proto()),
+                      lb.signed_header.commit)
+        last_commit = lb.signed_header.commit
+
+    pruned = bs.prune_blocks(4)
+    assert pruned == 3
+    assert bs.base() == 4 and bs.height() == 5
+    assert bs.load_block(2) is None
+    assert bs.load_block(4) is not None
+    # commit for retain_height-1 kept (needed to verify block 4)
+    assert bs.load_block_commit(3) is not None
+    assert bs.load_block_commit(2) is None
+    assert bs.prune_blocks(4) == 0
+    with pytest.raises(ValueError):
+        bs.prune_blocks(100)
+
+
+# -- StateStore -------------------------------------------------------------
+
+def _genesis_doc(privs):
+    return GenesisDoc(
+        chain_id="test-chain", genesis_time=Timestamp(1_700_000_000, 0),
+        validators=[GenesisValidator(p.pub_key(), 10) for p in privs])
+
+
+def test_state_store_roundtrip(db):
+    privs = gen_privkeys(4)
+    st = make_genesis_state(_genesis_doc(privs))
+    ss = StateStore(db)
+    ss.save(st)
+
+    loaded = ss.load()
+    assert loaded.chain_id == "test-chain"
+    assert loaded.initial_height == 1
+    assert loaded.validators.hash() == st.validators.hash()
+    assert loaded.next_validators.hash() == st.next_validators.hash()
+    assert loaded.consensus_params.hash() == st.consensus_params.hash()
+
+    # validators at initial height and height 2 (next)
+    v1 = ss.load_validators(1)
+    assert v1.hash() == st.validators.hash()
+    v2 = ss.load_validators(2)
+    assert v2.hash() == st.next_validators.hash()
+
+    p1 = ss.load_consensus_params(1)
+    assert p1.hash() == st.consensus_params.hash()
+
+
+def test_state_store_pointer_chase(db):
+    """Validator sets unchanged for many heights -> stubs chase back to
+    the stored epoch; priorities catch up (store.go:860-868)."""
+    privs = gen_privkeys(4)
+    st = make_genesis_state(_genesis_doc(privs))
+    ss = StateStore(db)
+    ss.save(st)
+
+    # simulate 5 heights with an unchanged validator set
+    for h in range(1, 6):
+        st = st.copy()
+        st.last_block_height = h
+        st.last_validators = st.validators
+        st.validators = st.next_validators
+        nxt = st.next_validators.copy()
+        nxt.increment_proposer_priority(1)
+        st.next_validators = nxt
+        ss.save(st)
+
+    v7 = ss.load_validators(7)
+    assert {v.address for v in v7.validators} == \
+        {p.pub_key().address() for p in privs}
+
+    resp = b"finalize-block-response-bytes"
+    ss.save_finalize_block_response(3, resp)
+    assert ss.load_finalize_block_response(3) == resp
+
+    pruned = ss.prune_states(5)
+    assert pruned > 0
+    v5 = ss.load_validators(5)
+    assert v5 is not None
+    # stubs >= retain_height still point at the (kept) epoch entry below
+    # retain — the full set at the genesis height must survive the prune
+    v7b = ss.load_validators(7)
+    assert v7b.hash() == v7.hash()
+    assert ss.load_consensus_params(6) is not None
+    with pytest.raises(KeyError):
+        ss.load_validators(2)
+    assert ss.load_finalize_block_response(3) is None
+
+
+def test_state_proto_roundtrip():
+    privs = gen_privkeys(3)
+    st = make_genesis_state(_genesis_doc(privs))
+    st.last_block_height = 42
+    st.app_hash = b"\xaa" * 32
+    st2 = State.from_proto(st.to_proto())
+    assert st2.chain_id == st.chain_id
+    assert st2.last_block_height == 42
+    assert st2.app_hash == st.app_hash
+    assert st2.validators.hash() == st.validators.hash()
+    assert st2.version.consensus.block == st.version.consensus.block
+
+
+# -- WAL --------------------------------------------------------------------
+
+def test_wal_write_replay(tmp_path):
+    path = str(tmp_path / "wal" / "wal")
+    wal = WAL(path)
+    wal.write(EventRoundState(1, 0, "RoundStepNewHeight"))
+    wal.write_sync(MsgInfo("peer-1", b"\x01\x02\x03"))
+    wal.write(TimeoutInfo(3_000_000_000, 1, 0, 1))
+    wal.write_sync(EndHeightMessage(1))
+    wal.write(MsgInfo("", b"\x09" * 10))
+    wal.close()
+
+    wal2 = WAL(path)
+    msgs = wal2.replay()
+    assert len(msgs) == 5
+    assert isinstance(msgs[0].msg, EventRoundState)
+    assert msgs[1].msg.peer_id == "peer-1"
+    assert msgs[2].msg.duration_ns == 3_000_000_000
+    assert msgs[3].msg.height == 1
+    assert msgs[4].msg.msg_bytes == b"\x09" * 10
+
+    found, after = wal2.search_for_end_height(1)
+    assert found and len(after) == 1
+    found, after = wal2.search_for_end_height(7)
+    assert not found
+    wal2.close()
+
+
+def test_wal_torn_tail_tolerated(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(9))
+    wal.close()
+    # append garbage that looks like a truncated record
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\x01\x00\x00")
+    wal2 = WAL(path)
+    msgs = wal2.replay()
+    assert len(msgs) == 1 and msgs[0].msg.height == 9
+    wal2.close()
+
+
+def test_wal_mid_corruption_detected(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(1))
+    wal.write_sync(EndHeightMessage(2))
+    wal.close()
+    data = bytearray(open(path, "rb").read())
+    data[10] ^= 0xFF  # flip a byte inside the first record's payload
+    with pytest.raises(DataCorruptionError):
+        list(decode_records(bytes(data)))
+
+
+def test_wal_rotation(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=256)
+    for i in range(50):
+        wal.write(MsgInfo("", bytes([i]) * 32))
+        wal.maybe_rotate()
+    wal.flush_and_sync()
+    assert wal._group.max_index() > 0  # rolled at least once
+    msgs = wal.replay()
+    assert len(msgs) == 50
+    assert [m.msg.msg_bytes[0] for m in msgs] == list(range(50))
+    wal.close()
