@@ -1,0 +1,10 @@
+"""ABCI: the application bridge (reference abci/).
+
+The consensus engine is application-agnostic: the replicated state
+machine lives behind the 15-method Application interface, reachable
+in-process, over a unix/tcp socket (length-delimited proto), or gRPC.
+"""
+
+from .application import Application, BaseApplication  # noqa: F401
+from .client import ABCIClient, LocalClient, SocketClient  # noqa: F401
+from .server import SocketServer  # noqa: F401

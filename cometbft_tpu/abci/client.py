@@ -1,0 +1,263 @@
+"""ABCI clients (reference abci/client/).
+
+- LocalClient: in-process calls with a mutex, the common production
+  config for Python apps (abci/client/local_client.go analog).
+- SocketClient: async-pipelined requests over a unix/tcp socket with
+  length-delimited proto framing — requests are written by the caller
+  thread, responses matched FIFO by a reader thread, mirroring
+  socket_client.go:129-193's sendRequestsRoutine/recvResponseRoutine.
+
+Both expose the same blocking call surface plus *_async returning a
+ReqRes future; consensus uses the sync calls, the mempool uses async
+CheckTx with callbacks.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections import deque
+
+from ..libs import protowire as pw
+from . import types as at
+from .application import Application
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class ReqRes:
+    """A pending request's future (abci/client/client.go ReqRes)."""
+
+    def __init__(self, method: str, req):
+        self.method = method
+        self.request = req
+        self.response = None
+        self._done = threading.Event()
+        self._cb = None
+        self._lock = threading.Lock()
+
+    def set_callback(self, cb) -> None:
+        """cb(response); fires immediately if already done."""
+        with self._lock:
+            if self.response is not None:
+                cb(self.response)
+            else:
+                self._cb = cb
+
+    def complete(self, response) -> None:
+        with self._lock:
+            self.response = response
+            cb = self._cb
+        self._done.set()
+        if cb is not None:
+            cb(response)
+
+    def wait(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise ABCIClientError(
+                f"ABCI {self.method} timed out after {timeout}s")
+        resp = self.response
+        if isinstance(resp, at.ExceptionResponse):
+            raise ABCIClientError(f"ABCI {self.method}: {resp.error}")
+        return resp
+
+
+class ABCIClient:
+    """Blocking call surface; subclasses implement _do(method, req)."""
+
+    def _do(self, method: str, req):
+        raise NotImplementedError
+
+    def _do_async(self, method: str, req) -> ReqRes:
+        rr = ReqRes(method, req)
+        rr.complete(self._do(method, req))
+        return rr
+
+    # -- sync surface ------------------------------------------------------
+    def echo(self, message: str) -> at.EchoResponse:
+        return self._do("echo", at.EchoRequest(message=message))
+
+    def flush(self) -> None:
+        self._do("flush", at.FlushRequest())
+
+    def info(self, req=None) -> at.InfoResponse:
+        return self._do("info", req or at.InfoRequest())
+
+    def query(self, req) -> at.QueryResponse:
+        return self._do("query", req)
+
+    def check_tx(self, req) -> at.CheckTxResponse:
+        return self._do("check_tx", req)
+
+    def check_tx_async(self, req) -> ReqRes:
+        return self._do_async("check_tx", req)
+
+    def init_chain(self, req) -> at.InitChainResponse:
+        return self._do("init_chain", req)
+
+    def prepare_proposal(self, req) -> at.PrepareProposalResponse:
+        return self._do("prepare_proposal", req)
+
+    def process_proposal(self, req) -> at.ProcessProposalResponse:
+        return self._do("process_proposal", req)
+
+    def finalize_block(self, req) -> at.FinalizeBlockResponse:
+        return self._do("finalize_block", req)
+
+    def extend_vote(self, req) -> at.ExtendVoteResponse:
+        return self._do("extend_vote", req)
+
+    def verify_vote_extension(self, req) -> at.VerifyVoteExtensionResponse:
+        return self._do("verify_vote_extension", req)
+
+    def commit(self) -> at.CommitResponse:
+        return self._do("commit", at.CommitRequest())
+
+    def list_snapshots(self, req) -> at.ListSnapshotsResponse:
+        return self._do("list_snapshots", req)
+
+    def offer_snapshot(self, req) -> at.OfferSnapshotResponse:
+        return self._do("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req) -> at.LoadSnapshotChunkResponse:
+        return self._do("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req) -> at.ApplySnapshotChunkResponse:
+        return self._do("apply_snapshot_chunk", req)
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class LocalClient(ABCIClient):
+    """In-proc client; one mutex serializes app access
+    (local_client.go). Pass shared_lock to mimic the reference's
+    one-mutex-across-all-connections default."""
+
+    def __init__(self, app: Application,
+                 shared_lock: threading.Lock | None = None):
+        self._app = app
+        self._lock = shared_lock or threading.Lock()
+
+    def _do(self, method: str, req):
+        if method == "echo":
+            return at.EchoResponse(message=req.message)
+        if method == "flush":
+            return at.FlushResponse()
+        with self._lock:
+            return getattr(self._app, method)(req)
+
+
+class SocketClient(ABCIClient):
+    """Pipelined socket client.
+
+    Caller threads append (ReqRes) to the in-flight queue and write the
+    frame; the reader thread pops FIFO as responses arrive. flush()
+    forces the server to drain its buffer (socket servers may batch)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._pending: deque[ReqRes] = deque()
+        self._plock = threading.Lock()
+        self._reader: threading.Thread | None = None
+        self._err: Exception | None = None
+        self._stopped = False
+
+    # -- connection --------------------------------------------------------
+
+    def start(self) -> None:
+        self._sock = _dial(self._addr)
+        self._reader = threading.Thread(
+            target=self._recv_routine, name="abci-socket-recv", daemon=True)
+        self._reader.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send(self, method: str, req) -> ReqRes:
+        if self._err is not None:
+            raise ABCIClientError(f"socket client dead: {self._err}")
+        rr = ReqRes(method, req)
+        frame = pw.marshal_delimited(at.wrap_request(req))
+        with self._wlock:
+            # queue entry must exist before the server can respond
+            with self._plock:
+                self._pending.append(rr)
+            try:
+                self._sock.sendall(frame)
+            except OSError as e:
+                with self._plock:
+                    self._pending.remove(rr)
+                self._err = e
+                raise ABCIClientError(f"socket write: {e}") from e
+        return rr
+
+    def _recv_routine(self) -> None:
+        buf = b""
+        try:
+            while not self._stopped:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("server closed connection")
+                buf += chunk
+                while True:
+                    # ValueError here = corrupt stream -> tear down (the
+                    # except below fails all pending callers); None = wait
+                    frame = pw.try_unmarshal_delimited(buf)
+                    if frame is None:
+                        break
+                    payload, pos = frame
+                    buf = buf[pos:]
+                    method, resp = at.unwrap_response(payload)
+                    with self._plock:
+                        if not self._pending:
+                            raise ConnectionError(
+                                f"unexpected {method} response")
+                        rr = self._pending.popleft()
+                    if (method != rr.method
+                            and not isinstance(resp, at.ExceptionResponse)):
+                        raise ConnectionError(
+                            f"response {method} != request {rr.method}")
+                    rr.complete(resp)
+        except Exception as e:  # noqa: BLE001 - fail all pending callers
+            self._err = e
+            with self._plock:
+                pending, self._pending = list(self._pending), deque()
+            for rr in pending:
+                rr.complete(at.ExceptionResponse(error=str(e)))
+
+    def _do_async(self, method: str, req) -> ReqRes:
+        return self._send(method, req)
+
+    def _do(self, method: str, req):
+        return self._send(method, req).wait(self._timeout)
+
+
+def _dial(addr: str) -> socket.socket:
+    """tcp://host:port, unix://path, or bare host:port."""
+    if addr.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(addr[len("unix://"):])
+        return s
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    host, _, port = addr.rpartition(":")
+    s = socket.create_connection((host or "127.0.0.1", int(port)))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s

@@ -1,0 +1,112 @@
+"""ABCI socket server for out-of-process applications
+(reference abci/server/socket_server.go).
+
+One handler thread per connection reads length-delimited Requests,
+dispatches to the Application, and writes Responses in request order —
+the app mutex serializes across connections like the reference's
+server-side lock.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from ..libs import protowire as pw
+from . import types as at
+from .application import Application
+
+
+class SocketServer:
+    def __init__(self, addr: str, app: Application):
+        self.addr = addr
+        self._app = app
+        self._app_lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stopped = False
+
+    def start(self) -> None:
+        self._listener = _listen(self.addr)
+        t = threading.Thread(target=self._accept_routine,
+                             name="abci-server-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._listener is not None:
+            self._listener.close()
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_routine(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+                if conn.family != socket.AF_UNIX else None
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 name="abci-server-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while not self._stopped:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while True:
+                    # ValueError = corrupt stream: drop the connection
+                    frame = pw.try_unmarshal_delimited(buf)
+                    if frame is None:
+                        break
+                    payload, pos = frame
+                    buf = buf[pos:]
+                    resp = self._dispatch(payload)
+                    conn.sendall(pw.marshal_delimited(at.wrap_response(resp)))
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, payload: bytes):
+        try:
+            method, req = at.unwrap_request(payload)
+        except ValueError as e:
+            return at.ExceptionResponse(error=str(e))
+        if method == "echo":
+            return at.EchoResponse(message=req.message)
+        if method == "flush":
+            return at.FlushResponse()
+        try:
+            with self._app_lock:
+                return getattr(self._app, method)(req)
+        except Exception as e:  # noqa: BLE001 - app errors cross the wire
+            return at.ExceptionResponse(error=f"{type(e).__name__}: {e}")
+
+
+def _listen(addr: str) -> socket.socket:
+    if addr.startswith("unix://"):
+        path = addr[len("unix://"):]
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(path)
+    else:
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        host, _, port = addr.rpartition(":")
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host or "127.0.0.1", int(port)))
+    s.listen(16)
+    return s

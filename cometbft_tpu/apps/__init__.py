@@ -1,0 +1,3 @@
+"""Example ABCI applications (reference abci/example/)."""
+
+from .kvstore import KVStoreApplication  # noqa: F401

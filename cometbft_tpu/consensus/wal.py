@@ -216,11 +216,52 @@ def decode_records(buf: bytes, tolerate_torn_tail: bool = True):
         pos += 8 + length
 
 
+def _valid_prefix_len(buf: bytes) -> int:
+    """Byte length of the longest prefix of whole, CRC-valid records.
+
+    Used to repair the head file after a crash: anything past this point
+    is a torn or corrupt tail that must be truncated BEFORE appending,
+    or every later replay would hit DataCorruptionError mid-log."""
+    pos = 0
+    n = len(buf)
+    while pos + 8 <= n:
+        crc, length = struct.unpack_from(">II", buf, pos)
+        if length > MAX_MSG_SIZE or pos + 8 + length > n:
+            break
+        payload = buf[pos + 8:pos + 8 + length]
+        if crc32c(payload) != crc:
+            break
+        try:
+            TimedWALMessage.from_proto(payload)
+        except Exception:  # noqa: BLE001 - undecodable = corrupt tail
+            break
+        pos += 8 + length
+    return pos
+
+
 class WAL:
-    """BaseWAL analog over an autofile Group."""
+    """BaseWAL analog over an autofile Group.
+
+    On open, the head chunk is scanned and any torn/corrupt tail from a
+    crash mid-write is truncated so new records append after the last
+    whole record (rolled chunks were fsync'd at rotation and need no
+    repair)."""
 
     def __init__(self, head_path: str, **group_kwargs):
+        self._repair_head(head_path)
         self._group = Group(head_path, **group_kwargs)
+
+    @staticmethod
+    def _repair_head(head_path: str) -> None:
+        try:
+            with open(head_path, "rb") as f:
+                buf = f.read()
+        except FileNotFoundError:
+            return
+        good = _valid_prefix_len(buf)
+        if good < len(buf):
+            with open(head_path, "r+b") as f:
+                f.truncate(good)
 
     def write(self, msg) -> None:
         """Buffered write (wal.go Write: internal msgs use WriteSync)."""
@@ -244,12 +285,25 @@ class WAL:
     def search_for_end_height(self, height: int):
         """Messages recorded AFTER EndHeight(height) — i.e. the partial
         progress of height+1 to replay (wal.go SearchForEndHeight).
-        Returns (found, msgs)."""
-        msgs = list(decode_records(self._group.read_all()))
-        for i in range(len(msgs) - 1, -1, -1):
-            m = msgs[i].msg
-            if isinstance(m, EndHeightMessage) and m.height == height:
-                return True, msgs[i + 1:]
+        Returns (found, msgs).
+
+        Scans chunk files newest->oldest so a full multi-GiB group never
+        has to be decoded: the marker is almost always near the tail."""
+        self._group.flush()
+        paths = self._group.chunk_paths()
+        tail_msgs: list[TimedWALMessage] = []
+        for p in reversed(paths):
+            try:
+                with open(p, "rb") as f:
+                    buf = f.read()
+            except FileNotFoundError:
+                continue
+            msgs = list(decode_records(buf))
+            for i in range(len(msgs) - 1, -1, -1):
+                m = msgs[i].msg
+                if isinstance(m, EndHeightMessage) and m.height == height:
+                    return True, msgs[i + 1:] + tail_msgs
+            tail_msgs = msgs + tail_msgs
         return False, []
 
     def close(self) -> None:

@@ -217,6 +217,25 @@ def unmarshal_delimited(buf: bytes, pos: int = 0) -> tuple[bytes, int]:
     return buf[pos:pos + n], pos + n
 
 
+def try_unmarshal_delimited(buf: bytes, pos: int = 0,
+                            max_frame: int = 256 * 1024 * 1024):
+    """Streaming-friendly framing: returns (payload, end_pos) for a whole
+    frame, None when more bytes are needed, and raises ValueError for a
+    genuinely corrupt stream (invalid/oversized length varint) — the
+    distinction socket read loops need to tell 'wait' from 'tear down'."""
+    try:
+        n, body = decode_uvarint(buf, pos)
+    except ValueError as e:
+        if "truncated" in str(e) and len(buf) - pos < 10:
+            return None  # varint may still be arriving
+        raise
+    if n > max_frame:
+        raise ValueError(f"frame length {n} exceeds cap {max_frame}")
+    if body + n > len(buf):
+        return None
+    return buf[body:body + n], body + n
+
+
 # -- google.protobuf.Timestamp ----------------------------------------------
 
 def encode_timestamp(seconds: int, nanos: int) -> bytes:

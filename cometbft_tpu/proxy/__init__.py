@@ -1,0 +1,4 @@
+"""AppConns: the 4 logical ABCI connections (reference proxy/)."""
+
+from .multi_app_conn import AppConns, ClientCreator, local_client_creator, \
+    socket_client_creator, default_client_creator  # noqa: F401

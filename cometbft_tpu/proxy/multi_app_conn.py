@@ -1,0 +1,73 @@
+"""The AppConns multiplexer (reference proxy/multi_app_conn.go:42-54).
+
+One application, four independent logical connections so slow queries
+never block consensus:
+
+  consensus - InitChain, PrepareProposal, ProcessProposal,
+              FinalizeBlock, ExtendVote, VerifyVoteExtension, Commit
+  mempool   - CheckTx
+  query     - Info, Query
+  snapshot  - ListSnapshots, OfferSnapshot, Load/ApplySnapshotChunk
+
+For a local app all four share one mutex (the app is one object); for a
+socket app they are four pipelined connections to the app process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..abci.application import Application
+from ..abci.client import ABCIClient, LocalClient, SocketClient
+
+ClientCreator = Callable[[], ABCIClient]
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    """All connections share one mutex (proxy/client.go NewLocalClientCreator)."""
+    lock = threading.Lock()
+    return lambda: LocalClient(app, shared_lock=lock)
+
+
+def unsync_local_client_creator(app: Application) -> ClientCreator:
+    """Per-connection mutex — for apps that handle their own locking
+    (proxy/client.go NewUnsyncLocalClientCreator)."""
+    return lambda: LocalClient(app)
+
+
+def socket_client_creator(addr: str) -> ClientCreator:
+    return lambda: SocketClient(addr)
+
+
+def default_client_creator(addr: str, app: Application | None = None
+                           ) -> ClientCreator:
+    """Address dispatch (proxy/client.go:265 DefaultClientCreator):
+    'kvstore' -> in-proc example app; 'local' -> provided app;
+    otherwise a socket address."""
+    if addr == "kvstore":
+        from ..apps.kvstore import KVStoreApplication
+        return local_client_creator(KVStoreApplication())
+    if addr == "local":
+        if app is None:
+            raise ValueError("local client creator requires an app")
+        return local_client_creator(app)
+    return socket_client_creator(addr)
+
+
+class AppConns:
+    """proxy.AppConns: start/stop the 4 clients as one service."""
+
+    def __init__(self, creator: ClientCreator):
+        self.consensus = creator()
+        self.mempool = creator()
+        self.query = creator()
+        self.snapshot = creator()
+
+    def start(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            c.start()
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            c.stop()

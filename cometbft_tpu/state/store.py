@@ -198,11 +198,14 @@ class StateStore:
             # Stubs at height >= retain with lhc < retain all share the
             # same lhc (the set/params last changed there), so inspecting
             # the entry AT retain_height finds every live pointer target.
+            # The lhc entry is kept even when retain_height itself is a
+            # full checkpoint: loads above retain chase to lhc, not to the
+            # checkpoint (reference keepVals[valInfo.LastHeightChanged]).
             for k_of in (_k_vals, _k_params):
                 raw = self._db.get(k_of(retain_height))
                 if raw is not None:
-                    lhc, payload = _info_parse(raw)
-                    if payload is None and lhc < retain_height:
+                    lhc, _payload = _info_parse(raw)
+                    if lhc < retain_height:
                         keep.add(k_of(lhc))
             deletes: list[bytes] = []
             for prefix_key in (_k_vals, _k_params, _k_fbresp):

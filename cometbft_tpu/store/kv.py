@@ -115,7 +115,11 @@ class SQLiteDB(KVStore):
         self._lock = threading.RLock()
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.execute("PRAGMA synchronous=NORMAL")
+            # FULL: every COMMIT fsyncs the sqlite WAL — the durability the
+            # block/state stores assume (reference db.Batch.WriteSync);
+            # NORMAL would defer fsync to checkpoints and could lose
+            # acknowledged blocks on power failure.
+            self._conn.execute("PRAGMA synchronous=FULL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv "
                 "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")

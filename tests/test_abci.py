@@ -1,0 +1,239 @@
+"""ABCI layer tests: wire roundtrips, local + socket clients, AppConns,
+kvstore example app (reference abci/tests, proxy tests)."""
+
+from __future__ import annotations
+
+import base64
+import threading
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.client import LocalClient, SocketClient
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.apps.kvstore import (CODE_INVALID_TX_FORMAT,
+                                       KVStoreApplication)
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.types.timestamp import Timestamp
+
+
+# -- wire roundtrips --------------------------------------------------------
+
+def test_request_response_oneof_roundtrip():
+    req = at.FinalizeBlockRequest(
+        txs=[b"a=1", b"b=2"],
+        decided_last_commit=at.CommitInfo(round=2, votes=[
+            at.VoteInfo(at.Validator(b"\x01" * 20, 10), 2)]),
+        misbehavior=[at.Misbehavior(
+            type=at.MISBEHAVIOR_DUPLICATE_VOTE,
+            validator=at.Validator(b"\x02" * 20, 5), height=7,
+            time=Timestamp(100, 5), total_voting_power=30)],
+        hash=b"\xaa" * 32, height=8, time=Timestamp(200, 0),
+        next_validators_hash=b"\xbb" * 32, proposer_address=b"\x03" * 20,
+        syncing_to_height=8)
+    name, back = at.unwrap_request(at.wrap_request(req))
+    assert name == "finalize_block"
+    assert back.txs == [b"a=1", b"b=2"]
+    assert back.decided_last_commit.votes[0].validator.power == 10
+    assert back.misbehavior[0].height == 7
+    assert back.syncing_to_height == 8
+
+    resp = at.FinalizeBlockResponse(
+        tx_results=[at.ExecTxResult(code=0, gas_used=3, events=[
+            at.Event("app", [at.EventAttribute("k", "v", True)])])],
+        validator_updates=[at.ValidatorUpdate(
+            power=9, pub_key_bytes=b"\x04" * 32, pub_key_type="ed25519")],
+        app_hash=b"\x05" * 8)
+    name, back = at.unwrap_response(at.wrap_response(resp))
+    assert name == "finalize_block"
+    assert back.tx_results[0].events[0].attributes[0].key == "k"
+    assert back.validator_updates[0].power == 9
+    assert back.app_hash == b"\x05" * 8
+
+
+def test_exception_response():
+    name, back = at.unwrap_response(
+        at.wrap_response(at.ExceptionResponse(error="boom")))
+    assert name == "exception" and back.error == "boom"
+
+
+# -- kvstore app ------------------------------------------------------------
+
+def _finalize(app, height, txs):
+    resp = app.finalize_block(at.FinalizeBlockRequest(
+        txs=txs, height=height, time=Timestamp(height, 0)))
+    app.commit(at.CommitRequest())
+    return resp
+
+
+def test_kvstore_lifecycle():
+    app = KVStoreApplication()
+    app.init_chain(at.InitChainRequest(chain_id="kv-chain",
+                                       initial_height=1))
+    assert app.check_tx(at.CheckTxRequest(tx=b"name=satoshi")).is_ok
+    assert app.check_tx(at.CheckTxRequest(tx=b"garbage")).code == \
+        CODE_INVALID_TX_FORMAT
+
+    resp = _finalize(app, 1, [b"name=satoshi", b"lang=python"])
+    assert all(r.is_ok for r in resp.tx_results)
+    assert app.info(at.InfoRequest()).last_block_height == 1
+
+    q = app.query(at.QueryRequest(data=b"name"))
+    assert q.value == b"satoshi"
+    q = app.query(at.QueryRequest(data=b"missing"))
+    assert q.value == b"" and q.log == "does not exist"
+
+    # app hash is deterministic in tx count
+    h1 = app.info(at.InfoRequest()).last_block_app_hash
+    assert h1 == (2).to_bytes(8, "big")
+
+
+def test_kvstore_validator_update_tx():
+    app = KVStoreApplication()
+    pub = b"\x07" * 32
+    tx = b"val:" + base64.b64encode(pub) + b"!25"
+    assert app.check_tx(at.CheckTxRequest(tx=tx)).is_ok
+    resp = _finalize(app, 1, [tx])
+    assert resp.tx_results[0].is_ok
+    assert len(resp.validator_updates) == 1
+    assert resp.validator_updates[0].power == 25
+    assert resp.validator_updates[0].pub_key_bytes == pub
+
+
+def test_kvstore_finalize_idempotent_before_commit():
+    """Crash-replay re-executes FinalizeBlock for a block whose Commit
+    never ran; the recomputed app_hash must match the original."""
+    app = KVStoreApplication()
+    _finalize(app, 1, [b"a=1"])
+    req = at.FinalizeBlockRequest(txs=[b"b=2", b"c=3"], height=2,
+                                  time=Timestamp(2, 0))
+    h_first = app.finalize_block(req).app_hash
+    # crash before commit -> replay
+    h_again = app.finalize_block(req).app_hash
+    assert h_again == h_first
+    app.commit(at.CommitRequest())
+    assert app.app_hash == h_first
+    assert app.kv == {"a": "1", "b": "2", "c": "3"}
+
+
+def test_kvstore_process_proposal_rejects_bad_tx():
+    app = KVStoreApplication()
+    r = app.process_proposal(at.ProcessProposalRequest(txs=[b"ok=1",
+                                                           b"bad"]))
+    assert not r.is_accepted
+
+
+def test_kvstore_snapshot_restore():
+    app = KVStoreApplication()
+    _finalize(app, 1, [b"a=1"])
+    _finalize(app, 2, [b"b=2", b"c=3"])
+    snaps = app.list_snapshots(at.ListSnapshotsRequest()).snapshots
+    assert snaps and snaps[-1].height == 2
+
+    snap = snaps[-1]
+    chunks = [app.load_snapshot_chunk(at.LoadSnapshotChunkRequest(
+        height=snap.height, format=1, chunk=i)).chunk
+        for i in range(snap.chunks)]
+
+    fresh = KVStoreApplication()
+    offer = fresh.offer_snapshot(at.OfferSnapshotRequest(snapshot=snap))
+    assert offer.result == at.OFFER_SNAPSHOT_ACCEPT
+    for i, c in enumerate(chunks):
+        r = fresh.apply_snapshot_chunk(at.ApplySnapshotChunkRequest(
+            index=i, chunk=c))
+        assert r.result == at.APPLY_CHUNK_ACCEPT
+    assert fresh.kv == app.kv
+    assert fresh.height == 2
+    assert fresh.app_hash == app.app_hash
+
+
+# -- clients ----------------------------------------------------------------
+
+def test_local_client():
+    app = KVStoreApplication()
+    c = LocalClient(app)
+    assert c.echo("hello").message == "hello"
+    c.flush()
+    assert c.info().version.startswith("kvstore")
+    assert c.check_tx(at.CheckTxRequest(tx=b"x=y")).is_ok
+
+
+def test_appconns_share_one_app():
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    conns.consensus.finalize_block(at.FinalizeBlockRequest(
+        txs=[b"k=v"], height=1, time=Timestamp(1, 0)))
+    conns.consensus.commit()
+    # query connection sees what consensus wrote
+    assert conns.query.query(at.QueryRequest(data=b"k")).value == b"v"
+    assert conns.mempool.check_tx(at.CheckTxRequest(tx=b"a=b")).is_ok
+    conns.stop()
+
+
+def test_socket_client_server():
+    app = KVStoreApplication()
+    addr = "tcp://127.0.0.1:28658"
+    server = SocketServer(addr, app)
+    server.start()
+    try:
+        client = SocketClient(addr, timeout=10.0)
+        client.start()
+        assert client.echo("ping").message == "ping"
+        client.init_chain(at.InitChainRequest(chain_id="sock-chain"))
+        assert client.check_tx(at.CheckTxRequest(tx=b"k1=v1")).is_ok
+
+        # pipelining: async CheckTx storm, then a flush barrier
+        futures = [client.check_tx_async(
+            at.CheckTxRequest(tx=b"key%d=val%d" % (i, i)))
+            for i in range(50)]
+        client.flush()
+        assert all(f.wait(5.0).is_ok for f in futures)
+
+        client.finalize_block(at.FinalizeBlockRequest(
+            txs=[b"k1=v1"], height=1, time=Timestamp(1, 0)))
+        client.commit()
+        assert client.query(at.QueryRequest(data=b"k1")).value == b"v1"
+
+        # app exceptions surface as ABCI errors, not hangs
+        class Boom(KVStoreApplication):
+            def query(self, req):
+                raise RuntimeError("kaboom")
+        server._app = Boom()
+        with pytest.raises(Exception, match="kaboom"):
+            client.query(at.QueryRequest(data=b"x"))
+        client.stop()
+    finally:
+        server.stop()
+
+
+def test_socket_client_concurrent_callers():
+    """Multiple caller threads pipeline safely over one socket."""
+    app = KVStoreApplication()
+    addr = "unix:///tmp/abci_test.sock"
+    server = SocketServer(addr, app)
+    server.start()
+    try:
+        client = SocketClient(addr, timeout=10.0)
+        client.start()
+        errs = []
+
+        def worker(n):
+            try:
+                for i in range(20):
+                    r = client.check_tx(at.CheckTxRequest(
+                        tx=b"t%d_%d=1" % (n, i)))
+                    assert r.is_ok
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        client.stop()
+    finally:
+        server.stop()

@@ -345,6 +345,66 @@ def test_wal_torn_tail_tolerated(tmp_path):
     wal2.close()
 
 
+def test_wal_append_after_torn_tail(tmp_path):
+    """Reopening after a crash must truncate the torn tail so new
+    records append cleanly — otherwise every later replay is corrupt."""
+    path = str(tmp_path / "wal")
+    wal = WAL(path)
+    wal.write_sync(EndHeightMessage(1))
+    wal.close()
+    with open(path, "ab") as f:
+        f.write(b"\x13\x37\x00\x00\x00\x00\x00\x09partial")  # torn record
+    wal2 = WAL(path)
+    wal2.write_sync(MsgInfo("peer-x", b"vote"))
+    wal2.write_sync(EndHeightMessage(2))
+    msgs = wal2.replay()
+    assert [type(m.msg).__name__ for m in msgs] == \
+        ["EndHeightMessage", "MsgInfo", "EndHeightMessage"]
+    found, after = wal2.search_for_end_height(1)
+    assert found and len(after) == 2
+    wal2.close()
+
+
+def test_wal_search_spans_rotated_chunks(tmp_path):
+    path = str(tmp_path / "wal")
+    wal = WAL(path, head_size_limit=128)
+    wal.write_sync(EndHeightMessage(1))
+    for i in range(30):
+        wal.write(MsgInfo("", bytes([i]) * 16))
+        wal.maybe_rotate()
+    wal.flush_and_sync()
+    assert wal._group.max_index() > 0
+    found, after = wal.search_for_end_height(1)
+    assert found and len(after) == 30
+    assert [m.msg.msg_bytes[0] for m in after] == list(range(30))
+    wal.close()
+
+
+def test_state_store_prune_at_checkpoint_height(db, monkeypatch):
+    """retain_height landing on a checkpoint must still keep the lhc
+    entry that stubs above the checkpoint point to."""
+    import cometbft_tpu.state.store as sstore
+    monkeypatch.setattr(sstore, "VALSET_CHECKPOINT_INTERVAL", 4)
+    privs = gen_privkeys(3)
+    st = make_genesis_state(_genesis_doc(privs))
+    ss = StateStore(db)
+    ss.save(st)
+    for h in range(1, 8):
+        st = st.copy()
+        st.last_block_height = h
+        st.last_validators = st.validators
+        st.validators = st.next_validators
+        nxt = st.next_validators.copy()
+        nxt.increment_proposer_priority(1)
+        st.next_validators = nxt
+        ss.save(st)
+    # height 8 is a checkpoint (full set stored, lhc=1 still)
+    ss.prune_states(8)
+    v9 = ss.load_validators(9)  # stub with lhc=1 -> entry at 1 must live
+    assert {v.address for v in v9.validators} == \
+        {p.pub_key().address() for p in privs}
+
+
 def test_wal_mid_corruption_detected(tmp_path):
     path = str(tmp_path / "wal")
     wal = WAL(path)
