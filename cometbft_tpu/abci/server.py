@@ -52,6 +52,7 @@ class SocketServer:
             t = threading.Thread(target=self._handle_conn, args=(conn,),
                                  name="abci-server-conn", daemon=True)
             t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _handle_conn(self, conn: socket.socket) -> None:

@@ -144,6 +144,11 @@ class KVStoreApplication(BaseApplication):
             self._staged_count = 0
             self.height = getattr(self, "_pending_height", self.height + 1)
             self.app_hash = getattr(self, "_pending_hash", self.app_hash)
+            # clear so a commit without a preceding finalize_block falls
+            # back to height+1 instead of replaying stale pending state
+            for attr in ("_pending_height", "_pending_hash"):
+                if hasattr(self, attr):
+                    delattr(self, attr)
             self._snapshots[self.height] = self._snapshot_bytes()
             # keep the 10 most recent snapshots
             for h in sorted(self._snapshots)[:-10]:

@@ -1,0 +1,148 @@
+"""BitArray: vote/part presence tracking for gossip
+(reference internal/bits/bit_array.go).
+
+Backed by a numpy bool array — `sub`, `or`, `not` and pick-random are
+vector ops, matching how the gossip routines use BitArrays to compute
+"parts the peer is missing" set differences.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from . import protowire as pw
+
+
+class BitArray:
+    __slots__ = ("bits",)
+
+    def __init__(self, n: int = 0):
+        self.bits = np.zeros(max(n, 0), dtype=bool)
+
+    @staticmethod
+    def from_bools(vals) -> "BitArray":
+        ba = BitArray(0)
+        ba.bits = np.asarray(list(vals), dtype=bool)
+        return ba
+
+    def size(self) -> int:
+        return int(self.bits.shape[0])
+
+    def __len__(self) -> int:
+        return self.size()
+
+    def get_index(self, i: int) -> bool:
+        if i < 0 or i >= self.size():
+            return False
+        return bool(self.bits[i])
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if i < 0 or i >= self.size():
+            return False
+        self.bits[i] = v
+        return True
+
+    def copy(self) -> "BitArray":
+        ba = BitArray(0)
+        ba.bits = self.bits.copy()
+        return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        """Union, sized to the larger operand (bit_array.go Or)."""
+        n = max(self.size(), other.size())
+        ba = BitArray(n)
+        ba.bits[:self.size()] = self.bits
+        ba.bits[:other.size()] |= other.bits
+        return ba
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        n = min(self.size(), other.size())
+        ba = BitArray(0)
+        ba.bits = self.bits[:n] & other.bits[:n]
+        return ba
+
+    def not_(self) -> "BitArray":
+        ba = BitArray(0)
+        ba.bits = ~self.bits
+        return ba
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other; result sized as self
+        (bit_array.go Sub)."""
+        ba = self.copy()
+        n = min(self.size(), other.size())
+        ba.bits[:n] &= ~other.bits[:n]
+        return ba
+
+    def is_empty(self) -> bool:
+        return not bool(self.bits.any())
+
+    def is_full(self) -> bool:
+        return bool(self.bits.all()) if self.size() else True
+
+    def pick_random(self) -> tuple[int, bool]:
+        """A uniformly random set index (bit_array.go PickRandom)."""
+        idxs = np.flatnonzero(self.bits)
+        if idxs.size == 0:
+            return 0, False
+        return int(random.choice(idxs)), True
+
+    def true_indices(self) -> list[int]:
+        return [int(i) for i in np.flatnonzero(self.bits)]
+
+    def num_true(self) -> int:
+        return int(self.bits.sum())
+
+    def update(self, other: "BitArray") -> None:
+        """Copy other's bits into self (bit_array.go Update)."""
+        n = min(self.size(), other.size())
+        self.bits[:n] = other.bits[:n]
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, BitArray):
+            return NotImplemented
+        return self.size() == other.size() and bool(
+            (self.bits == other.bits).all())
+
+    def __str__(self) -> str:
+        return "BA{%d:%s}" % (
+            self.size(),
+            "".join("x" if b else "_" for b in self.bits))
+
+    # proto: message BitArray { int64 bits = 1; repeated uint64 elems = 2; }
+    def to_proto(self) -> bytes:
+        n = self.size()
+        elems = []
+        for w in range((n + 63) // 64):
+            word = 0
+            for b in range(64):
+                i = w * 64 + b
+                if i < n and self.bits[i]:
+                    word |= 1 << b
+            elems.append(word)
+        wtr = pw.Writer().int_field(1, n)
+        if elems:
+            wtr.packed_uint64_field(2, elems)
+        return wtr.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "BitArray":
+        r = pw.Reader(payload)
+        n, elems = 0, []
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                n = r.read_int()
+            elif f == 2 and w == pw.BYTES:
+                elems = r.read_packed_uint64()
+            elif f == 2 and w == pw.VARINT:
+                elems.append(r.read_int())
+            else:
+                r.skip(w)
+        ba = BitArray(n)
+        for i in range(n):
+            word = elems[i // 64] if i // 64 < len(elems) else 0
+            ba.bits[i] = bool((word >> (i % 64)) & 1)
+        return ba

@@ -23,6 +23,8 @@ import struct
 
 _U64 = (1 << 64) - 1
 
+MASK64 = (1 << 64) - 1
+
 # wire types
 VARINT = 0
 FIXED64 = 1
@@ -109,6 +111,10 @@ class Writer:
     def string_field(self, field: int, v: str) -> "Writer":
         return self.bytes_field(field, v.encode("utf-8"))
 
+    def packed_uint64_field(self, field: int, vals) -> "Writer":
+        payload = b"".join(encode_uvarint(v & MASK64) for v in vals)
+        return self.bytes_field(field, payload)
+
     # -- messages ----------------------------------------------------------
     def message_field(self, field: int, payload: bytes) -> "Writer":
         """Embedded message, gogo nullable=false: always emitted."""
@@ -180,6 +186,14 @@ class Reader:
 
     def read_string(self) -> str:
         return self.read_bytes().decode("utf-8")
+
+    def read_packed_uint64(self) -> list[int]:
+        payload = self.read_bytes()
+        vals, pos = [], 0
+        while pos < len(payload):
+            v, pos = decode_uvarint(payload, pos)
+            vals.append(v)
+        return vals
 
     def sub_reader(self) -> "Reader":
         n = self.read_uvarint()

@@ -1,0 +1,67 @@
+"""Uniform Start/Stop/Quit lifecycle for long-lived objects
+(reference libs/service/service.go).
+
+Every engine component (reactors, switch, WAL, event bus, node) shares
+this contract: start once, stop once, wait for quit. Thread-based —
+the runtime around the JAX compute path is ordinary host concurrency.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AlreadyStartedError(RuntimeError):
+    pass
+
+
+class AlreadyStoppedError(RuntimeError):
+    pass
+
+
+class BaseService:
+    """Template-method lifecycle: subclasses override on_start/on_stop."""
+
+    def __init__(self, name: str = ""):
+        self._name = name or type(self).__name__
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self._lifecycle_mtx = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lifecycle_mtx:
+            if self._started:
+                raise AlreadyStartedError(self._name)
+            if self._stopped:
+                raise AlreadyStoppedError(self._name)
+            self._started = True
+        self.on_start()
+
+    def stop(self) -> None:
+        with self._lifecycle_mtx:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.on_stop()
+        self._quit.set()
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._quit.wait(timeout)
+
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    # -- overridables ------------------------------------------------------
+    def on_start(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def on_stop(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def __str__(self) -> str:
+        return self._name

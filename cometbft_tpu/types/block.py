@@ -271,6 +271,145 @@ class Commit:
 PRECOMMIT = 2
 
 
+@dataclass(frozen=True)
+class ExtendedCommitSig:
+    """CommitSig + vote-extension data (block.go:724)."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    signature: bytes = b""
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    @staticmethod
+    def absent() -> "ExtendedCommitSig":
+        return ExtendedCommitSig()
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def to_commit_sig(self) -> CommitSig:
+        return CommitSig(self.block_id_flag, self.validator_address,
+                         self.timestamp, self.signature)
+
+    def validate_basic(self) -> None:
+        self.to_commit_sig().validate_basic()
+        if self.block_id_flag != BLOCK_ID_FLAG_COMMIT and (
+                self.extension or self.extension_signature):
+            raise ValueError(
+                "non-commit sig must not carry a vote extension")
+        if len(self.extension_signature) > 64:
+            raise ValueError("extension signature too big")
+
+    def ensure_extension(self, ext_enabled: bool) -> None:
+        """block.go:773: extensions required exactly when enabled."""
+        has = bool(self.extension_signature)
+        if ext_enabled and self.for_block() and not has:
+            raise ValueError("vote extension data missing")
+        if not ext_enabled and (self.extension or self.extension_signature):
+            raise ValueError("unexpected vote extension data")
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().int_field(1, self.block_id_flag)
+                .bytes_field(2, self.validator_address)
+                .message_field(3, self.timestamp.to_proto())
+                .bytes_field(4, self.signature)
+                .bytes_field(5, self.extension)
+                .bytes_field(6, self.extension_signature).bytes())
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "ExtendedCommitSig":
+        r = pw.Reader(payload)
+        vals = {"block_id_flag": 0, "validator_address": b"",
+                "timestamp": Timestamp.zero(), "signature": b"",
+                "extension": b"", "extension_signature": b""}
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                vals["block_id_flag"] = r.read_int()
+            elif f == 2 and w == pw.BYTES:
+                vals["validator_address"] = r.read_bytes()
+            elif f == 3 and w == pw.BYTES:
+                vals["timestamp"] = Timestamp.from_proto(r.read_bytes())
+            elif f == 4 and w == pw.BYTES:
+                vals["signature"] = r.read_bytes()
+            elif f == 5 and w == pw.BYTES:
+                vals["extension"] = r.read_bytes()
+            elif f == 6 and w == pw.BYTES:
+                vals["extension_signature"] = r.read_bytes()
+            else:
+                r.skip(w)
+        return ExtendedCommitSig(**vals)
+
+
+@dataclass
+class ExtendedCommit:
+    """Commit carrying vote extensions, persisted alongside blocks when
+    extensions are enabled (block.go:1081)."""
+
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    extended_signatures: list[ExtendedCommitSig] = field(
+        default_factory=list)
+
+    def size(self) -> int:
+        return len(self.extended_signatures)
+
+    def to_commit(self) -> Commit:
+        return Commit(self.height, self.round, self.block_id,
+                      [s.to_commit_sig()
+                       for s in self.extended_signatures])
+
+    def ensure_extensions(self, ext_enabled: bool) -> None:
+        for s in self.extended_signatures:
+            s.ensure_extension(ext_enabled)
+
+    def bit_array(self):
+        from ..libs.bits import BitArray
+        return BitArray.from_bools(
+            [bool(s.signature) for s in self.extended_signatures])
+
+    def validate_basic(self) -> None:
+        if self.height < 0 or self.round < 0:
+            raise ValueError("negative height/round")
+        if self.height >= 1:
+            if self.block_id.is_nil():
+                raise ValueError("extended commit cannot be for nil block")
+            if not self.extended_signatures:
+                raise ValueError("no signatures in extended commit")
+            for s in self.extended_signatures:
+                s.validate_basic()
+
+    def to_proto(self) -> bytes:
+        w = (pw.Writer().int_field(1, self.height)
+             .int_field(2, self.round)
+             .message_field(3, self.block_id.to_proto()))
+        for s in self.extended_signatures:
+            w.message_field(4, s.to_proto())
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(payload: bytes) -> "ExtendedCommit":
+        r = pw.Reader(payload)
+        ec = ExtendedCommit()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                ec.height = r.read_int()
+            elif f == 2 and w == pw.VARINT:
+                ec.round = r.read_int()
+            elif f == 3 and w == pw.BYTES:
+                ec.block_id = BlockID.from_proto(r.read_bytes())
+            elif f == 4 and w == pw.BYTES:
+                ec.extended_signatures.append(
+                    ExtendedCommitSig.from_proto(r.read_bytes()))
+            else:
+                r.skip(w)
+        return ec
+
+
 @dataclass
 class Header:
     version: Consensus = field(default_factory=Consensus)

@@ -1,0 +1,159 @@
+"""libs: BitArray, pubsub query/server, service lifecycle
+(reference internal/bits/bit_array_test.go, libs/pubsub/*_test.go)."""
+
+import pytest
+
+from cometbft_tpu.libs import pubsub
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.libs.service import AlreadyStartedError, BaseService
+
+
+class TestBitArray:
+    def test_set_get(self):
+        ba = BitArray(10)
+        assert not ba.get_index(3)
+        assert ba.set_index(3, True)
+        assert ba.get_index(3)
+        assert not ba.set_index(10, True)  # out of range
+        assert not ba.get_index(-1)
+
+    def test_ops(self):
+        a = BitArray.from_bools([1, 1, 0, 0])
+        b = BitArray.from_bools([0, 1, 1, 0])
+        assert a.or_(b) == BitArray.from_bools([1, 1, 1, 0])
+        assert a.and_(b) == BitArray.from_bools([0, 1, 0, 0])
+        assert a.sub(b) == BitArray.from_bools([1, 0, 0, 0])
+        assert a.not_() == BitArray.from_bools([0, 0, 1, 1])
+
+    def test_sub_different_sizes(self):
+        a = BitArray.from_bools([1, 1, 1])
+        b = BitArray.from_bools([0, 1])
+        assert a.sub(b) == BitArray.from_bools([1, 0, 1])
+
+    def test_pick_random(self):
+        ba = BitArray(8)
+        _, ok = ba.pick_random()
+        assert not ok
+        ba.set_index(5, True)
+        i, ok = ba.pick_random()
+        assert ok and i == 5
+
+    def test_full_empty(self):
+        assert BitArray(0).is_full()
+        ba = BitArray(3)
+        assert ba.is_empty() and not ba.is_full()
+        for i in range(3):
+            ba.set_index(i, True)
+        assert ba.is_full() and not ba.is_empty()
+
+    def test_proto_roundtrip(self):
+        for n in (0, 1, 63, 64, 65, 130):
+            ba = BitArray(n)
+            for i in range(0, n, 3):
+                ba.set_index(i, True)
+            assert BitArray.from_proto(ba.to_proto()) == ba
+
+
+class TestQuery:
+    def test_match_equal(self):
+        q = pubsub.Query.parse("tm.event = 'Tx'")
+        assert q.matches({"tm.event": ["Tx"]})
+        assert not q.matches({"tm.event": ["NewBlock"]})
+        assert not q.matches({})
+
+    def test_match_numeric(self):
+        q = pubsub.Query.parse("tx.height > 5 AND tx.height <= 10")
+        assert q.matches({"tx.height": ["7"]})
+        assert not q.matches({"tx.height": ["5"]})
+        assert not q.matches({"tx.height": ["11"]})
+
+    def test_match_contains_exists(self):
+        q = pubsub.Query.parse("tx.hash CONTAINS 'AB' AND account.owner EXISTS")
+        assert q.matches({"tx.hash": ["XXABYY"], "account.owner": ["ivan"]})
+        assert not q.matches({"tx.hash": ["XXABYY"]})
+
+    def test_multiple_values(self):
+        q = pubsub.Query.parse("transfer.to = 'bob'")
+        assert q.matches({"transfer.to": ["alice", "bob"]})
+
+    def test_parse_errors(self):
+        for bad in ("tm.event =", "= 'x'", "tm.event = 'x' AND",
+                    "a CONTAINS 5"):
+            with pytest.raises(pubsub.QueryError):
+                pubsub.Query.parse(bad)
+
+
+class TestPubSubServer:
+    def test_publish_subscribe(self):
+        s = pubsub.Server()
+        sub = s.subscribe("c1", pubsub.Query.parse("tm.event = 'Tx'"))
+        s.publish("tx-data", {"tm.event": ["Tx"]})
+        msg = sub.next(timeout=1)
+        assert msg.data == "tx-data"
+        s.publish("other", {"tm.event": ["NewBlock"]})
+        assert sub.next(timeout=0.05) is None
+
+    def test_unsubscribe(self):
+        s = pubsub.Server()
+        q = pubsub.Query.parse("tm.event = 'Tx'")
+        sub = s.subscribe("c1", q)
+        s.unsubscribe("c1", q)
+        assert sub.canceled.is_set()
+        with pytest.raises(KeyError):
+            s.unsubscribe("c1", q)
+
+    def test_overflow_cancels(self):
+        s = pubsub.Server()
+        sub = s.subscribe("slow", pubsub.ALL, capacity=2)
+        for _ in range(3):
+            s.publish("x", {"k": ["v"]})
+        assert sub.canceled.is_set()
+        assert s.num_clients() == 0
+
+
+class TestService:
+    def test_lifecycle(self):
+        calls = []
+
+        class S(BaseService):
+            def on_start(self):
+                calls.append("start")
+
+            def on_stop(self):
+                calls.append("stop")
+
+        s = S()
+        s.start()
+        assert s.is_running()
+        with pytest.raises(AlreadyStartedError):
+            s.start()
+        s.stop()
+        s.stop()  # idempotent
+        assert calls == ["start", "stop"]
+        assert s.wait(0)
+
+
+class TestEventBus:
+    def test_typed_publish_and_query(self):
+        from cometbft_tpu.types import events as ev
+        bus = ev.EventBus()
+        sub = bus.subscribe("test", ev.query_for_event(ev.EVENT_NEW_ROUND))
+        bus.publish_new_round_step(ev.EventDataRoundState(1, 0, "propose"))
+        bus.publish_new_round(ev.EventDataNewRound(1, 0, "new-round"))
+        msg = sub.next(timeout=1)
+        assert msg.data.step == "new-round"
+
+    def test_tx_event_attributes(self):
+        from cometbft_tpu.abci import types as at
+        from cometbft_tpu.types import events as ev
+        bus = ev.EventBus()
+        sub = bus.subscribe(
+            "t", ev.pubsub.Query.parse(
+                "tm.event = 'Tx' AND transfer.amount = '100'"))
+        res = at.ExecTxResult(events=[at.Event(type="transfer", attributes=[
+            at.EventAttribute(key="amount", value="100")])])
+        bus.publish_tx(ev.EventDataTx(height=7, index=0, tx=b"abc",
+                                      result=res))
+        msg = sub.next(timeout=1)
+        assert msg.events["tx.height"] == ["7"]
+        assert len(msg.events["tx.hash"][0]) == 64

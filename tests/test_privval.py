@@ -1,0 +1,112 @@
+"""FilePV double-sign protection (reference privval/file_test.go)."""
+
+import pytest
+
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.privval.file import (
+    STEP_PRECOMMIT, STEP_PREVOTE, DoubleSignError,
+)
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import (
+    PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote,
+)
+
+CHAIN = "test-chain"
+BID = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+
+def make_vote(pv, vtype=PREVOTE_TYPE, height=1, round_=0, bid=BID,
+              ts=None, ext=b""):
+    return Vote(type=vtype, height=height, round=round_, block_id=bid,
+                timestamp=ts or Timestamp(100, 0),
+                validator_address=pv.get_address(), validator_index=0,
+                extension=ext)
+
+
+@pytest.fixture
+def pv(tmp_path):
+    return FilePV.load_or_generate(str(tmp_path / "key.json"),
+                                   str(tmp_path / "state.json"))
+
+
+class TestFilePV:
+    def test_sign_and_verify(self, pv):
+        v = make_vote(pv)
+        pv.sign_vote(CHAIN, v)
+        v.verify(CHAIN, pv.get_pub_key())
+
+    def test_same_hrs_same_bytes_replays_signature(self, pv):
+        v1 = make_vote(pv)
+        pv.sign_vote(CHAIN, v1)
+        v2 = make_vote(pv)
+        pv.sign_vote(CHAIN, v2)
+        assert v2.signature == v1.signature
+
+    def test_same_hrs_timestamp_only_diff_replays(self, pv):
+        v1 = make_vote(pv, ts=Timestamp(100, 0))
+        pv.sign_vote(CHAIN, v1)
+        v2 = make_vote(pv, ts=Timestamp(200, 7))
+        pv.sign_vote(CHAIN, v2)
+        assert v2.signature == v1.signature
+        assert v2.timestamp == Timestamp(100, 0)
+        v2.verify(CHAIN, pv.get_pub_key())
+
+    def test_same_hrs_conflicting_block_errors(self, pv):
+        pv.sign_vote(CHAIN, make_vote(pv))
+        other = BlockID(b"\x09" * 32, PartSetHeader(1, b"\x0a" * 32))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN, make_vote(pv, bid=other))
+
+    def test_regressions_rejected(self, pv):
+        pv.sign_vote(CHAIN, make_vote(pv, vtype=PRECOMMIT_TYPE,
+                                      height=5, round_=2))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN, make_vote(pv, height=4))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN, make_vote(pv, height=5, round_=1))
+        with pytest.raises(DoubleSignError):  # prevote after precommit
+            pv.sign_vote(CHAIN, make_vote(pv, vtype=PREVOTE_TYPE,
+                                          height=5, round_=2))
+
+    def test_step_progression_allowed(self, pv):
+        pv.sign_vote(CHAIN, make_vote(pv, vtype=PREVOTE_TYPE))
+        pv.sign_vote(CHAIN, make_vote(pv, vtype=PRECOMMIT_TYPE))
+        assert pv.last_sign_state.step == STEP_PRECOMMIT
+
+    def test_state_survives_reload(self, pv, tmp_path):
+        pv.sign_vote(CHAIN, make_vote(pv, height=3))
+        pv2 = FilePV.load(str(tmp_path / "key.json"),
+                          str(tmp_path / "state.json"))
+        assert pv2.get_address() == pv.get_address()
+        assert pv2.last_sign_state.height == 3
+        assert pv2.last_sign_state.step == STEP_PREVOTE
+        # replay across restart (the crash-before-WAL scenario)
+        v = make_vote(pv2, height=3)
+        pv2.sign_vote(CHAIN, v)
+        v.verify(CHAIN, pv2.get_pub_key())
+
+    def test_sign_proposal(self, pv):
+        p = Proposal(height=1, round=0, pol_round=-1, block_id=BID,
+                     timestamp=Timestamp(5, 0))
+        pv.sign_proposal(CHAIN, p)
+        assert pv.get_pub_key().verify_signature(
+            p.sign_bytes(CHAIN), p.signature)
+        # timestamp-only diff replays
+        p2 = Proposal(height=1, round=0, pol_round=-1, block_id=BID,
+                      timestamp=Timestamp(77, 0))
+        pv.sign_proposal(CHAIN, p2)
+        assert p2.signature == p.signature and p2.timestamp == Timestamp(5, 0)
+
+    def test_sign_vote_with_extension(self, pv):
+        v = make_vote(pv, vtype=PRECOMMIT_TYPE, ext=b"app-data")
+        pv.sign_vote(CHAIN, v, sign_extension=True)
+        assert v.extension_signature
+        v.verify_vote_and_extension(CHAIN, pv.get_pub_key())
+
+    def test_load_or_generate_idempotent(self, tmp_path):
+        a = FilePV.load_or_generate(str(tmp_path / "k.json"),
+                                    str(tmp_path / "s.json"))
+        b = FilePV.load_or_generate(str(tmp_path / "k.json"),
+                                    str(tmp_path / "s.json"))
+        assert a.get_address() == b.get_address()

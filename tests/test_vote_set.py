@@ -1,0 +1,187 @@
+"""VoteSet tallying, conflict tracking, commit construction
+(reference types/vote_set_test.go)."""
+
+import pytest
+
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL,
+    BlockID, ExtendedCommit, PartSetHeader,
+)
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+from cometbft_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+from cometbft_tpu.types.vote_set import (
+    ErrVoteConflictingVotes, ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress, ErrVoteUnexpectedStep, VoteSet,
+    commit_to_vote_set, extended_commit_to_vote_set,
+)
+
+CHAIN = "test-chain"
+
+
+def make_valset(n, power=10):
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    vals = ValidatorSet([Validator(p.pub_key(), power) for p in privs])
+    # map privkeys by address so indices follow the set's sort order
+    by_addr = {p.pub_key().address(): p for p in privs}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    return vals, ordered
+
+
+def block_id(seed=1):
+    return BlockID(bytes([seed]) * 32, PartSetHeader(1, bytes([seed + 1]) * 32))
+
+
+def signed_vote(priv, idx, vote_type, height, round_, bid,
+                ts=None, ext=b""):
+    v = Vote(type=vote_type, height=height, round=round_, block_id=bid,
+             timestamp=ts or Timestamp(1, 0),
+             validator_address=priv.pub_key().address(),
+             validator_index=idx, extension=ext)
+    v.signature = priv.sign(v.sign_bytes(CHAIN))
+    if ext and vote_type == PRECOMMIT_TYPE and not bid.is_nil():
+        v.extension_signature = priv.sign(v.extension_sign_bytes(CHAIN))
+    return v
+
+
+class TestVoteSet:
+    def test_majority_at_two_thirds_plus_one(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        bid = block_id()
+        for i in range(2):
+            assert vs.add_vote(signed_vote(privs[i], i, PREVOTE_TYPE, 1, 0, bid))
+            assert not vs.has_two_thirds_majority()
+        assert vs.add_vote(signed_vote(privs[2], 2, PREVOTE_TYPE, 1, 0, bid))
+        got, ok = vs.two_thirds_majority()
+        assert ok and got == bid
+
+    def test_duplicate_returns_false(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        v = signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, block_id())
+        assert vs.add_vote(v)
+        assert not vs.add_vote(v)
+
+    def test_wrong_step_rejected(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        with pytest.raises(ErrVoteUnexpectedStep):
+            vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 2, 0, block_id()))
+        with pytest.raises(ErrVoteUnexpectedStep):
+            vs.add_vote(signed_vote(privs[0], 0, PRECOMMIT_TYPE, 1, 0, block_id()))
+
+    def test_bad_signature_rejected(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        v = signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, block_id())
+        v.signature = bytes(64)
+        with pytest.raises(ErrVoteInvalidSignature):
+            vs.add_vote(v)
+
+    def test_wrong_address_rejected(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        v = signed_vote(privs[0], 1, PREVOTE_TYPE, 1, 0, block_id())
+        with pytest.raises(ErrVoteInvalidValidatorAddress):
+            vs.add_vote(v)
+
+    def test_conflicting_vote_raises_and_is_dropped(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        assert vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, block_id(1)))
+        with pytest.raises(ErrVoteConflictingVotes):
+            vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, block_id(3)))
+        # canonical vote unchanged
+        assert vs.get_by_index(0).block_id == block_id(1)
+
+    def test_conflict_tracked_after_peer_maj23(self):
+        """vote_set.go: conflicting votes count toward a block only once
+        a peer claimed maj23 for it."""
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        bid_a, bid_b = block_id(1), block_id(3)
+        assert vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, bid_a))
+        vs.set_peer_maj23("peer1", bid_b)
+        # conflicting vote for tracked block: recorded in votesByBlock
+        with pytest.raises(ErrVoteConflictingVotes):
+            vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, bid_b))
+        for i in (1, 2):
+            assert vs.add_vote(signed_vote(privs[i], i, PREVOTE_TYPE, 1, 0, bid_b))
+        # 3 votes (incl. the conflicting one) reach quorum for bid_b
+        got, ok = vs.two_thirds_majority()
+        assert ok and got == bid_b
+        # canonical vote for validator 0 flipped to the maj23 block
+        assert vs.get_by_index(0).block_id == bid_b
+
+    def test_make_commit(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+        bid = block_id()
+        # one nil vote, three for the block
+        nil_v = signed_vote(privs[3], 3, PRECOMMIT_TYPE, 1, 0, BlockID())
+        assert vs.add_vote(nil_v)
+        for i in range(3):
+            assert vs.add_vote(signed_vote(privs[i], i, PRECOMMIT_TYPE, 1, 0, bid))
+        commit = vs.make_commit()
+        assert commit.height == 1 and commit.block_id == bid
+        flags = [s.block_id_flag for s in commit.signatures]
+        assert flags == [BLOCK_ID_FLAG_COMMIT] * 3 + [BLOCK_ID_FLAG_NIL]
+        # the commit passes full verification
+        vals.verify_commit(CHAIN, bid, 1, commit)
+
+    def test_commit_round_trips_through_vote_set(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 2, 1, PRECOMMIT_TYPE, vals)
+        bid = block_id()
+        for i in range(3):
+            vs.add_vote(signed_vote(privs[i], i, PRECOMMIT_TYPE, 2, 1, bid))
+        commit = vs.make_commit()
+        vs2 = commit_to_vote_set(CHAIN, commit, vals)
+        assert vs2.has_two_thirds_majority()
+        assert vs2.make_commit().block_id == bid
+
+    def test_extended_commit(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals,
+                     extensions_enabled=True)
+        bid = block_id()
+        for i in range(4):
+            vs.add_vote(signed_vote(privs[i], i, PRECOMMIT_TYPE, 1, 0, bid,
+                                    ext=b"ext%d" % i))
+        ec = vs.make_extended_commit(True)
+        assert all(s.extension_signature for s in ec.extended_signatures)
+        ec2 = ExtendedCommit.from_proto(ec.to_proto())
+        assert ec2.block_id == bid and ec2.size() == 4
+        vs2 = extended_commit_to_vote_set(CHAIN, ec2, vals)
+        assert vs2.has_two_thirds_majority()
+
+    def test_absent_validators_marked_absent(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PRECOMMIT_TYPE, vals)
+        bid = block_id()
+        for i in range(3):
+            vs.add_vote(signed_vote(privs[i], i, PRECOMMIT_TYPE, 1, 0, bid))
+        commit = vs.make_commit()
+        assert commit.signatures[3].block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def test_two_thirds_any_vs_majority(self):
+        """Split votes can cross 2/3 total power with no single-block
+        majority."""
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        vs.add_vote(signed_vote(privs[0], 0, PREVOTE_TYPE, 1, 0, block_id(1)))
+        vs.add_vote(signed_vote(privs[1], 1, PREVOTE_TYPE, 1, 0, block_id(3)))
+        vs.add_vote(signed_vote(privs[2], 2, PREVOTE_TYPE, 1, 0, BlockID()))
+        assert vs.has_two_thirds_any()
+        assert not vs.has_two_thirds_majority()
+
+    def test_bit_arrays(self):
+        vals, privs = make_valset(4)
+        vs = VoteSet(CHAIN, 1, 0, PREVOTE_TYPE, vals)
+        bid = block_id()
+        vs.add_vote(signed_vote(privs[1], 1, PREVOTE_TYPE, 1, 0, bid))
+        assert vs.bit_array().true_indices() == [1]
+        assert vs.bit_array_by_block_id(bid).true_indices() == [1]
+        assert vs.bit_array_by_block_id(block_id(7)) is None
