@@ -14,6 +14,10 @@ import numpy as np
 
 from . import protowire as pw
 
+# Upper bound for wire-decoded sizes: generous for both vote sets
+# (MaxVotesCount=10000) and block part sets (100MiB / 64KiB parts)
+MAX_PROTO_BITS = 1 << 22
+
 
 class BitArray:
     __slots__ = ("bits",)
@@ -141,8 +145,13 @@ class BitArray:
                 elems.append(r.read_int())
             else:
                 r.skip(w)
+        # DoS bound: the declared size is attacker-controlled gossip input
+        if n < 0 or n > MAX_PROTO_BITS:
+            raise ValueError(f"BitArray size {n} out of range")
+        words = np.array(elems, dtype=np.uint64)
+        unpacked = np.unpackbits(
+            words.view(np.uint8), bitorder="little")
         ba = BitArray(n)
-        for i in range(n):
-            word = elems[i // 64] if i // 64 < len(elems) else 0
-            ba.bits[i] = bool((word >> (i % 64)) & 1)
+        m = min(n, unpacked.shape[0])
+        ba.bits[:m] = unpacked[:m].astype(bool)
         return ba

@@ -25,6 +25,12 @@ _U64 = (1 << 64) - 1
 
 MASK64 = (1 << 64) - 1
 
+
+def delimited_field_size(n: int) -> int:
+    """Wire size of an n-byte length-delimited field with a 1-byte tag
+    (types/tx.go ComputeProtoSizeForTxs)."""
+    return 1 + len(encode_uvarint(n)) + n
+
 # wire types
 VARINT = 0
 FIXED64 = 1

@@ -72,20 +72,22 @@ class Condition:
             elif self.op == "CONTAINS":
                 if str(self.value) in v:
                     return True
-            else:  # ordered comparison: numeric, or lexicographic for
-                # DATE/TIME operands (ISO-8601 sorts correctly as text)
-                try:
-                    x, t = float(v), float(self.value)
-                except (ValueError, TypeError):
+            else:  # ordered comparison
+                if isinstance(self.value, float):
+                    # numeric operand: non-numeric values never match
+                    try:
+                        x: float | str = float(v)
+                    except ValueError:
+                        continue
+                    t: float | str = self.value
+                else:
+                    # DATE/TIME operand: ISO-8601 sorts correctly as text
                     x, t = str(v), str(self.value)
-                try:
-                    if ((self.op == "<" and x < t)
-                            or (self.op == "<=" and x <= t)
-                            or (self.op == ">" and x > t)
-                            or (self.op == ">=" and x >= t)):
-                        return True
-                except TypeError:
-                    continue
+                if ((self.op == "<" and x < t)
+                        or (self.op == "<=" and x <= t)
+                        or (self.op == ">" and x > t)
+                        or (self.op == ">=" and x >= t)):
+                    return True
         return False
 
 

@@ -1,0 +1,7 @@
+"""Mempool: CheckTx-gated pending-tx pool (reference mempool/)."""
+
+from .cache import LRUTxCache, NopTxCache  # noqa: F401
+from .clist_mempool import (  # noqa: F401
+    CListMempool, ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge, MempoolTx,
+    NopMempool, tx_key,
+)

@@ -152,6 +152,57 @@ class State:
         return s
 
 
+def tx_results_hash(tx_results: list) -> bytes:
+    """Merkle root of the deterministic subset of each ExecTxResult
+    (reference types/results.go NewResults().Hash(); the deterministic
+    fields are code/data/gas_wanted/gas_used per
+    abci/types.go DeterministicExecTxResult)."""
+    from ..abci import types as at
+    from ..crypto import merkle
+    stripped = [
+        at.ExecTxResult(code=r.code, data=r.data, gas_wanted=r.gas_wanted,
+                        gas_used=r.gas_used).to_proto()
+        for r in tx_results
+    ]
+    return merkle.hash_from_byte_slices(stripped)
+
+
+def make_block(state: State, height: int, txs: list[bytes], last_commit,
+               evidence: list, proposer_address: bytes,
+               timestamp: Timestamp | None = None):
+    """state.MakeBlock (state/state.go:241): block data + header fields
+    drawn from the state; time = genesis (initial), BFT median of the
+    last commit, or wall clock under PBTS."""
+    from ..types.block import Block, Data, Header, evidence_hash
+
+    if timestamp is None:
+        if state.consensus_params.pbts_enabled(height):
+            timestamp = Timestamp.now()
+        elif height == state.initial_height:
+            timestamp = state.last_block_time  # genesis time
+        else:
+            timestamp = last_commit.median_time(state.last_validators)
+
+    header = Header(
+        version=state.version.consensus,
+        chain_id=state.chain_id,
+        height=height,
+        time=timestamp,
+        last_block_id=state.last_block_id,
+        last_commit_hash=last_commit.hash(),
+        data_hash=Data(txs=list(txs)).hash(),
+        validators_hash=state.validators.hash(),
+        next_validators_hash=state.next_validators.hash(),
+        consensus_hash=state.consensus_params.hash(),
+        app_hash=state.app_hash,
+        last_results_hash=state.last_results_hash,
+        evidence_hash=evidence_hash(evidence),
+        proposer_address=proposer_address,
+    )
+    return Block(header=header, data=Data(txs=list(txs)),
+                 evidence=list(evidence), last_commit=last_commit)
+
+
 def make_genesis_state(genesis: GenesisDoc) -> State:
     """state.MakeGenesisState analog: State before any block."""
     genesis.validate_and_complete()

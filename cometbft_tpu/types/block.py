@@ -227,6 +227,30 @@ class Commit:
                 [s.to_proto() for s in self.signatures])
         return self._hash
 
+    def median_time(self, validators) -> Timestamp:
+        """Voting-power-weighted median of the precommit timestamps —
+        the BFT Time rule (block.go:944, types/time/time.go
+        WeightedMedian). Safe against 1/3 byzantine clock skew."""
+        weighted = []  # (unix_ns, power)
+        total_power = 0
+        for cs in self.signatures:
+            if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+                continue
+            _, val = validators.get_by_address(cs.validator_address)
+            if val is not None:
+                total_power += val.voting_power
+                weighted.append(
+                    (cs.timestamp.seconds * 1_000_000_000
+                     + cs.timestamp.nanos, val.voting_power))
+        weighted.sort(key=lambda wt: wt[0])
+        median = total_power // 2
+        for t_ns, power in weighted:
+            if median <= power:
+                return Timestamp(t_ns // 1_000_000_000,
+                                 t_ns % 1_000_000_000)
+            median -= power
+        return Timestamp.zero()
+
     def validate_basic(self) -> None:
         if self.height < 0:
             raise ValueError("negative Height")

@@ -327,6 +327,32 @@ class ConsensusParams:
         if self.evidence.max_bytes > block_max:
             raise ValueError("evidence.MaxBytes exceeds block.MaxBytes")
 
+    def merge_proto_updates(self, payload: bytes) -> "ConsensusParams":
+        """ABCI ConsensusParamUpdates: a partial ConsensusParams proto
+        where absent sub-messages mean "keep current"
+        (types/params.go Update)."""
+        r = pw.Reader(payload)
+        kwargs = {}
+        while not r.at_end():
+            f, w = r.read_tag()
+            if w != pw.BYTES:
+                r.skip(w)
+                continue
+            buf = r.read_bytes()
+            if f == 1:
+                kwargs["block"] = BlockParams.from_proto(buf)
+            elif f == 2:
+                kwargs["evidence"] = EvidenceParams.from_proto(buf)
+            elif f == 3:
+                kwargs["validator"] = ValidatorParams.from_proto(buf)
+            elif f == 4:
+                kwargs["version"] = VersionParams.from_proto(buf)
+            elif f == 6:
+                kwargs["synchrony"] = SynchronyParams.from_proto(buf)
+            elif f == 7:
+                kwargs["feature"] = FeatureParams.from_proto(buf)
+        return self.update(**kwargs)
+
     def update(self, *, block=None, evidence=None, validator=None,
                version=None, synchrony=None, feature=None
                ) -> "ConsensusParams":

@@ -1,0 +1,212 @@
+"""BlockExecutor: proposal creation, validation, apply, state update
+(reference state/execution_test.go, state/validation_test.go).
+
+Runs a real multi-height chain: kvstore app over a local ABCI client,
+signed commits from FilePV validators, state persisted to a MemDB.
+"""
+
+import base64
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.state.execution import BlockExecutor, update_state
+from cometbft_tpu.state.state import make_genesis_state, make_block
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.state.validation import InvalidBlockError, validate_block
+from cometbft_tpu.store.kv import MemDB
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.types.block import BlockID, ExtendedCommit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
+from cometbft_tpu.types.vote_set import VoteSet
+
+CHAIN = "exec-chain"
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+class Harness:
+    """One in-process node: app + mempool + executor + signing vals."""
+
+    def __init__(self, n_vals=4):
+        self.privs = [PrivKey.generate(bytes([i + 1]) * 32)
+                      for i in range(n_vals)]
+        genesis = GenesisDoc(
+            chain_id=CHAIN, genesis_time=GENESIS_TIME,
+            validators=[GenesisValidator(pub_key=p.pub_key(), power=10)
+                        for p in self.privs])
+        self.state = make_genesis_state(genesis)
+        self.app = KVStoreApplication()
+        self.client = LocalClient(self.app)
+        self.client.init_chain(at.InitChainRequest(
+            chain_id=CHAIN, initial_height=1,
+            validators=[], app_state_bytes=b""))
+        self.mempool = CListMempool(self.client)
+        self.store = StateStore(MemDB())
+        self.store.bootstrap(self.state)
+        self.bus = ev.EventBus()
+        self.exec = BlockExecutor(self.store, self.client, self.mempool,
+                                  event_bus=self.bus)
+        self.last_ext_commit = ExtendedCommit(height=0, round=0)
+
+    def priv_by_addr(self, addr):
+        return next(p for p in self.privs
+                    if p.pub_key().address() == addr)
+
+    def proposer(self):
+        return self.state.validators.get_proposer()
+
+    def make_next_block(self, txs=None):
+        if txs:
+            for tx in txs:
+                self.mempool.check_tx(tx)
+        height = self.state.last_block_height + 1
+        return self.exec.create_proposal_block(
+            height, self.state, self.last_ext_commit,
+            self.proposer().address)
+
+    def commit_block(self, block):
+        """Sign precommits for the block with every validator."""
+        parts = PartSet.from_data(block.to_proto())
+        bid = BlockID(block.hash(), parts.header)
+        vs = VoteSet(CHAIN, block.header.height, 0, PRECOMMIT_TYPE,
+                     self.state.validators)
+        for i, val in enumerate(self.state.validators.validators):
+            priv = self.priv_by_addr(val.address)
+            v = Vote(type=PRECOMMIT_TYPE, height=block.header.height,
+                     round=0, block_id=bid,
+                     timestamp=block.header.time.add_ns(1_000_000_000),
+                     validator_address=val.address, validator_index=i)
+            v.signature = priv.sign(v.sign_bytes(CHAIN))
+            vs.add_vote(v)
+        return bid, vs.make_extended_commit(False)
+
+    def apply(self, block, bid):
+        self.state = self.exec.apply_block(self.state, bid, block)
+        return self.state
+
+    def advance(self, txs=None):
+        block = self.make_next_block(txs)
+        assert self.exec.process_proposal(block, self.state)
+        bid, ext = self.commit_block(block)
+        self.apply(block, bid)
+        self.last_ext_commit = ext
+        return block
+
+
+@pytest.fixture
+def h():
+    return Harness()
+
+
+class TestBlockExecutor:
+    def test_first_block(self, h):
+        block = h.make_next_block([b"a=1"])
+        assert block.header.height == 1
+        assert block.header.time == GENESIS_TIME  # genesis time rule
+        assert block.data.txs == [b"a=1"]
+        bid, _ = h.commit_block(block)
+        state = h.apply(block, bid)
+        assert state.last_block_height == 1
+        assert state.app_hash == h.app.app_hash
+        # mempool drained
+        assert h.mempool.size() == 0
+
+    def test_multi_height_chain(self, h):
+        for i in range(5):
+            block = h.advance([b"k%d=%d" % (i, i)])
+            assert block.header.height == i + 1
+        assert h.state.last_block_height == 5
+        assert h.app.height == 5
+        # app kv updated through FinalizeBlock
+        q = h.client.query(at.QueryRequest(data=b"k3"))
+        assert q.value == b"3"
+
+    def test_block_time_is_commit_median(self, h):
+        h.advance()
+        block2 = h.make_next_block()
+        # non-PBTS: time must equal median of last commit timestamps
+        median = block2.last_commit.median_time(h.state.last_validators)
+        assert block2.header.time == median
+
+    def test_validate_block_rejects_tampering(self, h):
+        h.advance()
+        block = h.make_next_block([b"x=1"])
+        block.header.app_hash = b"\xff" * 8
+        with pytest.raises(InvalidBlockError):
+            validate_block(h.state, block)
+        block2 = h.make_next_block()
+        block2.header.chain_id = "other-chain"
+        with pytest.raises(InvalidBlockError):
+            validate_block(h.state, block2)
+
+    def test_validate_rejects_bad_last_commit(self, h):
+        h.advance()
+        block = h.make_next_block()
+        # corrupt one signature: batch verify must reject
+        sig = block.last_commit.signatures[0]
+        from dataclasses import replace
+        block.last_commit.signatures[0] = replace(
+            sig, signature=bytes(64))
+        block.header.last_commit_hash = block.last_commit.hash()
+        with pytest.raises(Exception):
+            validate_block(h.state, block)
+
+    def test_validator_update_flows_through(self, h):
+        new_priv = PrivKey.generate(b"\x77" * 32)
+        b64 = base64.b64encode(new_priv.pub_key().bytes()).decode()
+        h.advance([f"val:{b64}!25".encode()])
+        # change lands in next_validators at H+2 per updateState
+        assert h.state.validators.size() == 4
+        assert h.state.next_validators.size() == 5
+        h.advance()
+        assert h.state.validators.size() == 5
+        _, val = h.state.validators.get_by_address(
+            new_priv.pub_key().address())
+        assert val.voting_power == 25
+
+    def test_events_fired(self, h):
+        sub_block = h.bus.subscribe(
+            "t", ev.query_for_event(ev.EVENT_NEW_BLOCK))
+        sub_tx = h.bus.subscribe("t", ev.query_for_event(ev.EVENT_TX))
+        h.advance([b"ev=1"])
+        msg = sub_block.next(timeout=1)
+        assert msg.data.block.header.height == 1
+        tx_msg = sub_tx.next(timeout=1)
+        assert tx_msg.data.tx == b"ev=1"
+        assert tx_msg.events["tx.height"] == ["1"]
+
+    def test_finalize_response_persisted(self, h):
+        h.advance([b"a=1"])
+        raw = h.store.load_finalize_block_response(1)
+        assert raw is not None
+        resp = at.FinalizeBlockResponse.from_proto(raw)
+        assert len(resp.tx_results) == 1
+        assert resp.app_hash == h.state.app_hash
+
+    def test_process_proposal_reject(self, h):
+        block = h.make_next_block()
+        block.data.txs = [b"malformed-tx-no-equals"]
+        block.header.data_hash = block.data.hash()
+        assert not h.exec.process_proposal(block, h.state)
+
+    def test_last_results_hash_chains(self, h):
+        h.advance([b"a=1"])
+        block2 = h.make_next_block()
+        from cometbft_tpu.state.state import tx_results_hash
+        raw = h.store.load_finalize_block_response(1)
+        resp = at.FinalizeBlockResponse.from_proto(raw)
+        assert block2.header.last_results_hash == \
+            tx_results_hash(resp.tx_results)
+
+    def test_validators_persisted_per_height(self, h):
+        h.advance()
+        h.advance()
+        v1 = h.store.load_validators(1)
+        assert v1.hash() == h.state.last_validators.hash() or v1.size() == 4

@@ -1,0 +1,152 @@
+"""Mempool: CheckTx gating, cache, reap, update/recheck
+(reference mempool/clist_mempool_test.go)."""
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.mempool import (
+    CListMempool, ErrMempoolIsFull, ErrTxInCache, ErrTxTooLarge,
+    LRUTxCache,
+)
+from cometbft_tpu.mempool.clist_mempool import ErrAppCheckTx
+
+
+def make_mempool(**kw):
+    app = KVStoreApplication()
+    return CListMempool(LocalClient(app), **kw), app
+
+
+class TestLRUTxCache:
+    def test_push_dedup(self):
+        c = LRUTxCache(10)
+        assert c.push(b"a")
+        assert not c.push(b"a")
+        c.remove(b"a")
+        assert c.push(b"a")
+
+    def test_eviction(self):
+        c = LRUTxCache(2)
+        c.push(b"a")
+        c.push(b"b")
+        c.push(b"c")  # evicts a
+        assert not c.has(b"a")
+        assert c.has(b"b") and c.has(b"c")
+
+    def test_lru_refresh(self):
+        c = LRUTxCache(2)
+        c.push(b"a")
+        c.push(b"b")
+        c.push(b"a")  # refresh: b is now oldest
+        c.push(b"c")
+        assert c.has(b"a") and not c.has(b"b")
+
+
+class TestCListMempool:
+    def test_check_tx_adds(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"k=v")
+        assert mp.size() == 1
+        assert mp.size_bytes() == 3
+
+    def test_duplicate_rejected_via_cache(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"k=v")
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"k=v")
+        assert mp.size() == 1
+
+    def test_app_reject_not_added(self):
+        mp, _ = make_mempool()
+        with pytest.raises(ErrAppCheckTx):
+            mp.check_tx(b"not-a-kv-tx")
+        assert mp.size() == 0
+        # invalid tx evicted from cache -> can be retried
+        with pytest.raises(ErrAppCheckTx):
+            mp.check_tx(b"not-a-kv-tx")
+
+    def test_too_large(self):
+        mp, _ = make_mempool(max_tx_bytes=10)
+        with pytest.raises(ErrTxTooLarge):
+            mp.check_tx(b"k=" + b"v" * 20)
+
+    def test_full(self):
+        mp, _ = make_mempool(size=2)
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        with pytest.raises(ErrMempoolIsFull):
+            mp.check_tx(b"c=3")
+
+    def test_reap_order_and_bounds(self):
+        mp, _ = make_mempool()
+        for i in range(10):
+            mp.check_tx(b"k%d=%d" % (i, i))
+        txs = mp.reap_max_bytes_max_gas(-1, -1)
+        assert txs == [b"k%d=%d" % (i, i) for i in range(10)]
+        # each tx is 4-6 bytes + 2 overhead; cap to ~3 txs
+        txs = mp.reap_max_bytes_max_gas(21, -1)
+        assert 1 <= len(txs) <= 3
+        # gas: kvstore wants 1 per tx
+        assert len(mp.reap_max_bytes_max_gas(-1, 4)) == 4
+        assert len(mp.reap_max_txs(2)) == 2
+
+    def test_update_removes_committed_and_rechecks(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        mp.lock()
+        try:
+            mp.update(1, [b"a=1"],
+                      [at.ExecTxResult(code=at.CODE_TYPE_OK)])
+        finally:
+            mp.unlock()
+        assert mp.size() == 1
+        assert [e.tx for e in mp.entries()] == [b"b=2"]
+        # committed tx stays cached (never re-admitted)
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"a=1")
+
+    def test_update_failed_tx_can_be_resubmitted(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"a=1")
+        mp.lock()
+        try:
+            mp.update(1, [b"a=1"], [at.ExecTxResult(code=7)])
+        finally:
+            mp.unlock()
+        assert mp.size() == 0
+        mp.check_tx(b"a=1")  # cache was cleared for the failed tx
+        assert mp.size() == 1
+
+    def test_txs_available_notification(self):
+        mp, _ = make_mempool()
+        mp.enable_txs_available()
+        ev = mp.txs_available()
+        assert not ev.is_set()
+        mp.check_tx(b"a=1")
+        assert ev.is_set()
+
+    def test_senders_tracked(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"a=1", sender="peer1")
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"a=1", sender="peer2")
+        entry = mp.entries()[0]
+        assert entry.senders == {"peer1", "peer2"}
+
+    def test_entries_after_seq(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"a=1")
+        seq1 = mp.entries()[0].seq
+        mp.check_tx(b"b=2")
+        later = mp.entries_after(seq1)
+        assert [e.tx for e in later] == [b"b=2"]
+        assert mp.wait_for_txs(0, timeout=0.1)
+
+    def test_flush(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"a=1")
+        mp.flush()
+        assert mp.size() == 0 and mp.size_bytes() == 0
+        mp.check_tx(b"a=1")  # cache reset too
