@@ -1,0 +1,325 @@
+"""Crash recovery: WAL replay + ABCI handshake
+(reference internal/consensus/replay.go).
+
+Two layers:
+1. catchup_replay — replay the tail of the consensus WAL (messages
+   after EndHeight(h-1)) through the state machine so it resumes
+   mid-height exactly where it crashed.
+2. Handshaker — compare the app's height (ABCI Info) with the block
+   store and replay whole blocks into the app until they agree,
+   InitChain-ing from genesis when the app is empty.
+"""
+
+from __future__ import annotations
+
+from ..abci import types as at
+from ..crypto import merkle
+from ..state.execution import (
+    BlockExecutor, update_state, validate_validator_updates,
+)
+from ..state.state import State
+from ..types.block import BlockID
+from ..types.validator_set import Validator, ValidatorSet
+from . import messages as msgs
+from .wal import (
+    EndHeightMessage, EventRoundState, MsgInfo, TimeoutInfo,
+)
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class ErrAppBlockHeightTooHigh(HandshakeError):
+    pass
+
+
+class ErrAppBlockHeightTooLow(HandshakeError):
+    pass
+
+
+# -- WAL catch-up ------------------------------------------------------------
+
+def catchup_replay(cs, cs_height: int) -> None:
+    """Replay WAL messages for the in-flight height (replay.go:95)."""
+    if cs.wal is None:
+        return
+    found, _ = cs.wal.search_for_end_height(cs_height)
+    if found:
+        raise HandshakeError(
+            f"WAL should not contain EndHeight {cs_height}")
+
+    if cs_height < cs.state.initial_height:
+        raise HandshakeError(
+            f"cannot replay height {cs_height} below initial height")
+    end_height = cs_height - 1
+    if cs_height == cs.state.initial_height:
+        end_height = 0
+    found, tail = cs.wal.search_for_end_height(end_height)
+    if not found and end_height > 0:
+        raise HandshakeError(
+            f"WAL does not contain EndHeight for {end_height}")
+
+    for timed in tail:
+        read_replay_message(cs, timed.msg)
+
+
+def read_replay_message(cs, msg) -> None:
+    """replay.go readReplayMessage."""
+    if isinstance(msg, EventRoundState):
+        return  # informational marker
+    if isinstance(msg, MsgInfo):
+        inner = msgs.unwrap_message(msg.msg_bytes)
+        cs.process_wal_message(inner, msg.peer_id)
+    elif isinstance(msg, TimeoutInfo):
+        with cs._mtx:
+            cs.replay_mode = True
+            try:
+                cs._handle_timeout(msg)
+            finally:
+                cs.replay_mode = False
+    elif isinstance(msg, EndHeightMessage):
+        return
+    else:
+        raise HandshakeError(f"unknown WAL message {type(msg)}")
+
+
+# -- stateless block replay ---------------------------------------------------
+
+def exec_commit_block(app_conn, block, state_store, initial_height: int,
+                      syncing_to_height: int) -> bytes:
+    """FinalizeBlock + Commit without touching consensus state
+    (state/execution.go ExecCommitBlock) — used to catch the app up on
+    already-committed history."""
+    commit_info = at.CommitInfo()
+    if block.header.height > initial_height:
+        last_vals = state_store.load_validators(block.header.height - 1)
+        commit = block.last_commit
+        commit_info = at.CommitInfo(
+            round=commit.round,
+            votes=[at.VoteInfo(
+                validator=at.Validator(address=v.address,
+                                       power=v.voting_power),
+                block_id_flag=commit.signatures[i].block_id_flag)
+                for i, v in enumerate(last_vals.validators)])
+    resp = app_conn.finalize_block(at.FinalizeBlockRequest(
+        hash=block.hash(),
+        next_validators_hash=block.header.next_validators_hash,
+        proposer_address=block.header.proposer_address,
+        height=block.header.height,
+        time=block.header.time,
+        decided_last_commit=commit_info,
+        txs=list(block.data.txs),
+        syncing_to_height=syncing_to_height,
+    ))
+    if len(resp.tx_results) != len(block.data.txs):
+        raise HandshakeError("app returned wrong number of tx results")
+    app_conn.commit()
+    return resp.app_hash
+
+
+class _StoredResponseApp:
+    """Mock consensus conn that serves the persisted
+    FinalizeBlockResponse (replay_stubs.go newMockProxyApp): used when
+    the app already committed the block but our state save was lost."""
+
+    def __init__(self, resp: at.FinalizeBlockResponse):
+        self._resp = resp
+
+    def finalize_block(self, req):
+        return self._resp
+
+    def commit(self):
+        return at.CommitResponse()
+
+
+class _NopMempoolStub:
+    def pre_update(self):
+        pass
+
+    def lock(self):
+        pass
+
+    def unlock(self):
+        pass
+
+    def flush_app_conn(self):
+        pass
+
+    def update(self, *a, **k):
+        pass
+
+
+class Handshaker:
+    """replay.go:242 Handshaker."""
+
+    def __init__(self, state_store, state: State, block_store, genesis,
+                 event_bus=None):
+        self.state_store = state_store
+        self.initial_state = state
+        self.store = block_store
+        self.genesis = genesis
+        self.event_bus = event_bus
+        self.n_blocks = 0
+
+    def handshake(self, app_conns) -> bytes:
+        """ABCI Info -> ReplayBlocks (replay.go Handshake)."""
+        res = app_conns.query.info(at.InfoRequest())
+        block_height = res.last_block_height
+        if block_height < 0:
+            raise HandshakeError(f"got negative last block height "
+                                 f"{block_height} from app")
+        app_hash = res.last_block_app_hash
+        app_hash = self.replay_blocks(self.initial_state, app_hash,
+                                      block_height, app_conns)
+        return app_hash
+
+    def replay_blocks(self, state: State, app_hash: bytes,
+                      app_block_height: int, app_conns) -> bytes:
+        """replay.go:284."""
+        store_base = self.store.base()
+        store_height = self.store.height()
+        state_height = state.last_block_height
+
+        if app_block_height == 0:
+            validators = [Validator(gv.pub_key, gv.power)
+                          for gv in self.genesis.validators]
+            import json as _json
+            app_state_bytes = b""
+            if self.genesis.app_state is not None:
+                app_state_bytes = _json.dumps(
+                    self.genesis.app_state).encode()
+            res = app_conns.consensus.init_chain(at.InitChainRequest(
+                time=self.genesis.genesis_time,
+                chain_id=self.genesis.chain_id,
+                initial_height=self.genesis.initial_height,
+                consensus_params=self.genesis.consensus_params.to_proto(),
+                validators=[at.ValidatorUpdate(
+                    power=v.voting_power,
+                    pub_key_bytes=v.pub_key.bytes(),
+                    pub_key_type=v.pub_key.type()) for v in validators],
+                app_state_bytes=app_state_bytes,
+            ))
+            app_hash = res.app_hash
+
+            if state_height == 0:
+                if res.app_hash:
+                    state.app_hash = res.app_hash
+                if res.validators:
+                    vals = validate_validator_updates(
+                        res.validators, state.consensus_params.validator)
+                    state.validators = ValidatorSet(
+                        [v.copy() for v in vals])
+                    nxt = ValidatorSet([v.copy() for v in vals])
+                    nxt.increment_proposer_priority(1)
+                    state.next_validators = nxt
+                elif not self.genesis.validators:
+                    raise HandshakeError(
+                        "validator set is nil in genesis and still empty "
+                        "after InitChain")
+                if res.consensus_params:
+                    state.consensus_params = state.consensus_params \
+                        .merge_proto_updates(res.consensus_params)
+                state.last_results_hash = merkle.hash_from_byte_slices([])
+                self.state_store.save(state)
+
+        # edge cases on store height/base (replay.go:364-390)
+        if store_height == 0:
+            _assert_app_hash(app_hash, state.app_hash)
+            return app_hash
+        if app_block_height == 0 and state.initial_height < store_base:
+            raise ErrAppBlockHeightTooLow(
+                f"app height {app_block_height} < store base {store_base}")
+        if 0 < app_block_height < store_base - 1:
+            raise ErrAppBlockHeightTooLow(
+                f"app height {app_block_height} < store base {store_base}")
+        if store_height < app_block_height:
+            raise ErrAppBlockHeightTooHigh(
+                f"app height {app_block_height} > store height "
+                f"{store_height}")
+        if store_height < state_height:
+            raise HandshakeError(
+                f"state height {state_height} > store height "
+                f"{store_height}")
+        if store_height > state_height + 1:
+            raise HandshakeError(
+                f"store height {store_height} > state height + 1")
+
+        if store_height == state_height:
+            if app_block_height < store_height:
+                return self._replay_blocks(state, app_conns,
+                                           app_block_height, store_height,
+                                           mutate_state=False)
+            _assert_app_hash(app_hash, state.app_hash)
+            return app_hash
+
+        # store is one block ahead of the state
+        if app_block_height < state_height:
+            return self._replay_blocks(state, app_conns, app_block_height,
+                                       store_height, mutate_state=True)
+        if app_block_height == state_height:
+            # app and state agree; replay the stored block for real
+            state = self._replay_block(state, store_height,
+                                       app_conns.consensus)
+            return state.app_hash
+        if app_block_height == store_height:
+            # app committed the block; reconstruct our state from the
+            # saved response without re-executing
+            raw = self.state_store.load_finalize_block_response(
+                store_height)
+            if raw is None:
+                raise HandshakeError(
+                    f"no saved FinalizeBlockResponse at {store_height}")
+            resp = at.FinalizeBlockResponse.from_proto(raw)
+            if not resp.app_hash:
+                resp.app_hash = app_hash
+            state = self._replay_block(state, store_height,
+                                       _StoredResponseApp(resp))
+            return state.app_hash
+
+        raise HandshakeError(
+            f"uncovered case: app {app_block_height}, store "
+            f"{store_height}, state {state_height}")
+
+    def _replay_blocks(self, state: State, app_conns,
+                       app_block_height: int, store_height: int,
+                       mutate_state: bool) -> bytes:
+        """Catch the app up on stored blocks (replay.go:452)."""
+        app_hash = b""
+        final = store_height - 1 if mutate_state else store_height
+        first = app_block_height + 1
+        if first == 1:
+            first = state.initial_height
+        for h in range(first, final + 1):
+            block = self.store.load_block(h)
+            app_hash = exec_commit_block(
+                app_conns.consensus, block, self.state_store,
+                self.genesis.initial_height, store_height)
+            self.n_blocks += 1
+        if mutate_state:
+            state = self._replay_block(state, store_height,
+                                       app_conns.consensus)
+            app_hash = state.app_hash
+        _assert_app_hash(app_hash, state.app_hash)
+        return app_hash
+
+    def _replay_block(self, state: State, height: int,
+                      consensus_conn) -> State:
+        """ApplyBlock on the stored block (replay.go:529)."""
+        block = self.store.load_block(height)
+        meta = self.store.load_block_meta(height)
+        block_exec = BlockExecutor(self.state_store, consensus_conn,
+                                   _NopMempoolStub(),
+                                   block_store=self.store,
+                                   event_bus=self.event_bus)
+        new_state = block_exec.apply_block(state, meta.block_id, block,
+                                           block.header.height)
+        self.n_blocks += 1
+        return new_state
+
+
+def _assert_app_hash(app_hash: bytes, state_app_hash: bytes) -> None:
+    if app_hash != state_app_hash:
+        raise HandshakeError(
+            f"app hash {app_hash.hex()} does not match state app hash "
+            f"{state_app_hash.hex()}")

@@ -142,7 +142,7 @@ class BitArray:
             elif f == 2 and w == pw.BYTES:
                 elems = r.read_packed_uint64()
             elif f == 2 and w == pw.VARINT:
-                elems.append(r.read_int())
+                elems.append(r.read_uvarint() & pw.MASK64)
             else:
                 r.skip(w)
         # DoS bound: the declared size is attacker-controlled gossip input

@@ -73,13 +73,17 @@ class Condition:
                 if str(self.value) in v:
                     return True
             else:  # ordered comparison
-                if isinstance(self.value, float):
+                try:
+                    t: float | str = float(self.value)
+                    numeric = True
+                except (TypeError, ValueError):
+                    numeric = False
+                if numeric:
                     # numeric operand: non-numeric values never match
                     try:
                         x: float | str = float(v)
                     except ValueError:
                         continue
-                    t: float | str = self.value
                 else:
                     # DATE/TIME operand: ISO-8601 sorts correctly as text
                     x, t = str(v), str(self.value)

@@ -101,7 +101,9 @@ class CListMempool:
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_enabled = False
-        self._change_cond = threading.Condition()
+        # shares _mtx so notify (under _mtx) and wait (which reads the
+        # tx map) cannot deadlock on two locks taken in opposite order
+        self._change_cond = threading.Condition(self._mtx)
 
     # -- locking (execution.go Commit holds this across app Commit) -------
     def lock(self) -> None:

@@ -29,10 +29,45 @@ MAX_COMMIT_SIG_BYTES = 109
 
 
 def max_data_bytes(max_bytes: int, ev_size: int, n_vals: int) -> int:
-    """types/block.go MaxDataBytes."""
-    return (max_bytes - MAX_OVERHEAD_FOR_BLOCK - MAX_HEADER_BYTES
+    """types/block.go MaxDataBytes (panics on negative, as the
+    reference does — it means block.max_bytes is misconfigured)."""
+    cap_ = (max_bytes - MAX_OVERHEAD_FOR_BLOCK - MAX_HEADER_BYTES
             - MAX_COMMIT_OVERHEAD_BYTES
             - n_vals * MAX_COMMIT_SIG_BYTES - ev_size)
+    if cap_ < 0:
+        raise InvalidBlockError(
+            f"negative MaxDataBytes: block.max_bytes {max_bytes} is too "
+            f"small for {n_vals} validators + {ev_size} evidence bytes")
+    return cap_
+
+
+def tx_pre_check(state: State):
+    """sm.TxPreCheck: reject txs larger than fits an empty block
+    (state/tx_filter.go PreCheckMaxBytes)."""
+    max_bytes = state.consensus_params.block.max_bytes
+    if max_bytes == -1:
+        max_bytes = MAX_BLOCK_SIZE_BYTES
+    data_cap = max_data_bytes(max_bytes, 0, state.validators.size())
+
+    def pre_check(tx: bytes) -> None:
+        size = _proto_size(len(tx))
+        if size > data_cap:
+            raise ValueError(
+                f"tx size {size} exceeds max data bytes {data_cap}")
+    return pre_check
+
+
+def tx_post_check(state: State):
+    """sm.TxPostCheck: reject txs wanting more than the block gas
+    (state/tx_filter.go PostCheckMaxGas)."""
+    max_gas = state.consensus_params.block.max_gas
+
+    def post_check(tx: bytes, res) -> None:
+        if max_gas > -1 and res.gas_wanted > max_gas:
+            raise ValueError(
+                f"gas wanted {res.gas_wanted} exceeds block max gas "
+                f"{max_gas}")
+    return post_check
 
 
 class NopEvidencePool:
@@ -213,7 +248,9 @@ class BlockExecutor:
             self.mempool.flush_app_conn()
             res = self.proxy_app.commit()
             self.mempool.update(block.header.height, list(block.data.txs),
-                                abci_response.tx_results)
+                                abci_response.tx_results,
+                                pre_check=tx_pre_check(state),
+                                post_check=tx_post_check(state))
             return res.retain_height
         finally:
             self.mempool.unlock()

@@ -1,0 +1,276 @@
+"""Consensus state machine: single-node commits, multi-validator
+in-process network, WAL recording
+(reference internal/consensus/state_test.go, common_test.go).
+
+The multi-node harness bridges ConsensusState listeners directly —
+the in-memory analog of the reference's mock p2p switch."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.consensus import messages as msgs
+from cometbft_tpu.consensus.round_types import (
+    STEP_NEW_HEIGHT, HeightVoteSet,
+)
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.state import \
+    test_consensus_config as _test_config
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage, MsgInfo
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.store.kv import MemDB
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.timestamp import Timestamp
+
+CHAIN = "cs-chain"
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+def make_node(priv, genesis, tmp_path=None, name="node"):
+    """One in-process consensus node over a kvstore app."""
+    state = make_genesis_state(genesis)
+    app = KVStoreApplication()
+    client = LocalClient(app)
+    client.init_chain(at.InitChainRequest(chain_id=genesis.chain_id,
+                                          initial_height=1))
+    mempool = CListMempool(client)
+    state_store = StateStore(MemDB())
+    state_store.bootstrap(state)
+    block_store = BlockStore(MemDB())
+    bus = ev.EventBus()
+    block_exec = BlockExecutor(state_store, client, mempool,
+                               block_store=block_store, event_bus=bus)
+    wal = None
+    if tmp_path is not None:
+        wal = WAL(str(tmp_path / f"{name}-wal" / "wal"))
+    pv = FilePV(priv)
+    cs = ConsensusState(_test_config(), state, block_exec,
+                        block_store, wal=wal, priv_validator=pv,
+                        event_bus=bus, mempool=mempool)
+    cs.app = app
+    cs.mempool_ = mempool
+    return cs
+
+
+def make_genesis(privs, power=10):
+    return GenesisDoc(
+        chain_id=CHAIN, genesis_time=GENESIS_TIME,
+        validators=[GenesisValidator(pub_key=p.pub_key(), power=power)
+                    for p in privs])
+
+
+def bridge(nodes):
+    """Wire consensus states together: every processed proposal /
+    block part / vote is re-delivered to all other nodes (in-memory
+    gossip; reference common_test.go wires a mock switch)."""
+    def make_listener(src):
+        def listener(kind, cs, data):
+            if kind == "proposal":
+                out = msgs.ProposalMessage(data)
+            elif kind == "block_part":
+                out = data
+            elif kind == "vote":
+                out = msgs.VoteMessage(data)
+            else:
+                return
+            for other in nodes:
+                if other is not src:
+                    other.add_peer_message(out, f"peer-{id(src)}")
+        return listener
+    for n in nodes:
+        n.listeners.append(make_listener(n))
+
+
+def wait_for_height(cs, height, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with cs._mtx:
+            if cs.height >= height:
+                return True
+        time.sleep(0.01)
+    return False
+
+
+class TestSingleValidator:
+    def test_commits_blocks_alone(self, tmp_path):
+        priv = PrivKey.generate(b"\x01" * 32)
+        cs = make_node(priv, make_genesis([priv]), tmp_path)
+        sub = cs.event_bus.subscribe(
+            "t", ev.query_for_event(ev.EVENT_NEW_BLOCK))
+        cs.start()
+        try:
+            assert wait_for_height(cs, 4), \
+                f"stuck at {cs.height}/{cs.round}/{cs.step}"
+        finally:
+            cs.stop()
+        m1 = sub.next(timeout=1)
+        assert m1.data.block.header.height == 1
+        # committed blocks are persisted with their seen commits
+        assert cs.block_store.height() >= 3
+        c = cs.block_store.load_seen_commit(2)
+        assert c is not None and c.height == 2
+        # LastCommit of block 3 carries the height-2 precommit
+        b3 = cs.block_store.load_block(3)
+        assert b3.last_commit.height == 2
+        assert len(b3.last_commit.signatures) == 1
+
+    def test_txs_flow_into_blocks(self, tmp_path):
+        priv = PrivKey.generate(b"\x02" * 32)
+        cs = make_node(priv, make_genesis([priv]), tmp_path)
+        cs.mempool_.check_tx(b"alpha=1")
+        cs.start()
+        try:
+            assert wait_for_height(cs, 3)
+        finally:
+            cs.stop()
+        found = any(
+            b"alpha=1" in (cs.block_store.load_block(h).data.txs or [])
+            for h in range(1, cs.block_store.height() + 1))
+        assert found
+        assert cs.app.kv.get("alpha") == "1"
+
+    def test_wal_records_end_heights(self, tmp_path):
+        priv = PrivKey.generate(b"\x03" * 32)
+        cs = make_node(priv, make_genesis([priv]), tmp_path)
+        cs.start()
+        try:
+            assert wait_for_height(cs, 3)
+        finally:
+            cs.stop()
+        found, tail = cs.wal.search_for_end_height(1)
+        assert found
+        replayed = cs.wal.replay()
+        end_heights = [m.msg.height for m in replayed
+                       if isinstance(m.msg, EndHeightMessage)]
+        assert 1 in end_heights and 2 in end_heights
+        # every own message was WAL'd before processing
+        assert any(isinstance(m.msg, MsgInfo) and m.msg.peer_id == ""
+                   for m in replayed)
+
+
+class TestMultiValidator:
+    def test_four_validators_commit(self, tmp_path):
+        privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+        genesis = make_genesis(privs)
+        nodes = [make_node(p, genesis, None, f"n{i}")
+                 for i, p in enumerate(privs)]
+        bridge(nodes)
+        for n in nodes:
+            n.start()
+        try:
+            for n in nodes:
+                assert wait_for_height(n, 3, timeout=60), \
+                    f"node stuck at {n.height}/{n.round}/{n.step}"
+        finally:
+            for n in nodes:
+                n.stop()
+        # all nodes committed identical blocks
+        h1_hashes = {n.block_store.load_block(1).hash() for n in nodes}
+        h2_hashes = {n.block_store.load_block(2).hash() for n in nodes}
+        assert len(h1_hashes) == 1 and len(h2_hashes) == 1
+        # commits carry signatures from (at least a quorum of) validators
+        c = nodes[0].block_store.load_seen_commit(1)
+        n_signed = sum(1 for s in c.signatures if s.signature)
+        assert n_signed >= 3
+
+    def test_three_of_four_still_commit(self, tmp_path):
+        """One silent validator: the other three (power 30/40) still
+        have +2/3 and make progress."""
+        privs = [PrivKey.generate(bytes([i + 10]) * 32) for i in range(4)]
+        genesis = make_genesis(privs)
+        # node 3 exists but never starts (its votes never appear)
+        nodes = [make_node(p, genesis, None, f"m{i}")
+                 for i, p in enumerate(privs[:3])]
+        bridge(nodes)
+        for n in nodes:
+            n.start()
+        try:
+            for n in nodes:
+                assert wait_for_height(n, 3, timeout=90), \
+                    f"node stuck at {n.height}/{n.round}/{n.step}"
+        finally:
+            for n in nodes:
+                n.stop()
+
+
+class TestHeightVoteSet:
+    def test_round_tracking(self):
+        privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+        from tests.helpers import valset_from_privs
+        vals = valset_from_privs(privs)
+        hvs = HeightVoteSet(CHAIN, 5, vals)
+        assert hvs.prevotes(0) is not None
+        assert hvs.prevotes(3) is None
+        hvs.set_round(2)
+        assert hvs.prevotes(2) is not None
+
+    def test_peer_catchup_round_limit(self):
+        from cometbft_tpu.consensus.round_types import (
+            ErrGotVoteFromUnwantedRound,
+        )
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.vote import PREVOTE_TYPE, Vote
+        from tests.helpers import valset_from_privs
+        privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+        vals = valset_from_privs(privs)
+        hvs = HeightVoteSet(CHAIN, 5, vals)
+        bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+
+        def vote_for_round(priv, r):
+            idx, _ = vals.get_by_address(priv.pub_key().address())
+            v = Vote(type=PREVOTE_TYPE, height=5, round=r, block_id=bid,
+                     timestamp=Timestamp(1, 0),
+                     validator_address=priv.pub_key().address(),
+                     validator_index=idx)
+            v.signature = priv.sign(v.sign_bytes(CHAIN))
+            return v
+
+        assert hvs.add_vote(vote_for_round(privs[0], 7), "peerX")
+        assert hvs.add_vote(vote_for_round(privs[0], 9), "peerX")
+        with pytest.raises(ErrGotVoteFromUnwantedRound):
+            hvs.add_vote(vote_for_round(privs[0], 11), "peerX")
+
+
+class TestMessagesWire:
+    def test_roundtrip_all(self):
+        from cometbft_tpu.libs.bits import BitArray
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.part_set import PartSet
+        from cometbft_tpu.types.vote import Proposal, Vote
+
+        bid = BlockID(b"\x01" * 32, PartSetHeader(2, b"\x02" * 32))
+        ba = BitArray.from_bools([1, 0, 1])
+        ps = PartSet.from_data(b"x" * 100)
+        cases = [
+            msgs.NewRoundStepMessage(5, 1, 3, 10, 0),
+            msgs.NewValidBlockMessage(5, 1, bid.part_set_header, ba, True),
+            msgs.ProposalMessage(Proposal(height=5, round=1, pol_round=-1,
+                                          block_id=bid,
+                                          timestamp=Timestamp(9, 1),
+                                          signature=b"s" * 64)),
+            msgs.ProposalPOLMessage(5, 0, ba),
+            msgs.BlockPartMessage(5, 1, ps.get_part(0)),
+            msgs.VoteMessage(Vote(height=5, validator_index=2,
+                                  validator_address=b"a" * 20,
+                                  signature=b"s" * 64)),
+            msgs.HasVoteMessage(5, 1, 1, 2),
+            msgs.VoteSetMaj23Message(5, 1, 2, bid),
+            msgs.VoteSetBitsMessage(5, 1, 2, bid, ba),
+            msgs.HasProposalBlockPartMessage(5, 1, 0),
+        ]
+        for m in cases:
+            wire = msgs.wrap_message(m)
+            back = msgs.unwrap_message(wire)
+            assert type(back) is type(m)
+            assert msgs.wrap_message(back) == wire

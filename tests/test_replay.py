@@ -1,0 +1,191 @@
+"""Crash recovery: ABCI handshake block replay + consensus WAL catchup
+(reference internal/consensus/replay_test.go).
+
+Simulates the real crash windows: app behind store (lost app state),
+crash between block save and apply (store ahead of state), and a crash
+mid-height (WAL tail replay).
+"""
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.replay import (
+    Handshaker, HandshakeError, catchup_replay,
+)
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.state import \
+    test_consensus_config as _test_config
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.libs import fail
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.store.kv import MemDB
+from cometbft_tpu.types import events as ev
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.timestamp import Timestamp
+
+from tests.test_consensus import make_genesis, wait_for_height
+
+
+class AppConnsStub:
+    def __init__(self, client):
+        self.consensus = client
+        self.mempool = client
+        self.query = client
+        self.snapshot = client
+
+
+class NodeEnv:
+    """Persistent stores + fresh runtime pieces, so we can 'restart'."""
+
+    def __init__(self, tmp_path, seed=b"\x05"):
+        self.priv = PrivKey.generate(seed * 32)
+        self.genesis = make_genesis([self.priv])
+        self.state_db = MemDB()
+        self.block_db = MemDB()
+        self.wal_path = str(tmp_path / "wal" / "wal")
+        self.app = KVStoreApplication()
+
+    def boot(self, fresh_app=False):
+        """Build a consensus state over the persistent stores."""
+        if fresh_app:
+            self.app = KVStoreApplication()
+        client = LocalClient(self.app)
+        state_store = StateStore(self.state_db)
+        block_store = BlockStore(self.block_db)
+        state = state_store.load()
+        if state is None:
+            state = make_genesis_state(self.genesis)
+            state_store.bootstrap(state)
+        conns = AppConnsStub(client)
+        # handshake replays the app up to the store height
+        hs = Handshaker(state_store, state, block_store, self.genesis)
+        hs.handshake(conns)
+        state = state_store.load() or state
+
+        mempool = CListMempool(client)
+        bus = ev.EventBus()
+        block_exec = BlockExecutor(state_store, client, mempool,
+                                   block_store=block_store, event_bus=bus)
+        wal = WAL(self.wal_path)
+        cs = ConsensusState(_test_config(), state, block_exec, block_store,
+                            wal=wal, priv_validator=FilePV(self.priv),
+                            event_bus=bus, mempool=mempool)
+        cs.handshaker = hs
+        return cs
+
+
+class TestHandshake:
+    def test_genesis_handshake_initchains(self, tmp_path):
+        env = NodeEnv(tmp_path)
+        cs = env.boot()
+        assert env.app.height == 0
+        assert cs.height == 1
+        cs.wal.close()
+
+    def test_app_behind_store_is_replayed(self, tmp_path):
+        env = NodeEnv(tmp_path)
+        cs = env.boot()
+        cs.mempool.check_tx(b"k1=v1")
+        cs.start()
+        try:
+            assert wait_for_height(cs, 4)
+        finally:
+            cs.stop()
+            cs.wal.close()
+        committed = env.app.height
+        assert committed >= 3
+
+        # "crash" with total app-state loss: fresh app, same stores
+        cs2 = env.boot(fresh_app=True)
+        # handshake replayed every committed block into the fresh app
+        assert env.app.height == cs2.block_store.height()
+        assert env.app.kv.get("k1") == "v1"
+        assert cs2.height == cs2.block_store.height() + 1
+        cs2.wal.close()
+
+    def test_crash_between_save_and_apply(self, tmp_path):
+        """Block saved + WAL EndHeight written, state/app not updated:
+        the handshake replays the stored block through the real app."""
+        env = NodeEnv(tmp_path)
+        cs = env.boot()
+
+        crash_at = {"armed": False}
+
+        def crash_cb(idx, name):
+            if name == "cs-after-wal-endheight" and \
+                    cs.block_store.height() >= 2:
+                crash_at["armed"] = True
+                raise RuntimeError("simulated crash")
+
+        fail.set_callback(crash_cb)
+        try:
+            cs.start()
+            import time
+            deadline = time.monotonic() + 30
+            while not crash_at["armed"] and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert crash_at["armed"], "crash point never hit"
+        finally:
+            fail.reset()
+            cs.stop()
+            cs.wal.close()
+
+        store_h = cs.block_store.height()
+        state_h = StateStore(env.state_db).load().last_block_height
+        assert store_h == state_h + 1  # the crash window
+
+        cs2 = env.boot()
+        # handshake healed: state caught up to the store
+        state_h2 = StateStore(env.state_db).load().last_block_height
+        assert state_h2 == store_h
+        assert env.app.height == store_h
+        cs2.wal.close()
+
+    def test_restart_continues_chain(self, tmp_path):
+        env = NodeEnv(tmp_path)
+        cs = env.boot()
+        cs.start()
+        try:
+            assert wait_for_height(cs, 3)
+        finally:
+            cs.stop()
+            cs.wal.close()
+        h_before = cs.block_store.height()
+
+        cs2 = env.boot()
+        catchup_replay(cs2, cs2.height)
+        cs2.start()
+        try:
+            assert wait_for_height(cs2, h_before + 2)
+        finally:
+            cs2.stop()
+            cs2.wal.close()
+        assert cs2.block_store.height() > h_before
+        # the chain is continuous: every height has a block + commit
+        for h in range(1, cs2.block_store.height() + 1):
+            assert cs2.block_store.load_block(h) is not None
+
+
+class TestCatchupReplay:
+    def test_replay_rejects_endheight_present(self, tmp_path):
+        env = NodeEnv(tmp_path)
+        cs = env.boot()
+        cs.start()
+        try:
+            assert wait_for_height(cs, 3)
+        finally:
+            cs.stop()
+            cs.wal.close()
+        cs2 = env.boot()
+        # claiming to be at an already-ended height must fail
+        with pytest.raises(HandshakeError):
+            catchup_replay(cs2, cs2.height - 1)
+        cs2.wal.close()
