@@ -97,6 +97,7 @@ class ConsensusState(BaseService):
         self.evpool = evidence_pool
         self.mempool = mempool
         self.replay_mode = False
+        self.crash_error: Exception | None = None
 
         # event loop plumbing
         self.peer_msg_queue: queue.Queue = queue.Queue(1000)
@@ -157,7 +158,8 @@ class ConsensusState(BaseService):
         self.ticker.stop()
         # poison pill wakes the loop
         self.timeout_queue.put(None)
-        if self._loop_thread is not None:
+        if self._loop_thread is not None and \
+                self._loop_thread is not threading.current_thread():
             self._loop_thread.join(timeout=5)
 
     # -- external input ----------------------------------------------------
@@ -180,9 +182,18 @@ class ConsensusState(BaseService):
             with self._mtx:
                 try:
                     self._dispatch(item)
-                except Exception:
-                    if self.is_running():
-                        raise
+                except Exception as e:
+                    if not self.is_running():
+                        return
+                    # fail LOUD and stop the service: a consensus crash
+                    # must never degrade into silent non-participation
+                    # (the reference panics the process, state.go:810)
+                    self.crash_error = e
+                    import traceback
+                    traceback.print_exc()
+                    threading.Thread(target=self.stop,
+                                     daemon=True).start()
+                    raise
 
     def _next_event(self, timeout: float = 0.1):
         """Timeouts first (they unblock stalls), then internal, then
@@ -265,14 +276,16 @@ class ConsensusState(BaseService):
         if self.height != self.state.last_block_height + 1 and \
                 self.height != self.state.initial_height:
             return
-        if self.step != STEP_NEW_HEIGHT:
-            return
-        if self.height == self.state.initial_height:
-            # first block: propose after timeout_commit (state.go:1034)
-            self._schedule_timeout(self.config.timeout_commit,
-                                   self.height, 0, STEP_NEW_ROUND)
-            return
-        self.enter_propose(self.height, 0)
+        if self.step == STEP_NEW_HEIGHT:
+            if self.height == self.state.initial_height:
+                # first block: propose after timeout_commit (state.go:1034)
+                self._schedule_timeout(self.config.timeout_commit,
+                                       self.height, 0, STEP_NEW_ROUND)
+                return
+            self.enter_propose(self.height, 0)
+        elif self.step == STEP_NEW_ROUND:
+            # waiting for txs inside the round (create_empty_blocks=False)
+            self.enter_propose(self.height, 0)
 
     # -- state transitions -------------------------------------------------
     def update_to_state(self, state) -> None:

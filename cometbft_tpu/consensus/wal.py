@@ -304,6 +304,10 @@ class WAL:
                 if isinstance(m, EndHeightMessage) and m.height == height:
                     return True, msgs[i + 1:] + tail_msgs
             tail_msgs = msgs + tail_msgs
+        if height == 0:
+            # no EndHeight(0) is ever written: the WAL's beginning IS the
+            # height-0 marker, so the whole log is the replay tail
+            return True, tail_msgs
         return False, []
 
     def close(self) -> None:

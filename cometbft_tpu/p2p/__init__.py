@@ -1,0 +1,7 @@
+"""Distributed communication backend: authenticated, multiplexed,
+rate-limited TCP mesh (reference p2p/)."""
+
+from .key import NodeKey, node_id_from_pubkey  # noqa: F401
+from .node_info import NodeInfo  # noqa: F401
+from .base_reactor import Reactor, Envelope  # noqa: F401
+from .switch import Switch  # noqa: F401

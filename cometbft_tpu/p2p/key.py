@@ -1,0 +1,60 @@
+"""Node identity (reference p2p/key.go).
+
+ID = lowercase hex of the Ed25519 pubkey address; persisted as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..crypto import ed25519
+
+
+def node_id_from_pubkey(pub_key) -> str:
+    """p2p.PubKeyToID."""
+    return pub_key.address().hex()
+
+
+class NodeKey:
+    def __init__(self, priv_key):
+        self.priv_key = priv_key
+
+    @property
+    def id(self) -> str:
+        return node_id_from_pubkey(self.priv_key.pub_key())
+
+    def pub_key(self):
+        return self.priv_key.pub_key()
+
+    def sign(self, msg: bytes) -> bytes:
+        return self.priv_key.sign(msg)
+
+    def save_as(self, path: str) -> None:
+        import base64
+        payload = json.dumps({
+            "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                         "value": base64.b64encode(
+                             self.priv_key.bytes()).decode()},
+        }, indent=2)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(payload)
+
+    @staticmethod
+    def load(path: str) -> "NodeKey":
+        import base64
+        with open(path) as f:
+            obj = json.load(f)
+        priv = ed25519.PrivKey(
+            base64.b64decode(obj["priv_key"]["value"]))
+        return NodeKey(priv)
+
+    @staticmethod
+    def load_or_gen(path: str) -> "NodeKey":
+        """p2p.LoadOrGenNodeKey."""
+        if os.path.exists(path):
+            return NodeKey.load(path)
+        nk = NodeKey(ed25519.PrivKey.generate())
+        nk.save_as(path)
+        return nk
