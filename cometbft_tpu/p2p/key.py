@@ -38,7 +38,8 @@ class NodeKey:
                              self.priv_key.bytes()).decode()},
         }, indent=2)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w") as f:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             f.write(payload)
 
     @staticmethod

@@ -127,10 +127,6 @@ class Switch(BaseService):
     def _add_peer_conn(self, conn, node_info: NodeInfo, outbound: bool,
                        persistent: bool = False,
                        socket_addr: str = "") -> Peer:
-        if self.peers.has(node_info.node_id):
-            conn.close()
-            raise SwitchError(f"duplicate peer {node_info.node_id}")
-
         peer_ref: list = [None]
 
         def on_receive(ch_id: int, msg_bytes: bytes) -> None:
@@ -149,12 +145,24 @@ class Switch(BaseService):
         peer = Peer(node_info, mconn, outbound, persistent, socket_addr)
         peer_ref[0] = peer
 
-        for reactor in self.reactors.values():
-            reactor.init_peer(peer)
-        self.peers.add(peer)
-        peer.start()
-        for reactor in self.reactors.values():
-            reactor.add_peer(peer)
+        # reserve the peer slot atomically BEFORE touching reactor
+        # state: a simultaneous cross-dial must not clobber the live
+        # peer's reactor state or leak its connection
+        try:
+            self.peers.add(peer)
+        except ValueError as e:
+            conn.close()
+            raise SwitchError(str(e)) from e
+        try:
+            for reactor in self.reactors.values():
+                reactor.init_peer(peer)
+            peer.start()
+            for reactor in self.reactors.values():
+                reactor.add_peer(peer)
+        except Exception:
+            self.peers.remove(peer)
+            conn.close()
+            raise
         return peer
 
     # -- peer removal ------------------------------------------------------

@@ -1,0 +1,160 @@
+"""Full-network integration: consensus + mempool reactors over real
+TCP switches with encrypted connections
+(reference internal/consensus/reactor_test.go).
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.state import \
+    test_consensus_config as _test_config
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool.reactor import MempoolReactor
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import make_genesis_state
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.blockstore import BlockStore
+from cometbft_tpu.store.kv import MemDB
+from cometbft_tpu.types import events as ev
+
+from tests.test_consensus import make_genesis, wait_for_height
+
+CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30])
+
+
+class P2PNode:
+    """A full node: switch + consensus & mempool reactors + kvstore."""
+
+    def __init__(self, priv, genesis, moniker):
+        self.state = make_genesis_state(genesis)
+        self.app = KVStoreApplication()
+        self.client = LocalClient(self.app)
+        self.client.init_chain(at.InitChainRequest(
+            chain_id=genesis.chain_id, initial_height=1))
+        self.mempool = CListMempool(self.client)
+        state_store = StateStore(MemDB())
+        state_store.bootstrap(self.state)
+        self.block_store = BlockStore(MemDB())
+        self.bus = ev.EventBus()
+        block_exec = BlockExecutor(state_store, self.client, self.mempool,
+                                   block_store=self.block_store,
+                                   event_bus=self.bus)
+        self.cs = ConsensusState(
+            _test_config(), self.state, block_exec, self.block_store,
+            priv_validator=FilePV(priv), event_bus=self.bus,
+            mempool=self.mempool)
+
+        self.node_key = NodeKey(PrivKey.generate())
+        info = NodeInfo(node_id=self.node_key.id,
+                        network=genesis.chain_id, channels=CHANNELS,
+                        moniker=moniker)
+        transport = MultiplexTransport(self.node_key, info)
+        self.switch = Switch(transport, listen_addr="127.0.0.1:0")
+        self.switch.add_reactor("CONSENSUS", ConsensusReactor(self.cs))
+        self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        self.switch.stop()
+
+    @property
+    def addr(self):
+        return f"{self.node_key.id}@{self.switch.bound_addr}"
+
+
+def connect_all(nodes):
+    """Full mesh."""
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            b.switch.dial_peer(a.addr)
+
+
+@pytest.fixture
+def network():
+    privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    genesis = make_genesis(privs)
+    nodes = [P2PNode(p, genesis, f"node{i}")
+             for i, p in enumerate(privs)]
+    for n in nodes:
+        n.start()
+    connect_all(nodes)
+    yield nodes
+    for n in nodes:
+        n.stop()
+
+
+class TestP2PConsensus:
+    def test_network_commits_blocks(self, network):
+        nodes = network
+        for n in nodes:
+            assert wait_for_height(n.cs, 3, timeout=90), \
+                f"stuck at {n.cs.height}/{n.cs.round}/{n.cs.step}"
+        # identical chains
+        h1 = {n.block_store.load_block(1).hash() for n in nodes}
+        h2 = {n.block_store.load_block(2).hash() for n in nodes}
+        assert len(h1) == 1 and len(h2) == 1
+        # commits aggregate votes from a quorum
+        c = nodes[0].block_store.load_seen_commit(1)
+        assert sum(1 for s in c.signatures if s.signature) >= 3
+
+    def test_tx_gossips_and_commits(self, network):
+        nodes = network
+        # submit on ONE node; mempool reactor gossips to the rest
+        nodes[0].mempool.check_tx(b"gossip=works")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(n.mempool.size() > 0 or
+                   n.app.kv.get("gossip") == "works" for n in nodes):
+                break
+            time.sleep(0.05)
+        # the tx must eventually be committed on every node
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if all(n.app.kv.get("gossip") == "works" for n in nodes):
+                break
+            time.sleep(0.05)
+        assert all(n.app.kv.get("gossip") == "works" for n in nodes), \
+            "tx failed to gossip+commit on all nodes"
+
+
+class TestLateJoiner:
+    def test_catchup_via_gossip(self):
+        """A validator that joins late catches up through the consensus
+        reactor's catchup gossip (block parts + commit votes)."""
+        privs = [PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+        genesis = make_genesis(privs)
+        nodes = [P2PNode(p, genesis, f"node{i}")
+                 for i, p in enumerate(privs[:3])]
+        late = P2PNode(privs[3], genesis, "late")
+        for n in nodes:
+            n.start()
+        connect_all(nodes)
+        try:
+            for n in nodes:
+                assert wait_for_height(n.cs, 3, timeout=90)
+            # now the 4th validator joins
+            late.start()
+            for n in nodes:
+                late.switch.dial_peer(n.addr)
+            assert wait_for_height(late.cs, 3, timeout=90), \
+                f"late joiner stuck at {late.cs.height}"
+            assert late.block_store.load_block(1).hash() == \
+                nodes[0].block_store.load_block(1).hash()
+        finally:
+            for n in nodes:
+                n.stop()
+            late.stop()
