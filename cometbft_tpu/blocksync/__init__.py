@@ -1,0 +1,5 @@
+"""Block sync (fast sync): catch up by downloading committed blocks
+from peers (reference internal/blocksync/)."""
+
+from .pool import BlockPool  # noqa: F401
+from .reactor import BlocksyncReactor  # noqa: F401

@@ -1,0 +1,257 @@
+"""BlockPool: schedules block downloads across peers
+(reference internal/blocksync/pool.go).
+
+Keeps a sliding window of in-flight height requests, each owned by a
+requester; blocks are surfaced to the reactor IN ORDER via
+peek_two_blocks (the next block is verified with the following block's
+LastCommit before being applied).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..libs.service import BaseService
+
+REQUEST_INTERVAL = 0.01          # pool.go requestInterval (10ms)
+MAX_PENDING_REQUESTS = 40        # window size
+MAX_PENDING_REQUESTS_PER_PEER = 20
+PEER_TIMEOUT = 15.0              # pool.go peerTimeout
+
+
+class _Peer:
+    def __init__(self, peer_id: str, base: int, height: int):
+        self.id = peer_id
+        self.base = base
+        self.height = height
+        self.num_pending = 0
+        self.timeout_at: float | None = None
+
+    def arm_timeout(self) -> None:
+        if self.timeout_at is None:
+            self.timeout_at = time.monotonic() + PEER_TIMEOUT
+
+    def reset_timeout(self) -> None:
+        """On every delivered block: an actively responsive peer must
+        not expire mid-sync (pool.go decrPending)."""
+        if self.num_pending > 0:
+            self.timeout_at = time.monotonic() + PEER_TIMEOUT
+        else:
+            self.timeout_at = None
+
+    def disarm_if_idle(self) -> None:
+        if self.num_pending == 0:
+            self.timeout_at = None
+
+
+class _Requester:
+    """One in-flight height (pool.go bpRequester)."""
+
+    def __init__(self, height: int):
+        self.height = height
+        self.peer_id: str | None = None
+        self.block = None
+        self.ext_commit = None
+        self.got_block = threading.Event()
+
+
+class BlockPool(BaseService):
+    def __init__(self, start_height: int, send_request,
+                 on_peer_error=None):
+        """send_request(height, peer_id) issues a BlockRequest;
+        on_peer_error(peer_id, reason) reports misbehaving peers."""
+        super().__init__("BlockPool")
+        self._mtx = threading.RLock()
+        self.start_height = start_height
+        self.height = start_height       # next height to sync
+        self._peers: dict[str, _Peer] = {}
+        self._requesters: dict[int, _Requester] = {}
+        self._send_request = send_request
+        self._on_peer_error = on_peer_error or (lambda pid, r: None)
+        self.last_advance = time.monotonic()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_start(self) -> None:
+        self._thread = threading.Thread(target=self._make_requesters_routine,
+                                        name="blockpool", daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        pass
+
+    def _make_requesters_routine(self) -> None:
+        """pool.go:116: keep the request window full; unassigned or
+        failed requesters are re-assigned on every pass (no recursion,
+        no permanent orphans)."""
+        while self.is_running():
+            with self._mtx:
+                pending = len(self._requesters)
+                max_height = self._max_peer_height()
+                next_height = self.height + pending
+                if pending < MAX_PENDING_REQUESTS and \
+                        next_height <= max_height and \
+                        next_height not in self._requesters:
+                    self._requesters[next_height] = _Requester(
+                        next_height)
+                # all unassigned requesters are assignment candidates
+                todo = [r for r in self._requesters.values()
+                        if r.peer_id is None and r.block is None]
+            progressed = False
+            for req in todo:
+                if self._assign_and_send(req):
+                    progressed = True
+            if not progressed:
+                time.sleep(REQUEST_INTERVAL)
+            self._check_timeouts()
+
+    def _assign_and_send(self, req: _Requester,
+                         exclude: str | None = None) -> bool:
+        """Try once; on failure leave the requester unassigned for the
+        next routine pass. Returns True if a request went out."""
+        peer = self._pick_peer(req.height, exclude)
+        if peer is None:
+            return False
+        with self._mtx:
+            req.peer_id = peer.id
+            peer.num_pending += 1
+            peer.arm_timeout()
+        try:
+            self._send_request(req.height, peer.id)
+            return True
+        except Exception:
+            with self._mtx:
+                req.peer_id = None
+                peer.num_pending -= 1
+                peer.disarm_if_idle()
+            return False
+
+    def _pick_peer(self, height: int, exclude: str | None) -> _Peer | None:
+        with self._mtx:
+            candidates = [
+                p for p in self._peers.values()
+                if p.id != exclude and p.base <= height <= p.height
+                and p.num_pending < MAX_PENDING_REQUESTS_PER_PEER]
+            if not candidates:
+                return None
+            return random.choice(candidates)
+
+    def _check_timeouts(self) -> None:
+        now = time.monotonic()
+        with self._mtx:
+            expired = [p for p in self._peers.values()
+                       if p.timeout_at is not None and now > p.timeout_at]
+        for p in expired:
+            self.remove_peer(p.id)
+            self._on_peer_error(p.id, "blocksync request timeout")
+
+    # -- peer management ---------------------------------------------------
+    def set_peer_range(self, peer_id: str, base: int,
+                       height: int) -> None:
+        """From a StatusResponse (pool.go SetPeerRange)."""
+        with self._mtx:
+            p = self._peers.get(peer_id)
+            if p is None:
+                self._peers[peer_id] = _Peer(peer_id, base, height)
+            else:
+                p.base = base
+                p.height = max(p.height, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._peers.pop(peer_id, None)
+            # its in-flight requests go back to the unassigned state;
+            # the requesters routine re-assigns them
+            for r in self._requesters.values():
+                if r.peer_id == peer_id and r.block is None:
+                    r.peer_id = None
+
+    def _redo_request(self, height: int, exclude_peer: str) -> None:
+        """Unassign so the requesters routine refetches from another
+        peer (never recursive)."""
+        with self._mtx:
+            req = self._requesters.get(height)
+            if req is None:
+                return
+            if req.peer_id is not None:
+                p = self._peers.get(req.peer_id)
+                if p is not None:
+                    p.num_pending -= 1
+                    p.disarm_if_idle()
+            req.peer_id = None
+            req.block = None
+            req.ext_commit = None
+
+    def _max_peer_height(self) -> int:
+        with self._mtx:
+            return max((p.height for p in self._peers.values()),
+                       default=0)
+
+    def max_peer_height(self) -> int:
+        return self._max_peer_height()
+
+    # -- block intake ------------------------------------------------------
+    def add_block(self, peer_id: str, block, ext_commit,
+                  block_size: int) -> None:
+        """pool.go AddBlock."""
+        height = block.header.height
+        with self._mtx:
+            req = self._requesters.get(height)
+            if req is None or req.peer_id != peer_id:
+                # unsolicited block: punish (pool.go:297)
+                self._on_peer_error(
+                    peer_id, f"unsolicited block at height {height}")
+                return
+            req.block = block
+            req.ext_commit = ext_commit
+            req.got_block.set()
+            p = self._peers.get(peer_id)
+            if p is not None:
+                p.num_pending -= 1
+                p.reset_timeout()
+
+    def no_block_response(self, peer_id: str, height: int) -> None:
+        self._redo_request(height, peer_id)
+
+    # -- consumer ----------------------------------------------------------
+    def peek_two_blocks(self):
+        """(first, first_ext_commit, second) at self.height and +1."""
+        with self._mtx:
+            r1 = self._requesters.get(self.height)
+            r2 = self._requesters.get(self.height + 1)
+            first = r1.block if r1 else None
+            ext = r1.ext_commit if r1 else None
+            second = r2.block if r2 else None
+            return first, ext, second
+
+    def pop_request(self) -> None:
+        """The block at self.height was applied (pool.go PopRequest)."""
+        with self._mtx:
+            self._requesters.pop(self.height, None)
+            self.height += 1
+            self.last_advance = time.monotonic()
+
+    def redo_request(self, height: int) -> str | None:
+        """First block failed verification: refetch both from other
+        peers (reactor.go:560). Returns the offending peer id."""
+        with self._mtx:
+            req = self._requesters.get(height)
+            bad_peer = req.peer_id if req else None
+        if bad_peer:
+            self.remove_peer(bad_peer)
+        for h in (height, height + 1):
+            with self._mtx:
+                r = self._requesters.get(h)
+            if r is not None:
+                self._redo_request(h, bad_peer or "")
+        return bad_peer
+
+    def is_caught_up(self) -> bool:
+        """pool.go IsCaughtUp: within one block of the best peer."""
+        with self._mtx:
+            if not self._peers:
+                return False
+            return self.height >= max(
+                p.height for p in self._peers.values())

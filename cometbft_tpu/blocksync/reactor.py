@@ -1,0 +1,193 @@
+"""Blocksync reactor (reference internal/blocksync/reactor.go).
+
+Channel 0x40. Serves stored blocks to catching-up peers; when started
+in sync mode, drives a BlockPool and applies downloaded blocks after
+verifying each with the NEXT block's LastCommit — the TPU-routed
+`verify_commit_light` at reactor.go:546, the second BASELINE hot path.
+On catch-up it hands off to the consensus reactor (SwitchToConsensus).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.base_reactor import Envelope, Reactor
+from ..p2p.conn.connection import ChannelDescriptor
+from ..types.block import BlockID
+from ..types.part_set import PartSet
+from . import messages as bm
+from .pool import BlockPool
+
+BLOCKSYNC_CHANNEL = 0x40
+TRY_SYNC_INTERVAL = 0.01
+STATUS_UPDATE_INTERVAL = 10.0
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(self, state, block_exec, block_store, block_sync: bool,
+                 consensus_reactor=None):
+        super().__init__("BlocksyncReactor")
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.store = block_store
+        self.block_sync = block_sync       # actively syncing?
+        self.consensus_reactor = consensus_reactor
+        self.pool = BlockPool(
+            max(self.store.height() + 1, state.initial_height),
+            self._send_block_request, self._on_peer_error)
+        self._stop_sync = threading.Event()
+        self.synced = not block_sync
+
+    def get_channels(self) -> list:
+        return [ChannelDescriptor(
+            BLOCKSYNC_CHANNEL, priority=5,
+            send_queue_capacity=1000,
+            recv_message_capacity=150 * 1024 * 1024)]
+
+    def on_start(self) -> None:
+        if self.block_sync:
+            self.pool.start()
+            threading.Thread(target=self._pool_routine,
+                             name="blocksync-pool", daemon=True).start()
+
+    def on_stop(self) -> None:
+        self._stop_sync.set()
+        self.pool.stop()
+
+    # -- peer lifecycle ----------------------------------------------------
+    def add_peer(self, peer) -> None:
+        peer.try_send(BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusResponse(
+            height=self.store.height(), base=self.store.base())))
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    # -- plumbing for the pool --------------------------------------------
+    def _send_block_request(self, height: int, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            raise RuntimeError(f"peer {peer_id} gone")
+        if not peer.try_send(BLOCKSYNC_CHANNEL,
+                             bm.wrap(bm.BlockRequest(height))):
+            raise RuntimeError(f"peer {peer_id} send queue full")
+
+    def _on_peer_error(self, peer_id: str, reason: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+
+    # -- receive -----------------------------------------------------------
+    def receive(self, envelope: Envelope) -> None:
+        msg = bm.unwrap(bytes(envelope.message))
+        peer = envelope.src
+        if isinstance(msg, bm.BlockRequest):
+            self._respond_to_block_request(peer, msg.height)
+        elif isinstance(msg, bm.StatusRequest):
+            peer.try_send(BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusResponse(
+                height=self.store.height(), base=self.store.base())))
+        elif isinstance(msg, bm.BlockResponse):
+            if msg.block is not None:
+                self.pool.add_block(peer.id, msg.block, msg.ext_commit,
+                                    len(envelope.message))
+        elif isinstance(msg, bm.StatusResponse):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, bm.NoBlockResponse):
+            self.pool.no_block_response(peer.id, msg.height)
+
+    def _respond_to_block_request(self, peer, height: int) -> None:
+        block = self.store.load_block(height)
+        if block is None:
+            peer.try_send(BLOCKSYNC_CHANNEL,
+                          bm.wrap(bm.NoBlockResponse(height)))
+            return
+        ext = None
+        raw_ext = self.store.load_extended_commit(height)
+        if raw_ext is not None:
+            from ..types.block import ExtendedCommit
+            ext = ExtendedCommit.from_proto(raw_ext) \
+                if isinstance(raw_ext, (bytes, bytearray)) else raw_ext
+        peer.try_send(BLOCKSYNC_CHANNEL,
+                      bm.wrap(bm.BlockResponse(block, ext)))
+
+    # -- sync driver -------------------------------------------------------
+    def _pool_routine(self) -> None:
+        """reactor.go:306 poolRoutine."""
+        last_status = 0.0
+        last_switch_check = 0.0
+        while not self._stop_sync.is_set() and self.is_running():
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL:
+                last_status = now
+                if self.switch is not None:
+                    self.switch.try_broadcast(
+                        BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusRequest()))
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self._maybe_switch_to_consensus():
+                    return
+            if not self._try_sync_one():
+                time.sleep(TRY_SYNC_INTERVAL)
+
+    def _try_sync_one(self) -> bool:
+        """reactor.go:534 processBlock: verify first with second's
+        LastCommit, then apply."""
+        first, first_ext, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+
+        ext_enabled = self.state.consensus_params \
+            .vote_extensions_enabled(first.header.height)
+        if ext_enabled and first_ext is None:
+            # the peer MUST supply the extended commit when extensions
+            # are enabled (reactor.go:540) — refetch from another peer
+            bad = self.pool.redo_request(first.header.height)
+            if bad:
+                self._on_peer_error(bad, "missing extended commit")
+            return False
+
+        parts = PartSet.from_data(first.to_proto())
+        first_id = BlockID(first.hash(), parts.header)
+        try:
+            # HOT PATH: batched signature verification on device
+            self.state.validators.verify_commit_light(
+                self.state.chain_id, first_id, first.header.height,
+                second.last_commit)
+            if ext_enabled:
+                first_ext.ensure_extensions(True)
+            self.block_exec.validate_block(self.state, first)
+        except Exception:
+            bad = self.pool.redo_request(first.header.height)
+            if bad:
+                # evict the peer that served the bad block
+                # (reactor.go:560 StopPeerForError)
+                self._on_peer_error(bad, "served invalid block")
+            return False
+
+        self.pool.pop_request()
+        if ext_enabled:
+            self.store.save_block(first, parts, first_ext.to_commit())
+            self.store.save_extended_commit(first.header.height,
+                                            first_ext.to_proto())
+        else:
+            self.store.save_block(first, parts, second.last_commit)
+        self.state = self.block_exec.apply_verified_block(
+            self.state, first_id, first,
+            syncing_to_height=self.pool.max_peer_height())
+        return True
+
+    def _maybe_switch_to_consensus(self) -> bool:
+        """reactor.go:520: hand off when caught up."""
+        if self.pool.is_caught_up():
+            self.block_sync = False
+            self.synced = True
+            self._stop_sync.set()
+            self.pool.stop()
+            if self.consensus_reactor is not None:
+                self.consensus_reactor.switch_to_consensus(self.state)
+            return True
+        return False

@@ -251,9 +251,9 @@ class ConsensusReactor(Reactor):
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
         """Blocksync -> consensus handoff (reactor.go:116)."""
-        self.cs.update_to_state(state)
         if state.last_block_height > 0:
             self.cs.reconstruct_last_commit(state)
+        self.cs.update_to_state(state)
         self.wait_sync = False
         self.cs.start()
 

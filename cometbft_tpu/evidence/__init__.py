@@ -1,0 +1,5 @@
+"""Byzantine evidence: detection, verification, pooling, gossip
+(reference internal/evidence/)."""
+
+from .pool import EvidencePool  # noqa: F401
+from .reactor import EvidenceReactor  # noqa: F401

@@ -31,29 +31,38 @@ from cometbft_tpu.types import events as ev
 
 from tests.test_consensus import make_genesis, wait_for_height
 
-CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30])
+CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40])
 
 
 class P2PNode:
-    """A full node: switch + consensus & mempool reactors + kvstore."""
+    """A full node: switch + consensus/mempool/evidence/blocksync
+    reactors over a kvstore app (node/node.go wiring in miniature)."""
 
-    def __init__(self, priv, genesis, moniker):
+    def __init__(self, priv, genesis, moniker, block_sync=False):
+        from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+        from cometbft_tpu.evidence import EvidencePool, EvidenceReactor
+
         self.state = make_genesis_state(genesis)
         self.app = KVStoreApplication()
         self.client = LocalClient(self.app)
         self.client.init_chain(at.InitChainRequest(
             chain_id=genesis.chain_id, initial_height=1))
         self.mempool = CListMempool(self.client)
-        state_store = StateStore(MemDB())
-        state_store.bootstrap(self.state)
+        self.state_store = StateStore(MemDB())
+        self.state_store.bootstrap(self.state)
         self.block_store = BlockStore(MemDB())
         self.bus = ev.EventBus()
-        block_exec = BlockExecutor(state_store, self.client, self.mempool,
+        self.evpool = EvidencePool(MemDB(), self.state_store,
+                                   self.block_store)
+        block_exec = BlockExecutor(self.state_store, self.client,
+                                   self.mempool,
+                                   evidence_pool=self.evpool,
                                    block_store=self.block_store,
                                    event_bus=self.bus)
         self.cs = ConsensusState(
             _test_config(), self.state, block_exec, self.block_store,
-            priv_validator=FilePV(priv), event_bus=self.bus,
+            priv_validator=FilePV(priv) if priv is not None else None,
+            event_bus=self.bus, evidence_pool=self.evpool,
             mempool=self.mempool)
 
         self.node_key = NodeKey(PrivKey.generate())
@@ -62,8 +71,14 @@ class P2PNode:
                         moniker=moniker)
         transport = MultiplexTransport(self.node_key, info)
         self.switch = Switch(transport, listen_addr="127.0.0.1:0")
-        self.switch.add_reactor("CONSENSUS", ConsensusReactor(self.cs))
+        cons_reactor = ConsensusReactor(self.cs, wait_sync=block_sync)
+        self.bcs_reactor = BlocksyncReactor(
+            self.state, block_exec, self.block_store, block_sync,
+            consensus_reactor=cons_reactor)
+        self.switch.add_reactor("CONSENSUS", cons_reactor)
         self.switch.add_reactor("MEMPOOL", MempoolReactor(self.mempool))
+        self.switch.add_reactor("EVIDENCE", EvidenceReactor(self.evpool))
+        self.switch.add_reactor("BLOCKSYNC", self.bcs_reactor)
 
     def start(self):
         self.switch.start()
