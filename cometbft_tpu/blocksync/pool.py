@@ -54,7 +54,7 @@ class _Requester:
         self.peer_id: str | None = None
         self.block = None
         self.ext_commit = None
-        self.got_block = threading.Event()
+        self.excluded: set[str] = set()  # peers that failed this height
 
 
 class BlockPool(BaseService):
@@ -103,18 +103,27 @@ class BlockPool(BaseService):
             for req in todo:
                 if self._assign_and_send(req):
                     progressed = True
+                elif req.excluded and self._peers and \
+                        all(p in req.excluded for p in self._peers):
+                    # every live peer failed this height: forgive so the
+                    # request can cycle rather than wedge
+                    req.excluded.clear()
             if not progressed:
                 time.sleep(REQUEST_INTERVAL)
             self._check_timeouts()
 
-    def _assign_and_send(self, req: _Requester,
-                         exclude: str | None = None) -> bool:
+    def _assign_and_send(self, req: _Requester) -> bool:
         """Try once; on failure leave the requester unassigned for the
         next routine pass. Returns True if a request went out."""
-        peer = self._pick_peer(req.height, exclude)
-        if peer is None:
-            return False
         with self._mtx:
+            candidates = [
+                p for p in self._peers.values()
+                if p.id not in req.excluded
+                and p.base <= req.height <= p.height
+                and p.num_pending < MAX_PENDING_REQUESTS_PER_PEER]
+            if not candidates:
+                return False
+            peer = random.choice(candidates)
             req.peer_id = peer.id
             peer.num_pending += 1
             peer.arm_timeout()
@@ -124,19 +133,12 @@ class BlockPool(BaseService):
         except Exception:
             with self._mtx:
                 req.peer_id = None
-                peer.num_pending -= 1
-                peer.disarm_if_idle()
+                req.excluded.add(peer.id)
+                live = self._peers.get(peer.id)
+                if live is not None:
+                    live.num_pending -= 1
+                    live.disarm_if_idle()
             return False
-
-    def _pick_peer(self, height: int, exclude: str | None) -> _Peer | None:
-        with self._mtx:
-            candidates = [
-                p for p in self._peers.values()
-                if p.id != exclude and p.base <= height <= p.height
-                and p.num_pending < MAX_PENDING_REQUESTS_PER_PEER]
-            if not candidates:
-                return None
-            return random.choice(candidates)
 
     def _check_timeouts(self) -> None:
         now = time.monotonic()
@@ -167,6 +169,7 @@ class BlockPool(BaseService):
             for r in self._requesters.values():
                 if r.peer_id == peer_id and r.block is None:
                     r.peer_id = None
+                    r.excluded.add(peer_id)
 
     def _redo_request(self, height: int, exclude_peer: str) -> None:
         """Unassign so the requesters routine refetches from another
@@ -177,9 +180,13 @@ class BlockPool(BaseService):
                 return
             if req.peer_id is not None:
                 p = self._peers.get(req.peer_id)
-                if p is not None:
+                # only an in-flight request still counts against the
+                # peer; a delivered block was decremented in add_block
+                if p is not None and req.block is None:
                     p.num_pending -= 1
                     p.disarm_if_idle()
+            if exclude_peer:
+                req.excluded.add(exclude_peer)
             req.peer_id = None
             req.block = None
             req.ext_commit = None
@@ -193,8 +200,7 @@ class BlockPool(BaseService):
         return self._max_peer_height()
 
     # -- block intake ------------------------------------------------------
-    def add_block(self, peer_id: str, block, ext_commit,
-                  block_size: int) -> None:
+    def add_block(self, peer_id: str, block, ext_commit) -> None:
         """pool.go AddBlock."""
         height = block.header.height
         with self._mtx:
@@ -204,9 +210,10 @@ class BlockPool(BaseService):
                 self._on_peer_error(
                     peer_id, f"unsolicited block at height {height}")
                 return
+            if req.block is not None:
+                return  # duplicate response: ignore (requester.setBlock)
             req.block = block
             req.ext_commit = ext_commit
-            req.got_block.set()
             p = self._peers.get(peer_id)
             if p is not None:
                 p.num_pending -= 1
@@ -233,20 +240,26 @@ class BlockPool(BaseService):
             self.height += 1
             self.last_advance = time.monotonic()
 
-    def redo_request(self, height: int) -> str | None:
-        """First block failed verification: refetch both from other
-        peers (reactor.go:560). Returns the offending peer id."""
+    def redo_request(self, height: int) -> list[str]:
+        """First block failed verification: the peers that supplied BOTH
+        blocks are suspect (the second's LastCommit drove the failed
+        verify) — remove them and refetch (reactor.go:560-575).
+        Returns the offending peer ids."""
+        bad: list[str] = []
         with self._mtx:
-            req = self._requesters.get(height)
-            bad_peer = req.peer_id if req else None
-        if bad_peer:
-            self.remove_peer(bad_peer)
+            for h in (height, height + 1):
+                req = self._requesters.get(h)
+                if req is not None and req.peer_id:
+                    bad.append(req.peer_id)
+        for pid in bad:
+            self.remove_peer(pid)
         for h in (height, height + 1):
             with self._mtx:
                 r = self._requesters.get(h)
             if r is not None:
-                self._redo_request(h, bad_peer or "")
-        return bad_peer
+                for pid in bad:
+                    self._redo_request(h, pid)
+        return bad
 
     def is_caught_up(self) -> bool:
         """pool.go IsCaughtUp: within one block of the best peer."""

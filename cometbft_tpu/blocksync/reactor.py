@@ -92,8 +92,7 @@ class BlocksyncReactor(Reactor):
                 height=self.store.height(), base=self.store.base())))
         elif isinstance(msg, bm.BlockResponse):
             if msg.block is not None:
-                self.pool.add_block(peer.id, msg.block, msg.ext_commit,
-                                    len(envelope.message))
+                self.pool.add_block(peer.id, msg.block, msg.ext_commit)
         elif isinstance(msg, bm.StatusResponse):
             self.pool.set_peer_range(peer.id, msg.base, msg.height)
         elif isinstance(msg, bm.NoBlockResponse):
@@ -145,9 +144,8 @@ class BlocksyncReactor(Reactor):
         if ext_enabled and first_ext is None:
             # the peer MUST supply the extended commit when extensions
             # are enabled (reactor.go:540) — refetch from another peer
-            bad = self.pool.redo_request(first.header.height)
-            if bad:
-                self._on_peer_error(bad, "missing extended commit")
+            for pid in self.pool.redo_request(first.header.height):
+                self._on_peer_error(pid, "missing extended commit")
             return False
 
         parts = PartSet.from_data(first.to_proto())
@@ -161,11 +159,10 @@ class BlocksyncReactor(Reactor):
                 first_ext.ensure_extensions(True)
             self.block_exec.validate_block(self.state, first)
         except Exception:
-            bad = self.pool.redo_request(first.header.height)
-            if bad:
-                # evict the peer that served the bad block
-                # (reactor.go:560 StopPeerForError)
-                self._on_peer_error(bad, "served invalid block")
+            # evict BOTH suppliers (reactor.go:560 StopPeerForError):
+            # the second block's LastCommit drove the failed verify
+            for pid in self.pool.redo_request(first.header.height):
+                self._on_peer_error(pid, "served invalid block")
             return False
 
         self.pool.pop_request()

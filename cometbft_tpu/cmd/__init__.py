@@ -1,0 +1,1 @@
+"""Operator CLI (reference cmd/cometbft/)."""

@@ -1,0 +1,176 @@
+"""Operator CLI (reference cmd/cometbft/main.go + commands/).
+
+    python -m cometbft_tpu.cmd.main --home ~/.cometbft-tpu init
+    python -m cometbft_tpu.cmd.main --home ~/.cometbft-tpu start
+    ... show-node-id | show-validator | gen-node-key | version |
+        unsafe-reset-all | replay
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+
+SOFTWARE_VERSION = "0.1.0-tpu"
+DEFAULT_HOME = os.path.expanduser("~/.cometbft-tpu")
+
+
+def _load_config(home: str):
+    from ..config import load_config
+    cfg = load_config(home)
+    cfg.base.root_dir = home
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """commands/init.go InitFilesCmd."""
+    from ..config import write_config_file
+    from ..node import init_files
+    cfg = _load_config(args.home)
+    genesis = init_files(cfg, chain_id=args.chain_id)
+    write_config_file(os.path.join(args.home, "config", "config.toml"),
+                      cfg)
+    print(f"Initialized node in {args.home} "
+          f"(chain_id={genesis.chain_id})")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """commands/run_node.go NewRunNodeCmd."""
+    from ..node import Node
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.abci = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+
+    node = Node(cfg, block_sync=args.block_sync)
+    node.start()
+    print(f"Node started: p2p={node.p2p_addr} rpc={node.rpc_addr}")
+
+    stop = {"flag": False}
+
+    def handle(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    try:
+        while not stop["flag"]:
+            node.wait(0.5)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from ..p2p.key import NodeKey
+    cfg = _load_config(args.home)
+    print(NodeKey.load_or_gen(cfg.node_key_file()).id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval import FilePV
+    cfg = _load_config(args.home)
+    pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                 cfg.priv_validator_state_file())
+    import base64
+    print(json.dumps({
+        "type": "tendermint/PubKeyEd25519",
+        "value": base64.b64encode(pv.get_pub_key().bytes()).decode(),
+    }))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from ..crypto import ed25519
+    from ..p2p.key import NodeKey
+    nk = NodeKey(ed25519.PrivKey.generate())
+    print(nk.id)
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go: wipe data, keep the validator key."""
+    cfg = _load_config(args.home)
+    data_dir = cfg.db_dir()
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    from ..privval import FilePV
+    if os.path.exists(cfg.priv_validator_key_file()):
+        pv = FilePV.load(cfg.priv_validator_key_file(),
+                         cfg.priv_validator_state_file())
+        pv.reset()
+    print(f"Reset {data_dir}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(SOFTWARE_VERSION)
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """commands/replay.go: replay the WAL through a fresh consensus
+    state (console mode prints each message)."""
+    cfg = _load_config(args.home)
+    from ..consensus.wal import WAL
+    wal = WAL(cfg.wal_file())
+    n = 0
+    for timed in wal.replay():
+        n += 1
+        if args.console:
+            print(type(timed.msg).__name__, timed.msg)
+    print(f"replayed {n} WAL messages")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cometbft-tpu",
+        description="TPU-native BFT state-machine replication engine")
+    parser.add_argument("--home", default=DEFAULT_HOME,
+                        help="node home directory")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize config/keys/genesis")
+    p.add_argument("--chain-id", default="")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run the node")
+    p.add_argument("--proxy-app", default="",
+                   help="ABCI app address or 'kvstore'")
+    p.add_argument("--p2p-laddr", default="")
+    p.add_argument("--rpc-laddr", default="")
+    p.add_argument("--persistent-peers", default="")
+    p.add_argument("--block-sync", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    for name, fn in (("show-node-id", cmd_show_node_id),
+                     ("show-validator", cmd_show_validator),
+                     ("gen-node-key", cmd_gen_node_key),
+                     ("unsafe-reset-all", cmd_unsafe_reset_all),
+                     ("version", cmd_version)):
+        p = sub.add_parser(name)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("replay", help="replay the consensus WAL")
+    p.add_argument("--console", action="store_true")
+    p.set_defaults(fn=cmd_replay)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""Configuration tree (reference config/)."""
+
+from .config import (  # noqa: F401
+    BaseConfig, Config, ConsensusTimeoutConfig, MempoolConfig, P2PConfig,
+    RPCConfig, StateSyncConfig, BlockSyncConfig, StorageConfig,
+    InstrumentationConfig, default_config, test_config, load_config,
+    write_config_file,
+)

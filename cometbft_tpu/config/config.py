@@ -1,0 +1,268 @@
+"""The full node configuration tree (reference config/config.go:93-108)
+with TOML persistence (config/toml.go).
+
+Layout on disk mirrors the reference:
+    <root>/config/config.toml
+    <root>/config/genesis.json
+    <root>/config/node_key.json
+    <root>/config/priv_validator_key.json
+    <root>/data/priv_validator_state.json
+    <root>/data/*.db, <root>/data/cs.wal/
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class BaseConfig:
+    """config.go BaseConfig."""
+    root_dir: str = ""
+    moniker: str = "tpu-node"
+    db_backend: str = "sqlite"        # memdb | sqlite
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    abci: str = "kvstore"             # app address or 'kvstore' builtin
+    filter_peers: bool = False
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: list = field(default_factory=list)
+    grpc_laddr: str = ""
+    max_open_connections: int = 900
+    unsafe: bool = False
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    size: int = 5000
+    max_txs_bytes: int = 1 << 30
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0   # 1 week
+    discovery_time: float = 15.0
+    chunk_request_timeout: float = 10.0
+    chunk_fetchers: int = 4
+    temp_dir: str = ""
+
+
+@dataclass
+class BlockSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusTimeoutConfig:
+    """config.go:1163-1207 defaults."""
+    wal_file: str = "data/cs.wal/wal"
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    double_sign_check_height: int = 0
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "cometbft_tpu"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusTimeoutConfig = field(
+        default_factory=ConsensusTimeoutConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig)
+
+    # -- path helpers ------------------------------------------------------
+    def _abs(self, rel: str) -> str:
+        if os.path.isabs(rel):
+            return rel
+        return os.path.join(self.base.root_dir, rel)
+
+    def genesis_file(self) -> str:
+        return self._abs(self.base.genesis_file)
+
+    def priv_validator_key_file(self) -> str:
+        return self._abs(self.base.priv_validator_key_file)
+
+    def priv_validator_state_file(self) -> str:
+        return self._abs(self.base.priv_validator_state_file)
+
+    def node_key_file(self) -> str:
+        return self._abs(self.base.node_key_file)
+
+    def addr_book_file(self) -> str:
+        return self._abs(self.p2p.addr_book_file)
+
+    def wal_file(self) -> str:
+        return self._abs(self.consensus.wal_file)
+
+    def db_dir(self) -> str:
+        return self._abs(self.base.db_dir)
+
+    def ensure_dirs(self) -> None:
+        for d in ("config", "data"):
+            os.makedirs(os.path.join(self.base.root_dir, d),
+                        exist_ok=True)
+        os.makedirs(os.path.dirname(self.wal_file()), exist_ok=True)
+
+    def validate_basic(self) -> None:
+        if self.base.db_backend not in ("memdb", "sqlite"):
+            raise ValueError(
+                f"unknown db_backend {self.base.db_backend!r}")
+        for name in ("timeout_propose", "timeout_prevote",
+                     "timeout_precommit", "timeout_commit"):
+            if getattr(self.consensus, name) < 0:
+                raise ValueError(f"negative consensus.{name}")
+        if self.mempool.size < 0 or self.mempool.max_tx_bytes < 0:
+            raise ValueError("negative mempool limits")
+
+
+def default_config(root_dir: str = "") -> Config:
+    cfg = Config()
+    cfg.base.root_dir = root_dir
+    return cfg
+
+
+def test_config(root_dir: str = "") -> Config:
+    """config.TestConfig: tight timeouts, memdb, random ports."""
+    cfg = default_config(root_dir)
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    c = cfg.consensus
+    c.timeout_propose = 0.08
+    c.timeout_propose_delta = 0.002
+    c.timeout_prevote = 0.02
+    c.timeout_prevote_delta = 0.002
+    c.timeout_precommit = 0.02
+    c.timeout_precommit_delta = 0.002
+    c.timeout_commit = 0.02
+    return cfg
+
+
+# -- TOML ------------------------------------------------------------------
+
+_SECTIONS = [
+    ("", "base"), ("rpc", "rpc"), ("p2p", "p2p"),
+    ("mempool", "mempool"), ("statesync", "statesync"),
+    ("blocksync", "blocksync"), ("consensus", "consensus"),
+    ("storage", "storage"), ("instrumentation", "instrumentation"),
+]
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def write_config_file(path: str, cfg: Config) -> None:
+    """config/toml.go WriteConfigFile analog."""
+    lines = ["# cometbft_tpu configuration", ""]
+    for section, attr in _SECTIONS:
+        sub = getattr(cfg, attr)
+        if section:
+            lines.append(f"[{section}]")
+        for f in fields(sub):
+            if f.name == "root_dir":
+                continue
+            lines.append(f"{f.name} = {_toml_value(getattr(sub, f.name))}")
+        lines.append("")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fobj:
+        fobj.write("\n".join(lines))
+
+
+def load_config(root_dir: str) -> Config:
+    """Read <root>/config/config.toml into a Config (missing file =
+    defaults)."""
+    import tomllib
+    cfg = default_config(root_dir)
+    path = os.path.join(root_dir, "config", "config.toml")
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    for section, attr in _SECTIONS:
+        sub = getattr(cfg, attr)
+        src = data if section == "" else data.get(section, {})
+        for fdef in fields(sub):
+            if fdef.name in src and fdef.name != "root_dir":
+                setattr(sub, fdef.name, src[fdef.name])
+    return cfg

@@ -40,7 +40,7 @@ def verify_evidence(ev, state, state_store, block_store) -> None:
         val_set = state_store.load_validators(ev.height())
         verify_duplicate_vote(ev, state.chain_id, val_set)
     elif isinstance(ev, LightClientAttackEvidence):
-        verify_light_client_attack(ev, state, state_store)
+        verify_light_client_attack(ev, state, state_store, block_store)
     else:
         raise EvidenceVerificationError(
             f"unknown evidence type {type(ev)}")
@@ -89,11 +89,15 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
 
 
 def verify_light_client_attack(ev: LightClientAttackEvidence, state,
-                               state_store) -> None:
-    """verify.go VerifyLightClientAttack (common-height checks).
+                               state_store, block_store=None) -> None:
+    """verify.go VerifyLightClientAttack.
 
     The conflicting block's commit must carry 1/3+ of the common-height
-    validators' signatures — verified with the trusting batch path."""
+    validators' signatures (trusting batch path), its header must
+    actually DIFFER from our stored header at that height, and every
+    accused byzantine validator must have signed the conflicting
+    commit — otherwise fabricated evidence could frame honest
+    validators."""
     common_vals = state_store.load_validators(ev.common_height)
     cb = ev.conflicting_block
     if cb is None or getattr(cb, "signed_header", None) is None:
@@ -106,6 +110,33 @@ def verify_light_client_attack(ev: LightClientAttackEvidence, state,
     if ev.total_voting_power != common_vals.total_voting_power():
         raise EvidenceVerificationError(
             "evidence total power does not match common validator set")
+
+    # the conflicting header must conflict with OUR chain
+    if block_store is not None:
+        trusted = block_store.load_block_meta(sh.header.height)
+        if trusted is not None and \
+                trusted.block_id.hash == sh.header.hash():
+            raise EvidenceVerificationError(
+                "conflicting block matches the canonical chain — "
+                "no divergence to report")
+
+    # accused validators must exist at the common height AND have
+    # signed the conflicting commit (verify.go:103-120)
+    from ..types.block import BLOCK_ID_FLAG_ABSENT
+    signers = {
+        s.validator_address
+        for s in sh.commit.signatures
+        if s.block_id_flag != BLOCK_ID_FLAG_ABSENT}
+    for val in ev.byzantine_validators:
+        _, member = common_vals.get_by_address(val.address)
+        if member is None:
+            raise EvidenceVerificationError(
+                f"accused validator {val.address.hex()} not in the "
+                f"common-height validator set")
+        if val.address not in signers:
+            raise EvidenceVerificationError(
+                f"accused validator {val.address.hex()} did not sign "
+                f"the conflicting commit")
 
 
 def _load_header(block_store, height: int):

@@ -74,7 +74,7 @@ def commit_from_rpc(d: dict) -> Commit:
     for s in d.get("signatures", []):
         flag = s.get("block_id_flag")
         if isinstance(flag, str):
-            flag = _FLAGS.get(flag, _int(flag))
+            flag = _FLAGS[flag] if flag in _FLAGS else _int(flag)
         ts = s.get("timestamp")
         sigs.append(CommitSig(
             block_id_flag=_int(flag),

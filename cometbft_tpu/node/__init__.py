@@ -1,0 +1,3 @@
+"""Node assembly (reference node/)."""
+
+from .node import Node, init_files  # noqa: F401

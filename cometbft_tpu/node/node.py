@@ -1,0 +1,245 @@
+"""Node: assembles every subsystem into a running validator/full node
+(reference node/node.go:279 NewNode, node/setup.go).
+
+Construction order mirrors the reference: DBs -> state from store or
+genesis -> app conns -> event bus -> privval -> ABCI handshake ->
+mempool/evidence/executor -> blocksync + consensus reactors -> p2p
+transport/switch -> (on start) RPC.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..abci.client import LocalClient
+from ..apps.kvstore import KVStoreApplication
+from ..blocksync.reactor import BlocksyncReactor
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import Handshaker, catchup_replay
+from ..consensus.state import ConsensusConfig, ConsensusState
+from ..consensus.wal import WAL
+from ..evidence import EvidencePool, EvidenceReactor
+from ..libs.service import BaseService
+from ..mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p.key import NodeKey
+from ..p2p.node_info import NodeInfo, ProtocolVersion
+from ..p2p.switch import Switch
+from ..p2p.transport import MultiplexTransport
+from ..privval import FilePV
+from ..proxy.multi_app_conn import AppConns, default_client_creator
+from ..state.execution import BlockExecutor
+from ..state.state import make_genesis_state
+from ..state.store import StateStore
+from ..store.blockstore import BlockStore
+from ..store.kv import open_db
+from ..types import events as ev
+from ..types.genesis import GenesisDoc
+
+# all gossip channels this node speaks
+NODE_CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40])
+
+
+def init_files(config: Config, chain_id: str = "",
+               app_state=None) -> GenesisDoc:
+    """`init` command (cmd/cometbft/commands/init.go): create the
+    private validator, node key, and a single-validator genesis."""
+    config.ensure_dirs()
+    pv = FilePV.load_or_generate(config.priv_validator_key_file(),
+                                 config.priv_validator_state_file())
+    NodeKey.load_or_gen(config.node_key_file())
+
+    genesis_path = config.genesis_file()
+    if os.path.exists(genesis_path):
+        return GenesisDoc.from_file(genesis_path)
+
+    from ..types.genesis import GenesisValidator
+    from ..types.timestamp import Timestamp
+    if not chain_id:
+        chain_id = "test-chain-%s" % os.urandom(3).hex()
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(pub_key=pv.get_pub_key(),
+                                     power=10)],
+        app_state=app_state)
+    genesis.save_as(genesis_path)
+    return genesis
+
+
+class Node(BaseService):
+    """node.Node."""
+
+    def __init__(self, config: Config, app=None,
+                 genesis: GenesisDoc | None = None,
+                 block_sync: bool = False):
+        super().__init__("Node")
+        self.config = config
+        config.ensure_dirs()
+        config.validate_basic()
+
+        # L3: databases + stores (node.go initDBs)
+        backend = config.base.db_backend
+        db_dir = config.db_dir()
+        self.block_store = BlockStore(
+            open_db(backend, os.path.join(db_dir, "blockstore.db")))
+        self.state_store = StateStore(
+            open_db(backend, os.path.join(db_dir, "state.db")))
+
+        # genesis + state (node.go LoadStateFromDBOrGenesisDocProvider)
+        self.genesis = genesis or GenesisDoc.from_file(
+            config.genesis_file())
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(self.genesis)
+            self.state_store.bootstrap(state)
+
+        # L4: app connections (node.go createAndStartProxyAppConns)
+        if app is None and config.base.abci == "kvstore":
+            app = KVStoreApplication()
+        self.app = app
+        creator = default_client_creator(config.base.abci, app=app)
+        self.app_conns = AppConns(creator)
+        self.app_conns.start()
+
+        # event bus
+        self.event_bus = ev.EventBus()
+
+        # privval
+        self.priv_validator = FilePV.load_or_generate(
+            config.priv_validator_key_file(),
+            config.priv_validator_state_file())
+
+        # ABCI handshake: replay to sync app with store (node.go:372)
+        handshaker = Handshaker(self.state_store, state,
+                                self.block_store, self.genesis,
+                                event_bus=self.event_bus)
+        handshaker.handshake(self.app_conns)
+        state = self.state_store.load() or state
+        self.initial_state = state
+
+        # mempool + evidence (node/setup.go)
+        mc = config.mempool
+        self.mempool = CListMempool(
+            self.app_conns.mempool, height=state.last_block_height,
+            size=mc.size, max_txs_bytes=mc.max_txs_bytes,
+            max_tx_bytes=mc.max_tx_bytes, cache_size=mc.cache_size,
+            keep_invalid_txs_in_cache=mc.keep_invalid_txs_in_cache,
+            recheck=mc.recheck)
+        self.evidence_pool = EvidencePool(
+            open_db(backend, os.path.join(db_dir, "evidence.db")),
+            self.state_store, self.block_store)
+
+        # block executor
+        self.block_exec = BlockExecutor(
+            self.state_store, self.app_conns.consensus, self.mempool,
+            evidence_pool=self.evidence_pool,
+            block_store=self.block_store, event_bus=self.event_bus)
+
+        # consensus (WAL + state machine + reactor)
+        cc = config.consensus
+        cs_config = ConsensusConfig(
+            timeout_propose=cc.timeout_propose,
+            timeout_propose_delta=cc.timeout_propose_delta,
+            timeout_prevote=cc.timeout_prevote,
+            timeout_prevote_delta=cc.timeout_prevote_delta,
+            timeout_precommit=cc.timeout_precommit,
+            timeout_precommit_delta=cc.timeout_precommit_delta,
+            timeout_commit=cc.timeout_commit,
+            create_empty_blocks=cc.create_empty_blocks,
+            create_empty_blocks_interval=cc.create_empty_blocks_interval)
+        self.wal = WAL(config.wal_file())
+        self.consensus_state = ConsensusState(
+            cs_config, state, self.block_exec, self.block_store,
+            wal=self.wal, priv_validator=self.priv_validator,
+            event_bus=self.event_bus, evidence_pool=self.evidence_pool,
+            mempool=self.mempool)
+        # crash recovery: WAL tail replay for the in-flight height
+        if not block_sync:
+            try:
+                catchup_replay(self.consensus_state,
+                               self.consensus_state.height)
+            except Exception:
+                pass  # a fresh WAL has nothing to replay
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=block_sync)
+
+        # blocksync
+        self.blocksync_reactor = BlocksyncReactor(
+            state, self.block_exec, self.block_store, block_sync,
+            consensus_reactor=self.consensus_reactor)
+
+        # p2p (node.go createTransport/createSwitch)
+        self.node_key = NodeKey.load_or_gen(config.node_key_file())
+        self.node_info = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            node_id=self.node_key.id,
+            listen_addr=config.p2p.laddr,
+            network=self.genesis.chain_id,
+            version="0.1.0-tpu",
+            channels=NODE_CHANNELS,
+            moniker=config.base.moniker,
+            rpc_address=config.rpc.laddr)
+        self.transport = MultiplexTransport(self.node_key,
+                                            self.node_info)
+        listen = config.p2p.laddr.replace("tcp://", "")
+        self.switch = Switch(self.transport, listen_addr=listen)
+        self.switch.max_inbound = config.p2p.max_num_inbound_peers
+        self.switch.max_outbound = config.p2p.max_num_outbound_peers
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("MEMPOOL",
+                                MempoolReactor(self.mempool,
+                                               config.mempool.broadcast))
+        self.switch.add_reactor("EVIDENCE",
+                                EvidenceReactor(self.evidence_pool))
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+
+        self.rpc_server = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_start(self) -> None:
+        self.event_bus.start()
+        self.switch.start()
+        if self.config.rpc.laddr:
+            self._start_rpc()
+        peers = [a.strip()
+                 for a in self.config.p2p.persistent_peers.split(",")
+                 if a.strip()]
+        if peers:
+            self.switch.dial_peers_async(peers, persistent=True)
+
+    def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.switch.stop()
+        self.wal.close()
+        self.app_conns.stop()
+        self.event_bus.stop()
+
+    def _start_rpc(self) -> None:
+        from ..rpc.server import RPCServer
+        from ..rpc.core import Environment
+        env = Environment(
+            state_store=self.state_store,
+            block_store=self.block_store,
+            consensus_state=self.consensus_state,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            p2p_switch=self.switch,
+            event_bus=self.event_bus,
+            genesis=self.genesis,
+            app_conns=self.app_conns,
+            node_info=self.node_info,
+            config=self.config)
+        addr = self.config.rpc.laddr.replace("tcp://", "")
+        self.rpc_server = RPCServer(env, addr)
+        self.rpc_server.start()
+
+    @property
+    def rpc_addr(self) -> str | None:
+        return self.rpc_server.bound_addr if self.rpc_server else None
+
+    @property
+    def p2p_addr(self) -> str:
+        return f"{self.node_key.id}@{self.switch.bound_addr}"

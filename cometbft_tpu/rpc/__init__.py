@@ -1,0 +1,1 @@
+"""External JSON-RPC API (reference rpc/)."""

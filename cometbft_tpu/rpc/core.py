@@ -1,0 +1,413 @@
+"""RPC route handlers over the node's stores and pools
+(reference rpc/core/: env.go, routes.go, blocks.go, mempool.go,
+status.go, consensus.go, net.go, abci.go, evidence.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+from dataclasses import dataclass, field
+
+from ..abci import types as at
+from ..types import events as ev
+from ..types.block import tx_hash
+from . import serialize as ser
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+@dataclass
+class Environment:
+    """rpc/core/env.go Environment: everything the handlers reach."""
+    state_store: object = None
+    block_store: object = None
+    consensus_state: object = None
+    mempool: object = None
+    evidence_pool: object = None
+    p2p_switch: object = None
+    event_bus: object = None
+    genesis: object = None
+    app_conns: object = None
+    node_info: object = None
+    config: object = None
+    tx_indexer: object = None
+    block_indexer: object = None
+    _subscribers: dict = field(default_factory=dict)
+
+    # -- height helpers ----------------------------------------------------
+    def _latest_height(self) -> int:
+        return self.block_store.height()
+
+    def _normalize_height(self, height) -> int:
+        if height is None or height == "":
+            return self._latest_height()
+        h = int(height)
+        if h <= 0:
+            raise RPCError(-32603, f"height must be positive, got {h}")
+        base = self.block_store.base()
+        if h < base:
+            raise RPCError(-32603,
+                           f"height {h} below base height {base}")
+        if h > self._latest_height():
+            raise RPCError(
+                -32603, f"height {h} above latest height "
+                f"{self._latest_height()}")
+        return h
+
+    # -- info --------------------------------------------------------------
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        """rpc/core/status.go."""
+        latest = self._latest_height()
+        meta = self.block_store.load_block_meta(latest) \
+            if latest > 0 else None
+        base = self.block_store.base()
+        base_meta = self.block_store.load_block_meta(base) \
+            if base > 0 else None
+        pv = self.consensus_state.priv_validator_pub_key \
+            if self.consensus_state else None
+        return {
+            "node_info": {
+                "protocol_version": {
+                    "p2p": str(self.node_info.protocol_version.p2p),
+                    "block": str(self.node_info.protocol_version.block),
+                    "app": str(self.node_info.protocol_version.app),
+                },
+                "id": self.node_info.node_id,
+                "listen_addr": self.node_info.listen_addr,
+                "network": self.node_info.network,
+                "version": self.node_info.version,
+                "channels": self.node_info.channels.hex(),
+                "moniker": self.node_info.moniker,
+                "other": {"tx_index": self.node_info.tx_index,
+                          "rpc_address": self.node_info.rpc_address},
+            },
+            "sync_info": {
+                "latest_block_hash": ser.hex_upper(
+                    meta.block_id.hash) if meta else "",
+                "latest_app_hash": ser.hex_upper(
+                    meta.header.app_hash) if meta else "",
+                "latest_block_height": str(latest),
+                "latest_block_time": meta.header.time.rfc3339()
+                if meta else "",
+                "earliest_block_hash": ser.hex_upper(
+                    base_meta.block_id.hash) if base_meta else "",
+                "earliest_block_height": str(base),
+                "catching_up": False,
+            },
+            "validator_info": {
+                "address": ser.hex_upper(pv.address()) if pv else "",
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": ser.b64(pv.bytes())} if pv else None,
+                "voting_power": "0",
+            },
+        }
+
+    def net_info(self) -> dict:
+        peers = self.p2p_switch.peers.list() if self.p2p_switch else []
+        return {
+            "listening": True,
+            "listeners": [self.p2p_switch.bound_addr or ""]
+            if self.p2p_switch else [],
+            "n_peers": str(len(peers)),
+            "peers": [{
+                "node_info": {"id": p.node_info.node_id,
+                              "moniker": p.node_info.moniker},
+                "is_outbound": p.outbound,
+                "remote_ip": p.socket_addr,
+            } for p in peers],
+        }
+
+    def genesis_(self) -> dict:
+        import json
+        return {"genesis": json.loads(self.genesis.to_json())}
+
+    # -- blocks ------------------------------------------------------------
+    def block(self, height=None) -> dict:
+        h = self._normalize_height(height)
+        block = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if block is None or meta is None:
+            raise RPCError(-32603, f"block at height {h} not found")
+        return {"block_id": ser.block_id_json(meta.block_id),
+                "block": ser.block_json(block)}
+
+    def block_by_hash(self, hash=None) -> dict:  # noqa: A002
+        raw = base64.b64decode(hash) if hash else b""
+        block = self.block_store.load_block_by_hash(raw)
+        if block is None:
+            return {"block_id": None, "block": None}
+        return self.block(block.header.height)
+
+    def header(self, height=None) -> dict:
+        h = self._normalize_height(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"header at height {h} not found")
+        return {"header": ser.header_json(meta.header)}
+
+    def commit(self, height=None) -> dict:
+        """rpc/core/blocks.go Commit: the canonical commit for a
+        height — what light clients verify."""
+        h = self._normalize_height(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        if h == self._latest_height():
+            commit = self.block_store.load_seen_commit(h)
+            canonical = False
+        else:
+            commit = self.block_store.load_block_commit(h)
+            canonical = True
+        if commit is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        return {
+            "signed_header": {
+                "header": ser.header_json(meta.header),
+                "commit": ser.commit_json(commit),
+            },
+            "canonical": canonical,
+        }
+
+    def blockchain(self, minHeight=None, maxHeight=None) -> dict:
+        """rpc/core/blocks.go BlockchainInfo: metas in [min, max]."""
+        latest = self._latest_height()
+        base = self.block_store.base()
+        max_h = int(maxHeight) if maxHeight else latest
+        max_h = min(max_h, latest)
+        min_h = int(minHeight) if minHeight else max(base, max_h - 19)
+        min_h = max(min_h, base)
+        if min_h > max_h:
+            raise RPCError(-32603,
+                           f"min height {min_h} > max height {max_h}")
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            m = self.block_store.load_block_meta(h)
+            if m is not None:
+                metas.append(ser.block_meta_json(m))
+        return {"last_height": str(latest), "block_metas": metas}
+
+    def block_results(self, height=None) -> dict:
+        h = self._normalize_height(height)
+        raw = self.state_store.load_finalize_block_response(h)
+        if raw is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        resp = at.FinalizeBlockResponse.from_proto(raw)
+        return {
+            "height": str(h),
+            "txs_results": [ser.exec_tx_result_json(r)
+                            for r in resp.tx_results],
+            "finalize_block_events": [ser.event_json(e)
+                                      for e in resp.events],
+            "validator_updates": [
+                {"pub_key_type": v.pub_key_type,
+                 "pub_key_bytes": ser.b64(v.pub_key_bytes),
+                 "power": str(v.power)}
+                for v in resp.validator_updates],
+            "app_hash": ser.hex_upper(resp.app_hash),
+        }
+
+    def validators(self, height=None, page=None, per_page=None) -> dict:
+        h = self._normalize_height(height)
+        vals = self.state_store.load_validators(h)
+        items = vals.validators
+        page_i = int(page) if page else 1
+        per = min(int(per_page) if per_page else 30, 100)
+        start = (page_i - 1) * per
+        sel = items[start:start + per]
+        return {
+            "block_height": str(h),
+            "validators": [ser.validator_json(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(len(items)),
+        }
+
+    def consensus_params(self, height=None) -> dict:
+        h = self._normalize_height(height)
+        params = self.state_store.load_consensus_params(h)
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {"max_bytes": str(params.block.max_bytes),
+                          "max_gas": str(params.block.max_gas)},
+                "evidence": {
+                    "max_age_num_blocks":
+                        str(params.evidence.max_age_num_blocks),
+                    "max_age_duration":
+                        str(params.evidence.max_age_duration_ns),
+                    "max_bytes": str(params.evidence.max_bytes)},
+                "validator": {
+                    "pub_key_types": params.validator.pub_key_types},
+            },
+        }
+
+    def consensus_state(self) -> dict:
+        cs = self.consensus_state
+        with cs._mtx:
+            return {"round_state": {
+                "height": str(cs.height), "round": cs.round,
+                "step": cs.step,
+                "proposal": cs.proposal is not None,
+                "locked_round": cs.locked_round,
+                "valid_round": cs.valid_round,
+            }}
+
+    def dump_consensus_state(self) -> dict:
+        out = self.consensus_state()
+        out["peers"] = [
+            {"node_address": p.node_info.node_id}
+            for p in (self.p2p_switch.peers.list()
+                      if self.p2p_switch else [])]
+        return out
+
+    # -- abci --------------------------------------------------------------
+    def abci_info(self) -> dict:
+        res = self.app_conns.query.info(at.InfoRequest())
+        return {"response": {
+            "data": res.data, "version": res.version,
+            "app_version": str(res.app_version),
+            "last_block_height": str(res.last_block_height),
+            "last_block_app_hash": ser.b64(res.last_block_app_hash),
+        }}
+
+    def abci_query(self, path="", data="", height=None,
+                   prove=False) -> dict:
+        raw = bytes.fromhex(data) if data else b""
+        res = self.app_conns.query.query(at.QueryRequest(
+            data=raw, path=path or "",
+            height=int(height) if height else 0,
+            prove=bool(prove)))
+        return {"response": {
+            "code": res.code, "log": res.log, "info": res.info,
+            "index": str(res.index),
+            "key": ser.b64(res.key) if res.key else None,
+            "value": ser.b64(res.value) if res.value else None,
+            "height": str(res.height), "codespace": res.codespace,
+        }}
+
+    # -- txs ---------------------------------------------------------------
+    def _decode_tx_param(self, tx) -> bytes:
+        if isinstance(tx, bytes):
+            return tx
+        return base64.b64decode(tx)
+
+    def broadcast_tx_async(self, tx=None) -> dict:
+        raw = self._decode_tx_param(tx)
+        threading.Thread(target=self._check_tx_ignore_errors,
+                         args=(raw,), daemon=True).start()
+        return {"code": 0, "data": "", "log": "",
+                "hash": ser.hex_upper(tx_hash(raw))}
+
+    def _check_tx_ignore_errors(self, raw: bytes) -> None:
+        try:
+            self.mempool.check_tx(raw)
+        except Exception:
+            pass
+
+    def broadcast_tx_sync(self, tx=None) -> dict:
+        """CheckTx result returned (rpc/core/mempool.go:38)."""
+        raw = self._decode_tx_param(tx)
+        from ..mempool.clist_mempool import ErrAppCheckTx, MempoolError
+        try:
+            res = self.mempool.check_tx(raw)
+            code, log = res.code, res.log
+        except ErrAppCheckTx as e:
+            code, log = e.code, e.log
+        except MempoolError as e:
+            raise RPCError(-32603, str(e)) from e
+        return {"code": code, "data": "", "log": log,
+                "hash": ser.hex_upper(tx_hash(raw))}
+
+    def broadcast_tx_commit(self, tx=None) -> dict:
+        """Subscribe to the tx event, submit, wait for commit
+        (rpc/core/mempool.go:76)."""
+        raw = self._decode_tx_param(tx)
+        h = tx_hash(raw)
+        query = ev.pubsub.Query.parse(
+            f"{ev.TX_HASH_KEY} = '{h.hex().upper()}'")
+        subscriber = f"tx-commit-{h.hex()[:16]}"
+        sub = self.event_bus.subscribe(subscriber, query)
+        try:
+            check = self.broadcast_tx_sync(tx=raw)
+            if check["code"] != 0:
+                return {"check_tx": check, "tx_result": None,
+                        "hash": check["hash"], "height": "0"}
+            timeout = self.config.rpc.timeout_broadcast_tx_commit \
+                if self.config else 10.0
+            msg = sub.next(timeout=timeout)
+            if msg is None:
+                raise RPCError(-32603,
+                               "timed out waiting for tx to commit")
+            data = msg.data  # EventDataTx
+            return {
+                "check_tx": check,
+                "tx_result": ser.exec_tx_result_json(data.result),
+                "hash": ser.hex_upper(h),
+                "height": str(data.height),
+            }
+        finally:
+            try:
+                self.event_bus.unsubscribe_all(subscriber)
+            except KeyError:
+                pass
+
+    def unconfirmed_txs(self, limit=None) -> dict:
+        txs = self.mempool.reap_max_txs(int(limit) if limit else 30)
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self.mempool.size()),
+            "total_bytes": str(self.mempool.size_bytes()),
+            "txs": [ser.b64(tx) for tx in txs],
+        }
+
+    def num_unconfirmed_txs(self) -> dict:
+        return {
+            "n_txs": str(self.mempool.size()),
+            "total": str(self.mempool.size()),
+            "total_bytes": str(self.mempool.size_bytes()),
+        }
+
+    # -- evidence ----------------------------------------------------------
+    def broadcast_evidence(self, evidence=None) -> dict:
+        from ..types.evidence import evidence_from_proto_wrapped
+        ev_obj = evidence_from_proto_wrapped(
+            base64.b64decode(evidence))
+        self.evidence_pool.add_evidence(ev_obj)
+        return {"hash": ser.hex_upper(ev_obj.hash())}
+
+
+# routes.go: method name -> handler attribute
+ROUTES = {
+    "health": "health",
+    "status": "status",
+    "net_info": "net_info",
+    "genesis": "genesis_",
+    "block": "block",
+    "block_by_hash": "block_by_hash",
+    "header": "header",
+    "commit": "commit",
+    "blockchain": "blockchain",
+    "block_results": "block_results",
+    "validators": "validators",
+    "consensus_params": "consensus_params",
+    "consensus_state": "consensus_state",
+    "dump_consensus_state": "dump_consensus_state",
+    "abci_info": "abci_info",
+    "abci_query": "abci_query",
+    "broadcast_tx_async": "broadcast_tx_async",
+    "broadcast_tx_sync": "broadcast_tx_sync",
+    "broadcast_tx_commit": "broadcast_tx_commit",
+    "unconfirmed_txs": "unconfirmed_txs",
+    "num_unconfirmed_txs": "num_unconfirmed_txs",
+    "broadcast_evidence": "broadcast_evidence",
+}

@@ -1,0 +1,137 @@
+"""Serialize core types to CometBFT-compatible RPC JSON
+(the shapes of rpc/core responses: hex hashes, base64 byte blobs,
+decimal-string int64s, RFC3339 times). Our own light client's
+rpc_decode parses exactly these shapes — round-trip tested.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from ..types.block import BlockIDFlag
+
+_FLAG_NAMES = {1: "BLOCK_ID_FLAG_ABSENT", 2: "BLOCK_ID_FLAG_COMMIT",
+               3: "BLOCK_ID_FLAG_NIL"}
+_KEY_TYPE_NAMES = {"ed25519": "tendermint/PubKeyEd25519",
+                   "secp256k1": "tendermint/PubKeySecp256k1"}
+
+
+def b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def hex_upper(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def block_id_json(bid) -> dict:
+    return {
+        "hash": hex_upper(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": hex_upper(bid.part_set_header.hash),
+        },
+    }
+
+
+def header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block),
+                    "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": h.time.rfc3339(),
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hex_upper(h.last_commit_hash),
+        "data_hash": hex_upper(h.data_hash),
+        "validators_hash": hex_upper(h.validators_hash),
+        "next_validators_hash": hex_upper(h.next_validators_hash),
+        "consensus_hash": hex_upper(h.consensus_hash),
+        "app_hash": hex_upper(h.app_hash),
+        "last_results_hash": hex_upper(h.last_results_hash),
+        "evidence_hash": hex_upper(h.evidence_hash),
+        "proposer_address": hex_upper(h.proposer_address),
+    }
+
+
+def commit_sig_json(s) -> dict:
+    return {
+        "block_id_flag": _FLAG_NAMES.get(s.block_id_flag,
+                                         str(s.block_id_flag)),
+        "validator_address": hex_upper(s.validator_address),
+        "timestamp": s.timestamp.rfc3339() if not s.timestamp.is_zero()
+        else "0001-01-01T00:00:00Z",
+        "signature": b64(s.signature) if s.signature else None,
+    }
+
+
+def commit_json(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": block_id_json(c.block_id),
+        "signatures": [commit_sig_json(s) for s in c.signatures],
+    }
+
+
+def data_json(d) -> dict:
+    return {"txs": [b64(tx) for tx in d.txs]}
+
+
+def evidence_list_json(evidence: list) -> dict:
+    # compact form: opaque proto bytes (full JSON schema arrives with
+    # the indexer work)
+    from ..types.evidence import evidence_to_proto_wrapped
+    return {"evidence": [
+        {"proto": b64(evidence_to_proto_wrapped(e))} for e in evidence]}
+
+
+def block_json(b) -> dict:
+    return {
+        "header": header_json(b.header),
+        "data": data_json(b.data),
+        "evidence": evidence_list_json(b.evidence),
+        "last_commit": commit_json(b.last_commit)
+        if b.last_commit is not None else None,
+    }
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": hex_upper(v.address),
+        "pub_key": {
+            "type": _KEY_TYPE_NAMES.get(v.pub_key.type(),
+                                        v.pub_key.type()),
+            "value": b64(v.pub_key.bytes()),
+        },
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def block_meta_json(m) -> dict:
+    return {
+        "block_id": block_id_json(m.block_id),
+        "block_size": str(m.block_size),
+        "header": header_json(m.header),
+        "num_txs": str(m.num_txs),
+    }
+
+
+def event_json(e) -> dict:
+    return {"type": e.type, "attributes": [
+        {"key": a.key, "value": a.value, "index": a.index}
+        for a in e.attributes]}
+
+
+def exec_tx_result_json(r) -> dict:
+    return {
+        "code": r.code,
+        "data": b64(r.data) if r.data else None,
+        "log": r.log,
+        "info": r.info,
+        "gas_wanted": str(r.gas_wanted),
+        "gas_used": str(r.gas_used),
+        "events": [event_json(e) for e in r.events],
+        "codespace": r.codespace,
+    }

@@ -1,0 +1,134 @@
+"""JSON-RPC 2.0 over HTTP (reference rpc/jsonrpc/server/).
+
+Accepts POST / with a JSON-RPC envelope and GET /<method>?arg=...
+URI-style calls, like the reference's http_json_handler + uri handler.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from .core import ROUTES, Environment, RPCError
+
+MAX_BODY_BYTES = 1_000_000
+
+
+class RPCServer:
+    def __init__(self, env: Environment, addr: str):
+        host, _, port = addr.rpartition(":")
+        self._env = env
+        self._httpd = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), _make_handler(env))
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+        self.bound_addr = "%s:%d" % self._httpd.server_address
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _coerce_params(params: dict) -> dict:
+    """URI params arrive as strings; strip surrounding quotes the way
+    the reference's uri handler tolerates."""
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, str) and len(v) >= 2 and \
+                v[0] == '"' and v[-1] == '"':
+            v = v[1:-1]
+        out[k] = v
+    return out
+
+
+def _make_handler(env: Environment):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:
+            pass  # quiet
+
+        # -- helpers -------------------------------------------------------
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _call(self, method: str, params: dict, req_id) -> dict:
+            attr = ROUTES.get(method)
+            if attr is None:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": -32601,
+                                  "message": f"method {method} not found"}}
+            try:
+                result = getattr(env, attr)(**_coerce_params(params))
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "result": result}
+            except RPCError as e:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": e.code, "message": e.message,
+                                  "data": e.data}}
+            except TypeError as e:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": -32602,
+                                  "message": f"invalid params: {e}"}}
+            except Exception as e:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": -32603, "message": str(e)}}
+
+        # -- JSON-RPC over POST -------------------------------------------
+        def do_POST(self) -> None:  # noqa: N802
+            length = int(self.headers.get("Content-Length", "0"))
+            if length > MAX_BODY_BYTES:
+                self._reply(413, {"error": "body too large"})
+                return
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError:
+                self._reply(400, {
+                    "jsonrpc": "2.0", "id": None,
+                    "error": {"code": -32700, "message": "parse error"}})
+                return
+            if isinstance(req, list):  # batch
+                resp = [self._call(r.get("method", ""),
+                                   r.get("params") or {}, r.get("id"))
+                        for r in req]
+            else:
+                resp = self._call(req.get("method", ""),
+                                  req.get("params") or {}, req.get("id"))
+            self._reply(200, resp) if isinstance(resp, dict) else \
+                self._reply_list(resp)
+
+        def _reply_list(self, payload: list) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- URI-style GET -------------------------------------------------
+        def do_GET(self) -> None:  # noqa: N802
+            parsed = urlparse(self.path)
+            method = parsed.path.strip("/")
+            if method == "":
+                # route listing (reference serves an HTML index)
+                self._reply(200, {"jsonrpc": "2.0", "id": -1,
+                                  "result": {"routes":
+                                             sorted(ROUTES.keys())}})
+                return
+            params = dict(parse_qsl(parsed.query))
+            self._reply(200, self._call(method, params, -1))
+
+    return Handler

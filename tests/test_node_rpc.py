@@ -1,0 +1,180 @@
+"""Node assembly + JSON-RPC + light-client-over-own-RPC
+(reference node/node_test.go, rpc/core tests).
+
+The flagship integration: `Node` wires every subsystem from a Config;
+the RPC serves CometBFT-shaped JSON; our light client bisection-syncs
+against our own node's RPC with TPU-routed commit verification.
+"""
+
+import base64
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.config import write_config_file, load_config
+from cometbft_tpu.config import test_config as _tcfg
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.types.genesis import GenesisDoc
+
+from tests.test_consensus import wait_for_height
+
+
+def rpc_get(addr, method, **params):
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    url = f"http://{addr}/{method}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = json.loads(resp.read())
+    return body
+
+
+def rpc_post(addr, method, **params):
+    payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": params}).encode()
+    req = urllib.request.Request(
+        f"http://{addr}/", data=payload,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="class")
+def node(tmp_path_factory):
+    home = str(tmp_path_factory.mktemp("node-home"))
+    cfg = _tcfg(home)
+    init_files(cfg, chain_id="rpc-chain")
+    n = Node(cfg)
+    n.start()
+    assert wait_for_height(n.consensus_state, 4, timeout=60)
+    yield n
+    n.stop()
+
+
+class TestNodeRPC:
+    def test_init_files_idempotent(self, tmp_path):
+        cfg = _tcfg(str(tmp_path))
+        g1 = init_files(cfg, chain_id="abc")
+        g2 = init_files(cfg)
+        assert g1.chain_id == g2.chain_id == "abc"
+        # config round-trips through TOML
+        write_config_file(str(tmp_path / "config" / "config.toml"), cfg)
+        cfg2 = load_config(str(tmp_path))
+        assert cfg2.base.db_backend == cfg.base.db_backend
+        assert cfg2.consensus.timeout_propose == \
+            cfg.consensus.timeout_propose
+
+    def test_status(self, node):
+        body = rpc_get(node.rpc_addr, "status")
+        res = body["result"]
+        assert res["node_info"]["network"] == "rpc-chain"
+        assert int(res["sync_info"]["latest_block_height"]) >= 3
+        assert len(res["sync_info"]["latest_block_hash"]) == 64
+
+    def test_block_and_commit(self, node):
+        body = rpc_get(node.rpc_addr, "block", height=2)
+        blk = body["result"]["block"]
+        assert blk["header"]["height"] == "2"
+        assert blk["header"]["chain_id"] == "rpc-chain"
+        commit = rpc_get(node.rpc_addr, "commit", height=2)["result"]
+        assert commit["canonical"] is True
+        sh = commit["signed_header"]
+        assert sh["commit"]["height"] == "2"
+        assert sh["commit"]["signatures"][0]["signature"]
+
+    def test_validators_and_params(self, node):
+        res = rpc_get(node.rpc_addr, "validators", height=2)["result"]
+        assert res["total"] == "1"
+        val = res["validators"][0]
+        assert val["voting_power"] == "10"
+        assert val["pub_key"]["type"] == "tendermint/PubKeyEd25519"
+        params = rpc_get(node.rpc_addr, "consensus_params",
+                         height=2)["result"]
+        assert int(params["consensus_params"]["block"]["max_bytes"]) > 0
+
+    def test_blockchain_info(self, node):
+        res = rpc_get(node.rpc_addr, "blockchain", minHeight=1,
+                      maxHeight=2)["result"]
+        assert len(res["block_metas"]) == 2
+        assert res["block_metas"][0]["header"]["height"] == "2"
+
+    def test_abci_info_and_query(self, node):
+        res = rpc_get(node.rpc_addr, "abci_info")["result"]
+        assert res["response"]["version"].startswith("kvstore")
+        # commit a kv pair, query it back
+        tx = base64.b64encode(b"rpckey=rpcval").decode()
+        commit_res = rpc_post(node.rpc_addr, "broadcast_tx_commit",
+                              tx=tx)["result"]
+        assert commit_res["tx_result"]["code"] == 0
+        assert int(commit_res["height"]) > 0
+        q = rpc_get(node.rpc_addr, "abci_query",
+                    data=b"rpckey".hex())["result"]
+        assert base64.b64decode(q["response"]["value"]) == b"rpcval"
+
+    def test_broadcast_tx_sync_rejects_invalid(self, node):
+        tx = base64.b64encode(b"not-a-kv-pair").decode()
+        res = rpc_post(node.rpc_addr, "broadcast_tx_sync",
+                       tx=tx)["result"]
+        assert res["code"] != 0
+
+    def test_unconfirmed_and_health(self, node):
+        assert rpc_get(node.rpc_addr, "health")["result"] == {}
+        res = rpc_get(node.rpc_addr, "num_unconfirmed_txs")["result"]
+        assert "n_txs" in res
+
+    def test_genesis_endpoint(self, node):
+        res = rpc_get(node.rpc_addr, "genesis")["result"]
+        assert res["genesis"]["chain_id"] == "rpc-chain"
+
+    def test_error_shapes(self, node):
+        body = rpc_get(node.rpc_addr, "block", height=10**9)
+        assert body["error"]["code"] == -32603
+        body = rpc_post(node.rpc_addr, "nope_method")
+        assert body["error"]["code"] == -32601
+
+    def test_block_results(self, node):
+        # find the height with our committed tx
+        latest = int(rpc_get(node.rpc_addr, "status")["result"]
+                     ["sync_info"]["latest_block_height"])
+        found = False
+        for h in range(1, latest + 1):
+            res = rpc_get(node.rpc_addr, "block_results",
+                          height=h)["result"]
+            if res["txs_results"]:
+                found = True
+                assert res["txs_results"][0]["code"] == 0
+        assert found
+
+
+class TestLightClientOverOwnRPC:
+    def test_bisection_sync_against_own_node(self, node):
+        """Light client verifies our chain through our own RPC — the
+        full hot path: /commit + /validators -> TPU batch verify."""
+        from cometbft_tpu.light.client import Client, TrustOptions
+        from cometbft_tpu.light.provider import HttpProvider
+        from cometbft_tpu.light.store import MemoryStore
+
+        assert wait_for_height(node.consensus_state, 6, timeout=60)
+
+        provider = HttpProvider("rpc-chain",
+                                f"http://{node.rpc_addr}")
+        # trust block 1 by hash
+        lb1 = provider.light_block(1)
+        client = Client(
+            chain_id="rpc-chain",
+            primary=provider,
+            witnesses=[],
+            trusted_store=MemoryStore(),
+            trust_options=TrustOptions(
+                period_ns=3600 * 10**9,
+                height=1, hash=lb1.signed_header.header.hash()))
+        latest = node.block_store.height() - 1
+        lb = client.verify_light_block_at_height(
+            latest, now=_now_plus(0))
+        assert lb.height == latest
+        assert lb.signed_header.header.chain_id == "rpc-chain"
+
+
+def _now_plus(secs):
+    from cometbft_tpu.types.timestamp import Timestamp
+    return Timestamp.now().add_ns(int(secs * 1e9))
