@@ -31,9 +31,10 @@ class Peer(BaseService):
     def on_stop(self) -> None:
         self.mconn.stop()
 
-    def send(self, channel_id: int, msg_bytes: bytes) -> bool:
+    def send(self, channel_id: int, msg_bytes: bytes,
+             timeout: float = 10.0) -> bool:
         """Blocking send onto the channel queue (peer.go Send)."""
-        return self.mconn.send(channel_id, msg_bytes)
+        return self.mconn.send(channel_id, msg_bytes, timeout=timeout)
 
     def try_send(self, channel_id: int, msg_bytes: bytes) -> bool:
         return self.mconn.try_send(channel_id, msg_bytes)

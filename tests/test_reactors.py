@@ -146,6 +146,45 @@ class TestP2PConsensus:
             "tx failed to gossip+commit on all nodes"
 
 
+class TestMempoolGossip:
+    def test_tx_reaches_peer_mempool_without_consensus(self):
+        """Gossip in isolation: two mempool-only switches, a tx checked
+        on A must arrive in B's mempool via the broadcast routine alone
+        (reference mempool/reactor.go:209)."""
+        from cometbft_tpu.store.kv import MemDB  # noqa: F401
+
+        sides = []
+        for name in ("a", "b"):
+            app = KVStoreApplication()
+            client = LocalClient(app)
+            mempool = CListMempool(client)
+            node_key = NodeKey(PrivKey.generate())
+            info = NodeInfo(node_id=node_key.id, network="gossip-test",
+                            channels=bytes([0x30]), moniker=name)
+            switch = Switch(MultiplexTransport(node_key, info),
+                            listen_addr="127.0.0.1:0")
+            switch.add_reactor("MEMPOOL", MempoolReactor(mempool))
+            sides.append((mempool, switch, node_key))
+        (mp_a, sw_a, key_a), (mp_b, sw_b, _) = sides
+        sw_a.start()
+        sw_b.start()
+        try:
+            sw_b.dial_peer(f"{key_a.id}@{sw_a.bound_addr}")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and sw_a.peers.size() == 0:
+                time.sleep(0.02)
+            assert sw_a.peers.size() == 1
+            mp_a.check_tx(b"direct=gossip")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and mp_b.size() == 0:
+                time.sleep(0.02)
+            assert mp_b.size() == 1, "tx never gossiped to peer mempool"
+            assert mp_b.entries_after(0)[0].tx == b"direct=gossip"
+        finally:
+            sw_a.stop()
+            sw_b.stop()
+
+
 class TestLateJoiner:
     def test_catchup_via_gossip(self):
         """A validator that joins late catches up through the consensus
