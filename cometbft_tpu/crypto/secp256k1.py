@@ -1,0 +1,283 @@
+"""secp256k1 ECDSA keys: sign/verify + Bitcoin-style addresses.
+
+Semantics mirror the reference (/root/reference/crypto/secp256k1/secp256k1.go):
+- 32-byte privkeys, 33-byte compressed pubkeys (02/03 || x).
+- Sign = ECDSA over SHA-256(msg) with RFC 6979 deterministic nonces,
+  output 64-byte R||S in lower-S form (secp256k1.go:129-142).
+- Verify rejects signatures not in lower-S form — the malleability rule
+  (secp256k1.go:193-219).
+- Address = RIPEMD160(SHA256(compressed pubkey)) (secp256k1.go:158-170).
+
+The curve math is from-scratch Python (verify correctness oracle, signing,
+key derivation); when the OpenSSL-backed `cryptography` package is present
+its ECDSA verify is used as the fast path (same accept set: OpenSSL also
+performs standard ECDSA; the lower-S gate is applied before dispatch).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+
+from .hash import sum_sha256
+
+KEY_TYPE = "secp256k1"
+PRIVKEY_SIZE = 32
+PUBKEY_SIZE = 33
+SIGNATURE_SIZE = 64
+
+# curve parameters (SEC2 2.4.1)
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, m - 2, m)
+
+
+# Jacobian point arithmetic (None = infinity); variable-time is fine for
+# verification (public data); signing uses it too — acceptable for a
+# validator whose key lives in FilePV, same trust model as the reference's
+# btcec pure-Go path.
+
+def _jadd(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = z1 * z1 % P
+    z2z2 = z2 * z2 % P
+    u1 = x1 * z2z2 % P
+    u2 = x2 * z1z1 % P
+    s1 = y1 * z2 * z2z2 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u1 == u2:
+        if s1 != s2:
+            return None
+        return _jdbl(p)
+    h = (u2 - u1) % P
+    i = 4 * h * h % P
+    j = h * i % P
+    r = 2 * (s2 - s1) % P
+    v = u1 * i % P
+    x3 = (r * r - j - 2 * v) % P
+    y3 = (r * (v - x3) - 2 * s1 * j) % P
+    z3 = 2 * h * z1 * z2 % P
+    return x3, y3, z3
+
+
+def _jdbl(p):
+    if p is None:
+        return None
+    x1, y1, z1 = p
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = b * b % P
+    d = 2 * ((x1 + b) * (x1 + b) - a - c) % P
+    e = 3 * a % P
+    f = e * e % P
+    x3 = (f - 2 * d) % P
+    y3 = (e * (d - x3) - 8 * c) % P
+    z3 = 2 * y1 * z1 % P
+    return x3, y3, z3
+
+
+def _jmul(k: int, pt):
+    """Double-and-add scalar multiplication."""
+    acc = None
+    add = pt
+    while k:
+        if k & 1:
+            acc = _jadd(acc, add)
+        add = _jdbl(add)
+        k >>= 1
+    return acc
+
+
+def _jaffine(p):
+    if p is None:
+        return None
+    x, y, z = p
+    zi = _inv(z, P)
+    zi2 = zi * zi % P
+    return x * zi2 % P, y * zi2 * zi % P
+
+
+_G = (GX, GY, 1)
+
+
+def _compress(x: int, y: int) -> bytes:
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decompress(data: bytes) -> tuple[int, int] | None:
+    if len(data) != PUBKEY_SIZE or data[0] not in (2, 3):
+        return None
+    x = int.from_bytes(data[1:], "big")
+    if x >= P:
+        return None
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        return None
+    if (y & 1) != (data[0] & 1):
+        y = P - y
+    return x, y
+
+
+def _rfc6979_k(x: int, h1: bytes) -> int:
+    """RFC 6979 §3.2 deterministic nonce for SHA-256 / secp256k1."""
+    qlen_bytes = 32
+    v = b"\x01" * 32
+    key = b"\x00" * 32
+    x_b = x.to_bytes(qlen_bytes, "big")
+    # bits2octets: h1 interpreted mod N then padded
+    z = int.from_bytes(h1, "big") % N
+    z_b = z.to_bytes(qlen_bytes, "big")
+    key = hmac.new(key, v + b"\x00" + x_b + z_b, hashlib.sha256).digest()
+    v = hmac.new(key, v, hashlib.sha256).digest()
+    key = hmac.new(key, v + b"\x01" + x_b + z_b, hashlib.sha256).digest()
+    v = hmac.new(key, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(key, v, hashlib.sha256).digest()
+        k = int.from_bytes(v, "big")
+        if 1 <= k < N:
+            return k
+        key = hmac.new(key, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(key, v, hashlib.sha256).digest()
+
+
+def _verify_py(pub_xy: tuple[int, int], digest: bytes, r: int, s: int) -> bool:
+    """Textbook ECDSA verify over the already-parsed values."""
+    e = int.from_bytes(digest, "big")
+    w = _inv(s, N)
+    u1 = e * w % N
+    u2 = r * w % N
+    pt = _jadd(_jmul(u1, _G), _jmul(u2, pub_xy + (1,)))
+    aff = _jaffine(pt)
+    if aff is None:
+        return False
+    return aff[0] % N == r
+
+
+def _verify_openssl(pub_bytes: bytes, msg: bytes, r: int, s: int) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature)
+
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), pub_bytes)
+    except ValueError:
+        return False
+    der = encode_dss_signature(r, s)
+    try:
+        pub.verify(der, msg, ec.ECDSA(hashes.SHA256()))
+        return True
+    except InvalidSignature:
+        return False
+
+
+try:  # fast path availability probe
+    import cryptography  # noqa: F401
+    _HAVE_OPENSSL = os.environ.get("COMETBFT_TPU_PURE_SECP", "") != "1"
+except ImportError:  # pragma: no cover
+    _HAVE_OPENSSL = False
+
+
+@dataclass(frozen=True)
+class PubKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("secp256k1 pubkey must be 33 bytes")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(compressed pubkey)) — secp256k1.go:158."""
+        return hashlib.new("ripemd160", sum_sha256(self.data)).digest()
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < N and 1 <= s < N):
+            return False
+        if s > N // 2:  # lower-S malleability rule (secp256k1.go:205-214)
+            return False
+        if _HAVE_OPENSSL:
+            return _verify_openssl(self.data, msg, r, s)
+        xy = _decompress(self.data)
+        if xy is None:
+            return False
+        return _verify_py(xy, sum_sha256(msg), r, s)
+
+    def __bytes__(self):
+        return self.data
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+        d = int.from_bytes(self.data, "big")
+        if not (1 <= d < N):
+            raise ValueError("secp256k1 privkey out of range")
+
+    @staticmethod
+    def generate(seed: bytes | None = None) -> "PrivKey":
+        """Random key, or the reference's hash-to-key rule for a seed:
+        k = (SHA256(seed) mod (n-1)) + 1 (secp256k1.go:106-126)."""
+        if seed is None:
+            while True:
+                raw = os.urandom(32)
+                d = int.from_bytes(raw, "big")
+                if 1 <= d < N:
+                    return PrivKey(raw)
+        fe = int.from_bytes(sum_sha256(seed), "big") % (N - 1) + 1
+        return PrivKey(fe.to_bytes(32, "big"))
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def pub_key(self) -> PubKey:
+        x, y = _jaffine(_jmul(int.from_bytes(self.data, "big"), _G))
+        return PubKey(_compress(x, y))
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte R||S, lower-S, RFC 6979 nonce (secp256k1.go:129-142)."""
+        d = int.from_bytes(self.data, "big")
+        digest = sum_sha256(msg)
+        e = int.from_bytes(digest, "big")
+        k = _rfc6979_k(d, digest)
+        while True:
+            x, _y = _jaffine(_jmul(k, _G))
+            r = x % N
+            s = _inv(k, N) * (e + r * d) % N
+            if r and s:
+                break
+            k = (k + 1) % N  # vanishing r/s: probability ~2^-256
+        if s > N // 2:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
