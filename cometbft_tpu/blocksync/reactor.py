@@ -57,6 +57,26 @@ class BlocksyncReactor(Reactor):
         self._stop_sync.set()
         self.pool.stop()
 
+    def switch_to_blocksync(self, state) -> None:
+        """Begin block-syncing from a statesync-bootstrapped state
+        (reference internal/blocksync/reactor.go SwitchToBlockSync):
+        re-base the pool past the snapshot height and start the
+        poolRoutine that was skipped at node start."""
+        self.state = state
+        self.initial_state = state
+        self.synced = False
+        self.block_sync = True
+        self.pool = BlockPool(max(self.store.height() + 1,
+                                  state.last_block_height + 1,
+                                  state.initial_height),
+                              self._send_block_request,
+                              self._on_peer_error)
+        for peer in (self.switch.peers.list() if self.switch else []):
+            peer.try_send(BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusRequest()))
+        self.pool.start()
+        threading.Thread(target=self._pool_routine,
+                         name="blocksync-pool", daemon=True).start()
+
     # -- peer lifecycle ----------------------------------------------------
     def add_peer(self, peer) -> None:
         peer.try_send(BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusResponse(
@@ -167,9 +187,8 @@ class BlocksyncReactor(Reactor):
 
         self.pool.pop_request()
         if ext_enabled:
-            self.store.save_block(first, parts, first_ext.to_commit())
-            self.store.save_extended_commit(first.header.height,
-                                            first_ext.to_proto())
+            self.store.save_block(first, parts, first_ext.to_commit(),
+                                  ext_commit=first_ext.to_proto())
         else:
             self.store.save_block(first, parts, second.last_commit)
         self.state = self.block_exec.apply_verified_block(

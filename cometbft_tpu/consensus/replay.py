@@ -34,6 +34,12 @@ class ErrAppBlockHeightTooHigh(HandshakeError):
     pass
 
 
+class ErrWALMissingEndHeight(HandshakeError):
+    """No EndHeight marker for the prior height — the benign fresh-WAL
+    case, distinguished from mid-log corruption so node startup only
+    swallows THIS (reference replay.go missing-ENDHEIGHT handling)."""
+
+
 class ErrAppBlockHeightTooLow(HandshakeError):
     pass
 
@@ -57,7 +63,7 @@ def catchup_replay(cs, cs_height: int) -> None:
         end_height = 0
     found, tail = cs.wal.search_for_end_height(end_height)
     if not found and end_height > 0:
-        raise HandshakeError(
+        raise ErrWALMissingEndHeight(
             f"WAL does not contain EndHeight for {end_height}")
 
     for timed in tail:

@@ -273,19 +273,34 @@ class ConsensusState(BaseService):
             raise ConsensusError(f"invalid timeout step {ti.step}")
 
     def _handle_txs_available(self) -> None:
-        if self.height != self.state.last_block_height + 1 and \
-                self.height != self.state.initial_height:
+        """handleTxsAvailable (state.go:1026-1049): inside the
+        timeout-commit phase, schedule the REMAINING commit timeout as a
+        NEW_ROUND timeout (+1ms so it lands after the NEW_HEIGHT timeout
+        and enter_new_round's bookkeeping has run) instead of proposing
+        immediately — cutting the window short would collect fewer
+        last-height precommits into the next LastCommit."""
+        if self.round != 0:
             return
         if self.step == STEP_NEW_HEIGHT:
-            if self.height == self.state.initial_height:
-                # first block: propose after timeout_commit (state.go:1034)
-                self._schedule_timeout(self.config.timeout_commit,
-                                       self.height, 0, STEP_NEW_ROUND)
+            if self._need_proof_block(self.height):
+                # enter_propose will be reached via enter_new_round
                 return
-            self.enter_propose(self.height, 0)
+            remaining = max(self.start_time - time.monotonic(), 0.0)
+            self._schedule_timeout(remaining + 0.001, self.height, 0,
+                                   STEP_NEW_ROUND)
         elif self.step == STEP_NEW_ROUND:
             # waiting for txs inside the round (create_empty_blocks=False)
             self.enter_propose(self.height, 0)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """First block, or app hash changed last height — a block must be
+        proposed regardless of txs (state.go needProofBlock)."""
+        if height == self.state.initial_height:
+            return True
+        if self.block_store is None:
+            return False
+        meta = self.block_store.load_block_meta(height - 1)
+        return meta is None or self.state.app_hash != meta.header.app_hash
 
     # -- state transitions -------------------------------------------------
     def update_to_state(self, state) -> None:
@@ -436,7 +451,8 @@ class ConsensusState(BaseService):
 
         wait_for_txs = (not self.config.create_empty_blocks and
                         round_ == 0 and self.mempool is not None and
-                        self.mempool.size() == 0)
+                        self.mempool.size() == 0 and
+                        not self._need_proof_block(height))
         if wait_for_txs:
             if self.config.create_empty_blocks_interval > 0:
                 self._schedule_timeout(
@@ -556,6 +572,20 @@ class ConsensusState(BaseService):
                         PREVOTE_TYPE, block_hash,
                         self.proposal_block_parts.header)
                     return
+                # PBTS: the proposal timestamp must equal the block time
+                # and be timely w.r.t. our receive time and the chain's
+                # SynchronyParams (reference state.go:1438-1463); without
+                # this a byzantine proposer poisons BFT time.
+                if self.state.consensus_params.pbts_enabled(height):
+                    if self.proposal.timestamp != \
+                            self.proposal_block.header.time:
+                        self._sign_add_vote(PREVOTE_TYPE, b"",
+                                            PartSetHeader())
+                        return
+                    if not self._proposal_is_timely():
+                        self._sign_add_vote(PREVOTE_TYPE, b"",
+                                            PartSetHeader())
+                        return
                 # consensus-level validity
                 try:
                     self.block_exec.validate_block(self.state,
@@ -595,6 +625,18 @@ class ConsensusState(BaseService):
                                     self.proposal_block_parts.header)
                 return
         self._sign_add_vote(PREVOTE_TYPE, b"", PartSetHeader())
+
+    def _proposal_is_timely(self) -> bool:
+        """PBTS timeliness (types/proposal.go:97 IsTimely with the
+        per-round message-delay relaxation of params.go InRound):
+        ts - precision <= recv <= ts + message_delay*1.1**round + precision.
+        """
+        sp = self.state.consensus_params.synchrony
+        delay_ns = int((1.1 ** self.proposal.round) * sp.message_delay_ns)
+        if self.proposal_receive_time is None:
+            return False
+        diff = self.proposal_receive_time.diff_ns(self.proposal.timestamp)
+        return -sp.precision_ns <= diff <= delay_ns + sp.precision_ns
 
     def enter_prevote_wait(self, height: int, round_: int) -> None:
         if self.height != height or round_ < self.round or \
@@ -751,14 +793,9 @@ class ConsensusState(BaseService):
                 .vote_extensions_enabled(block.header.height)
             seen_ec = self.votes.precommits(
                 self.commit_round).make_extended_commit(ext_enabled)
-            if ext_enabled:
-                self.block_store.save_block(block, block_parts,
-                                            seen_ec.to_commit())
-                self.block_store.save_extended_commit(
-                    block.header.height, seen_ec.to_proto())
-            else:
-                self.block_store.save_block(block, block_parts,
-                                            seen_ec.to_commit())
+            self.block_store.save_block(
+                block, block_parts, seen_ec.to_commit(),
+                ext_commit=seen_ec.to_proto() if ext_enabled else None)
 
         fail_point("cs-before-wal-endheight")
 

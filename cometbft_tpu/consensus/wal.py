@@ -310,5 +310,40 @@ class WAL:
             return True, tail_msgs
         return False, []
 
+    def repair(self) -> bool:
+        """Repair mid-log corruption: back up every chunk, then truncate
+        the group at the first corrupt record (the reference backs up and
+        rewrites the valid prefix, consensus/wal.go corruption handling).
+        Returns True if anything was changed."""
+        import os
+        import shutil
+
+        self._group.flush()
+        changed = False
+        paths = self._group.chunk_paths()
+        for i, p in enumerate(paths):
+            try:
+                with open(p, "rb") as f:
+                    buf = f.read()
+            except FileNotFoundError:
+                continue
+            good = _valid_prefix_len(buf)
+            if good == len(buf):
+                continue
+            shutil.copyfile(p, p + ".corrupt")
+            with open(p, "r+b") as f:
+                f.truncate(good)
+            # everything after the corruption point is unusable
+            for later in paths[i + 1:]:
+                try:
+                    shutil.move(later, later + ".corrupt")
+                except FileNotFoundError:
+                    pass
+            changed = True
+            break
+        if changed:
+            self._group.reopen()
+        return changed
+
     def close(self) -> None:
         self._group.close()

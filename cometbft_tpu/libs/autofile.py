@@ -102,6 +102,17 @@ class Group:
                 pass
             self._min_index = chunks[0] + 1
 
+    def reopen(self) -> None:
+        """Re-open the head and rescan indexes after external surgery on
+        the group's files (WAL corruption repair)."""
+        with self._mtx:
+            try:
+                self._head.close()
+            except OSError:
+                pass
+            self._head = open(self.head_path, "ab")
+            self._min_index, self._max_index = self._scan_indexes()
+
     # -- reading -----------------------------------------------------------
 
     def chunk_paths(self) -> list[str]:

@@ -9,14 +9,19 @@ transport/switch -> (on start) RPC.
 
 from __future__ import annotations
 
+import logging
 import os
+
+_log = logging.getLogger(__name__)
 
 from ..abci.client import LocalClient
 from ..apps.kvstore import KVStoreApplication
 from ..blocksync.reactor import BlocksyncReactor
 from ..config import Config
 from ..consensus.reactor import ConsensusReactor
-from ..consensus.replay import Handshaker, catchup_replay
+from ..consensus.replay import (
+    ErrWALMissingEndHeight, Handshaker, catchup_replay)
+from ..consensus.wal import DataCorruptionError
 from ..consensus.state import ConsensusConfig, ConsensusState
 from ..consensus.wal import WAL
 from ..evidence import EvidencePool, EvidenceReactor
@@ -38,7 +43,8 @@ from ..types import events as ev
 from ..types.genesis import GenesisDoc
 
 # all gossip channels this node speaks
-NODE_CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40])
+NODE_CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40,
+                       0x60, 0x61])
 
 
 def init_files(config: Config, chain_id: str = "",
@@ -73,7 +79,11 @@ class Node(BaseService):
 
     def __init__(self, config: Config, app=None,
                  genesis: GenesisDoc | None = None,
-                 block_sync: bool = False):
+                 block_sync: bool = False,
+                 state_provider=None):
+        """`state_provider` injects a statesync StateProvider (tests use
+        in-memory light providers; production builds one from
+        config.statesync.rpc_servers)."""
         super().__init__("Node")
         self.config = config
         config.ensure_dirs()
@@ -119,6 +129,15 @@ class Node(BaseService):
         state = self.state_store.load() or state
         self.initial_state = state
 
+        # statesync decision: only a node with no history state-syncs
+        # (node.go:603 startStateSync gating); consensus + blocksync
+        # both wait for it
+        self._statesync_enabled = (config.statesync.enable and
+                                   state.last_block_height == 0)
+        self._state_provider = state_provider
+        if self._statesync_enabled and state_provider is None:
+            self._state_provider = self._build_state_provider(state)
+
         # mempool + evidence (node/setup.go)
         mc = config.mempool
         self.mempool = CListMempool(
@@ -155,19 +174,35 @@ class Node(BaseService):
             wal=self.wal, priv_validator=self.priv_validator,
             event_bus=self.event_bus, evidence_pool=self.evidence_pool,
             mempool=self.mempool)
-        # crash recovery: WAL tail replay for the in-flight height
+        # crash recovery: WAL tail replay for the in-flight height.
+        # Only the fresh-WAL case is benign; mid-log corruption gets one
+        # backup-and-truncate repair, and a node that STILL can't replay
+        # refuses to start rather than silently skip its locked round.
         if not block_sync:
             try:
                 catchup_replay(self.consensus_state,
                                self.consensus_state.height)
-            except Exception:
+            except ErrWALMissingEndHeight:
                 pass  # a fresh WAL has nothing to replay
+            except DataCorruptionError as e:
+                _log.warning("WAL corrupt (%s); attempting repair", e)
+                if not self.wal.repair():
+                    raise
+                # after a repair the EndHeight marker MUST be found: if
+                # the truncation ate it, the node may have signed votes
+                # it no longer remembers — refuse to start rather than
+                # risk equivocation (reference replay.go errors here)
+                catchup_replay(self.consensus_state,
+                               self.consensus_state.height)
         self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, wait_sync=block_sync)
+            self.consensus_state,
+            wait_sync=block_sync or self._statesync_enabled)
 
-        # blocksync
+        # blocksync: a statesyncing node activates it AFTER the snapshot
+        # restore (switch_to_blocksync), not at start
         self.blocksync_reactor = BlocksyncReactor(
-            state, self.block_exec, self.block_store, block_sync,
+            state, self.block_exec, self.block_store,
+            block_sync and not self._statesync_enabled,
             consensus_reactor=self.consensus_reactor)
 
         # p2p (node.go createTransport/createSwitch)
@@ -195,6 +230,12 @@ class Node(BaseService):
                                 EvidenceReactor(self.evidence_pool))
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
 
+        # statesync reactor: every node SERVES snapshots; a syncing node
+        # additionally carries a Syncer (node.go:450)
+        from ..statesync import StatesyncReactor
+        self.statesync_reactor = StatesyncReactor(self.app_conns.snapshot)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
         self.rpc_server = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -208,6 +249,64 @@ class Node(BaseService):
                  if a.strip()]
         if peers:
             self.switch.dial_peers_async(peers, persistent=True)
+        if self._statesync_enabled:
+            import threading
+            threading.Thread(target=self._run_statesync,
+                             name="statesync", daemon=True).start()
+
+    def _build_state_provider(self, state):
+        """Production path: light providers over the configured RPC
+        servers (stateprovider.go:47 NewLightClientStateProvider)."""
+        from ..light.client import TrustOptions
+        from ..light.provider import HttpProvider
+        from ..statesync import LightClientStateProvider
+        cfg = self.config.statesync
+        if len(cfg.rpc_servers) < 2:
+            raise ValueError(
+                "statesync requires at least 2 rpc_servers")
+        providers = []
+        for addr in cfg.rpc_servers:
+            if "://" not in addr:
+                addr = "http://" + addr
+            providers.append(HttpProvider(self.genesis.chain_id, addr))
+        opts = TrustOptions(period_ns=int(cfg.trust_period * 1e9),
+                            height=cfg.trust_height,
+                            hash=bytes.fromhex(cfg.trust_hash))
+        return LightClientStateProvider(
+            self.genesis.chain_id, state.initial_height, providers, opts)
+
+    def _run_statesync(self) -> None:
+        """Statesync bootstrap: restore a snapshot, persist the trusted
+        state + seen commit, then hand off to blocksync
+        (node.go:603 startStateSync -> node.go:158 BootstrapState)."""
+        from ..statesync import Syncer
+        from ..statesync.messages import SnapshotsRequest, wrap
+        from ..statesync.reactor import SNAPSHOT_CHANNEL
+        cfg = self.config.statesync
+        syncer = Syncer(self.app_conns.snapshot, self.app_conns.query,
+                        self._state_provider,
+                        self.statesync_reactor.request_chunk,
+                        chunk_fetchers=cfg.chunk_fetchers,
+                        retry_timeout=cfg.chunk_request_timeout)
+        self.statesync_reactor.syncer = syncer
+        for peer in self.switch.peers.list():
+            peer.try_send(SNAPSHOT_CHANNEL, wrap(SnapshotsRequest()))
+        try:
+            state, commit = syncer.sync_any(
+                discovery_time=cfg.discovery_time)
+        except Exception as e:
+            _log.error("statesync failed: %s; falling back to blocksync",
+                       e)
+            self.statesync_reactor.syncer = None
+            self.blocksync_reactor.switch_to_blocksync(self.initial_state)
+            return
+        # the reactor reverts to a pure server once sync finishes
+        self.statesync_reactor.syncer = None
+        # BootstrapState: persist trusted state + the commit FOR the
+        # snapshot height so blocksync/consensus can verify onward
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.blocksync_reactor.switch_to_blocksync(state)
 
     def on_stop(self) -> None:
         if self.rpc_server is not None:

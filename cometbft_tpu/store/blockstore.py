@@ -131,9 +131,13 @@ class BlockStore:
     # -- save --------------------------------------------------------------
 
     def save_block(self, block: Block, parts: PartSet,
-                   seen_commit: Commit | None) -> None:
-        """store/store.go:586 SaveBlock: meta + parts + LastCommit +
-        seen commit + hash index + extent, one atomic batch."""
+                   seen_commit: Commit | None,
+                   ext_commit: bytes | None = None) -> None:
+        """store/store.go:586 SaveBlock / :618 SaveBlockWithExtendedCommit:
+        meta + parts + LastCommit + seen commit + hash index + extent —
+        and, when vote extensions are enabled, the extended commit — in
+        ONE atomic batch, so a crash can never leave a committed block
+        without the extended commit its restart replay needs."""
         if block is None:
             raise ValueError("BlockStore can only save a non-nil block")
         height = block.header.height
@@ -162,6 +166,8 @@ class BlockStore:
             if seen_commit is not None:
                 sets.append((_k_seen_commit(height),
                              seen_commit.to_proto()))
+            if ext_commit is not None:
+                sets.append((_k_ext_commit(height), ext_commit))
             self._height = height
             if self._base == 0:
                 self._base = height
