@@ -43,20 +43,21 @@ def main() -> None:
         msgs.append(msg)
         sigs.append(ge._sign(seed, msg))
 
-    max_blocks = ed.max_blocks_for(msgs)
     bucket = dev.bucket_size(batch)
-    a, r, s, mh, ml, nb, valid = ed.pack_batch(pks, msgs, sigs, bucket,
-                                               max_blocks)
+    a, r, s, h, valid = ed.pack_batch(pks, msgs, sigs, bucket)
     assert valid.all()
 
-    # compile + correctness
-    verdict = np.asarray(dev.verify_batch_device(a, r, s, mh, ml, nb))
+    # compile + correctness (np.asarray forces a real device round-trip;
+    # under the axon tunnel block_until_ready alone can return early)
+    verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
     assert verdict[:batch].all(), "benchmark batch failed to verify"
 
+    # dispatches pipeline on-device; the single final np.asarray forces
+    # completion (one ~fixed readback amortized over iters)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = dev.verify_batch_device(a, r, s, mh, ml, nb)
-    jax.block_until_ready(out)
+    for _ in range(iters - 1):
+        dev.verify_batch_device(a, r, s, h)
+    out = np.asarray(dev.verify_batch_device(a, r, s, h))
     dt = (time.perf_counter() - t0) / iters
 
     sigs_per_sec = batch / dt

@@ -77,11 +77,9 @@ class TpuEd25519BatchVerifier:
         if n == 0:
             return False, []
         bucket = dev.bucket_size(n)
-        max_blocks = ed.max_blocks_for(self._msgs)
-        packed = ed.pack_batch(self._pks, self._msgs, self._sigs,
-                               bucket, max_blocks)
-        a, r, s, mh, ml, nb, valid = packed
-        verdict = np.asarray(dev.verify_batch_device(a, r, s, mh, ml, nb))
+        a, r, s, h, valid = ed.pack_batch(self._pks, self._msgs,
+                                          self._sigs, bucket)
+        verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
         verdict = verdict & valid
         out = verdict[:n].tolist()
         return all(out) and bool(out), out

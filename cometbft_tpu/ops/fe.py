@@ -1,0 +1,306 @@
+"""GF(2**255 - 19) arithmetic for TPU, v2: signed 20 x 13-bit limbs.
+
+Round-2 redesign driven by on-chip profiling.  The round-1 field library
+(f25519.py, 16x16-bit limbs) spent most of each multiplication in three
+sequential 16-step carry chains plus per-partial-product lo/hi
+splitting — a deep graph of mini-ops.  This version keeps every field op
+a SHALLOW graph of fusable elementwise ops:
+
+- limbs are SIGNED int32 in radix 2**13 (20 limbs = 260 bits; the wrap
+  constant is 608 = 19 * 2**5, since 2**260 == 19 * 2**5 mod p).
+  Signed limbs make subtraction/negation plain elementwise arithmetic —
+  no "4p padding" constants in the hot path.
+- products of 13-bit limbs fit so comfortably in int32 that a whole
+  schoolbook COLUMN (20 products, <= 20 * 9800**2 < 2**31) accumulates
+  with NO splitting, and carries are THREE data-parallel passes over
+  whole limb vectors (concat-shift, no 16-step ripple).
+
+Bound bookkeeping (the invariant every op maintains):
+  op outputs have limbs in [-1220, 9800]           ("weak" form)
+  mul inputs may have |limb| <= 10300:  20 * 10300**2 = 2.12e9 < 2**31.
+
+Reference analog: the 64-bit limb arithmetic inside curve25519-voi
+consumed by /root/reference/crypto/ed25519/ed25519.go.  The layout is an
+original TPU design, not a translation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 20
+RADIX = 13
+BASE = 1 << RADIX            # 8192
+MASK = BASE - 1
+WRAP = 19 << 5               # 608: 2**260 == 608 (mod p)
+P = (1 << 255) - 19
+
+_MAX_IN = 10300              # max |limb| mul accepts
+assert NLIMBS * _MAX_IN * _MAX_IN < (1 << 31)
+
+
+# ---------------------------------------------------------------------------
+# host <-> limb conversion
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int -> 20 int32 limbs (radix 2**13, little-endian)."""
+    x %= P
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    """Accepts redundant/signed limbs; value mod p."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(arr)) % P
+
+
+# curve constants
+D_INT = (-121665 * pow(121666, P - 2, P)) % P
+D2_INT = (2 * D_INT) % P
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+D_LIMBS = int_to_limbs(D_INT)
+D2_LIMBS = int_to_limbs(D2_INT)
+SQRT_M1_LIMBS = int_to_limbs(SQRT_M1_INT)
+ONE_LIMBS = int_to_limbs(1)
+ZERO_LIMBS = int_to_limbs(0)
+
+# canonical digits of p: [8173, 8191*18, 255]
+_P_CANON = np.zeros(NLIMBS, dtype=np.int32)
+_t = P
+for _i in range(NLIMBS):
+    _P_CANON[_i] = _t & MASK
+    _t >>= RADIX
+
+# 8p in 20 digits, every digit >= 2047: [8040, 8191*18, 2047].  Adding it
+# makes any weak-form (limbs >= -1220) element nonnegative.
+_PAD_8P = np.zeros(NLIMBS, dtype=np.int32)
+_t = 8 * P
+for _i in range(NLIMBS - 1):
+    _PAD_8P[_i] = _t & MASK
+    _t >>= RADIX
+_PAD_8P[NLIMBS - 1] = _t
+assert sum(int(v) << (RADIX * i) for i, v in enumerate(_PAD_8P)) == 8 * P
+assert (_PAD_8P >= 2047).all()
+
+
+# ---------------------------------------------------------------------------
+# carries: data-parallel whole-vector shifts, no ripple
+# ---------------------------------------------------------------------------
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry step on 20 limbs.  Arithmetic >> keeps floor
+    semantics for signed limbs, so lo is always in [0, 2**13); the top
+    limb's carry wraps through 2**260 == 608."""
+    hi = x >> RADIX
+    lo = x - (hi << RADIX)
+    wrapped = jnp.concatenate(
+        [hi[..., -1:] * jnp.int32(WRAP), hi[..., :-1]], axis=-1)
+    return lo + wrapped
+
+
+def norm_weak(x: jnp.ndarray) -> jnp.ndarray:
+    """Two passes: |limb| < 2**27 input -> limbs in [-1220, 9800].
+
+    Pass 1: lo in [0, 8191], carry-in |c| <= 2**14 + wrap |608*c_top|
+    ... after pass 2 carries are in [-2, 2] so limbs land in
+    [0-2*608, 8191+2+608] within the weak bound."""
+    return _carry_pass(_carry_pass(x))
+
+
+# ---------------------------------------------------------------------------
+# field ops (all outputs in weak form)
+# ---------------------------------------------------------------------------
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(-a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """20x20 schoolbook -> anti-diagonal columns -> carry -> 608-fold ->
+    two carry passes.  Inputs: |limb| <= 10300.
+
+    Column bound: 20 * 10300**2 = 2.12e9 < 2**31.  After the first
+    column-space carry pass, columns are < 2**13 + 2.12e9/2**13 ~ 267k;
+    folding multiplies the high half by 608: <= 608*267k ~ 1.63e8 < 2**31.
+    Two more passes land in weak form.
+    """
+    p = a[..., :, None] * b[..., None, :]            # (..., 20, 20)
+    col = _antidiag_sum(p)                           # (..., 39)
+    # carry pass in 40-wide column space (no wrap: col 39 catches it)
+    pad = [(0, 0)] * (col.ndim - 1) + [(0, 1)]
+    col = jnp.pad(col, pad)                          # (..., 40)
+    hi = col >> RADIX
+    lo = col - (hi << RADIX)
+    zero = jnp.zeros_like(hi[..., :1])
+    col = lo + jnp.concatenate([zero, hi[..., :-1]], axis=-1)
+    # fold: 2**260 == 608  =>  out_k = col_k + 608 * col_{20+k}
+    out = col[..., :NLIMBS] + jnp.int32(WRAP) * col[..., NLIMBS:]
+    return norm_weak(out)
+
+
+def _antidiag_sum(p: jnp.ndarray) -> jnp.ndarray:
+    """Sum p[..., i, j] over equal i+j -> (..., 39) via the skew-reshape
+    trick: one pad, one reshape, ONE reduction."""
+    n = NLIMBS
+    w = 2 * n
+    pad = [(0, 0)] * (p.ndim - 2) + [(0, 0), (0, n)]
+    skew = jnp.pad(p, pad).reshape(p.shape[:-2] + (n * w,))
+    skew = skew[..., :n * (w - 1)].reshape(p.shape[:-2] + (n, w - 1))
+    return skew.sum(axis=-2, dtype=jnp.int32)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_word(a: jnp.ndarray, w: int) -> jnp.ndarray:
+    """Multiply by a small nonneg constant: w * 10300 < 2**31."""
+    return norm_weak(a * jnp.int32(w))
+
+
+def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jax.lax.fori_loop(0, n, lambda i, v: sqr(v), x, unroll=8)
+
+
+def _pow_22501(z: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared prefix of the p-2 and (p-5)/8 chains: (z**(2**250-1), z**11)."""
+    z2 = sqr(z)
+    z9 = mul(_sq_n(z2, 2), z)
+    z11 = mul(z9, z2)
+    z2_5_0 = mul(sqr(z11), z9)
+    z2_10_0 = mul(_sq_n(z2_5_0, 5), z2_5_0)
+    z2_20_0 = mul(_sq_n(z2_10_0, 10), z2_10_0)
+    z2_40_0 = mul(_sq_n(z2_20_0, 20), z2_20_0)
+    z2_50_0 = mul(_sq_n(z2_40_0, 10), z2_10_0)
+    z2_100_0 = mul(_sq_n(z2_50_0, 50), z2_50_0)
+    z2_200_0 = mul(_sq_n(z2_100_0, 100), z2_100_0)
+    z2_250_0 = mul(_sq_n(z2_200_0, 50), z2_50_0)
+    return z2_250_0, z11
+
+
+def invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z**(p-2); returns 0 for z == 0."""
+    z2_250_0, z11 = _pow_22501(z)
+    return mul(_sq_n(z2_250_0, 5), z11)
+
+
+def pow_p58(z: jnp.ndarray) -> jnp.ndarray:
+    """z**((p-5)/8)."""
+    z2_250_0, _ = _pow_22501(z)
+    return mul(_sq_n(z2_250_0, 2), z)
+
+
+# ---------------------------------------------------------------------------
+# canonicalization / predicates (cold path: eq/identity checks)
+# ---------------------------------------------------------------------------
+
+def _seq_canonical_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact sequential carry over nonneg limbs, then reduce the bits at
+    and above 2**255 (limb 19 bits >= 8) through the 19-wrap."""
+    c = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    outs = []
+    for i in range(NLIMBS):
+        v = x[..., i] + c
+        lo = v & jnp.int32(MASK)
+        outs.append(lo)
+        c = (v - lo) >> RADIX
+    x = jnp.stack(outs, axis=-1)
+    # c is the carry out of limb 19 (units of 2**260 == 608)
+    top = x[..., 19] >> jnp.int32(8)         # bits 255.. of the value
+    x = x.at[..., 19].set(x[..., 19] & jnp.int32(0xFF))
+    add0 = top * jnp.int32(19) + c * jnp.int32(WRAP)
+    return x.at[..., 0].add(add0)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p).  Rare (eq/identity checks),
+    so a few exact 20-step ripples are fine."""
+    x = norm_weak(a) + jnp.asarray(_PAD_8P)   # all limbs > 0
+    for _ in range(3):
+        x = _seq_canonical_pass(x)
+    # value now < 2**255; subtract p once if needed
+    return _cond_sub_p(x)
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """x - p if x >= p else x, for canonical digits (value < 2**255)."""
+    p_l = jnp.asarray(_P_CANON)
+    gt = jnp.zeros(x.shape[:-1], dtype=bool)
+    eq_ = jnp.ones(x.shape[:-1], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        gt = gt | (eq_ & (x[..., i] > p_l[i]))
+        eq_ = eq_ & (x[..., i] == p_l[i])
+    take = (gt | eq_)[..., None]
+    diff = x - p_l
+    c = jnp.zeros(diff.shape[:-1], dtype=jnp.int32)
+    outs = []
+    for i in range(NLIMBS):
+        v = diff[..., i] + c
+        lo = v & jnp.int32(MASK)
+        outs.append(lo)
+        c = (v - lo) >> RADIX
+    diff = jnp.stack(outs, axis=-1)
+    return jnp.where(take, diff, x)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    return (freeze(a)[..., 0] & jnp.int32(1)).astype(jnp.uint32)
+
+
+def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """sqrt(u/v) per RFC 8032 decompression; returns (x, ok)."""
+    v3 = mul(sqr(v), v)
+    v7 = mul(sqr(v3), v)
+    r = mul(mul(u, v3), pow_p58(mul(u, v7)))
+    check = mul(v, sqr(r))
+    correct = eq(check, u)
+    flipped = eq(check, neg(u))
+    r_alt = mul(r, jnp.asarray(SQRT_M1_LIMBS))
+    x = jnp.where(flipped[..., None], r_alt, r)
+    return x, correct | flipped
+
+
+# ---------------------------------------------------------------------------
+# packing: 8 little-endian uint32 words -> limbs
+# ---------------------------------------------------------------------------
+
+def words32_to_limbs(words: jnp.ndarray) -> jnp.ndarray:
+    """(..., 8) uint32 LE words -> (..., 20) int32 limbs.  Bit 255 (the
+    sign bit of point encodings) is EXCLUDED: limb 19 holds bits
+    247..254 only."""
+    w = jnp.concatenate(
+        [words, jnp.zeros_like(words[..., :1])], axis=-1).astype(jnp.uint32)
+    limbs = []
+    for i in range(NLIMBS):
+        bit = RADIX * i
+        j, r = bit // 32, bit % 32
+        v = w[..., j] >> jnp.uint32(r)
+        if r + RADIX > 32:
+            v = v | (w[..., j + 1] << jnp.uint32(32 - r))
+        mask = MASK if i < NLIMBS - 1 else 0xFF   # drop the sign bit
+        limbs.append((v & jnp.uint32(mask)).astype(jnp.int32))
+    return jnp.stack(limbs, axis=-1)

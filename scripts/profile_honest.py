@@ -1,0 +1,66 @@
+"""Honest timing under axon: force a device->host readback of a scalar."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import f25519 as fe
+
+rng = np.random.default_rng(0)
+
+
+def bench(name, fn, *args, iters=10):
+    f = jax.jit(fn)
+    _ = np.asarray(f(*args))  # compile + one run
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = np.asarray(f(*args))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e6:10.1f} us")
+    return dt
+
+
+x = jax.device_put(jnp.asarray(rng.random((4096, 1024), np.float32)).astype(jnp.bfloat16))
+w = jax.device_put(jnp.asarray(rng.random((1024, 1024), np.float32)).astype(jnp.bfloat16))
+
+bench("1 matmul -> sum", lambda a, b: jnp.sum((a @ b).astype(jnp.float32)), x, w)
+
+
+def loopn(n):
+    def f(a, b):
+        def body(c, _):
+            return c @ b, ()
+        c, _ = jax.lax.scan(body, a, None, length=n)
+        return jnp.sum(c.astype(jnp.float32))
+    return f
+
+
+bench("10 matmuls -> sum", loopn(10), x, w)
+bench("100 matmuls -> sum", loopn(100), x, w)
+bench("400 matmuls -> sum", loopn(400), x, w, iters=5)
+
+a = jax.device_put(jnp.asarray(rng.integers(0, 1 << 15, (4096, 16), dtype=np.uint32)))
+b = jax.device_put(jnp.asarray(rng.integers(0, 1 << 15, (4096, 16), dtype=np.uint32)))
+
+
+def chain_elem(n):
+    def f(p, q):
+        for _ in range(n):
+            p = (p * q + p) & jnp.uint32(0x7FFF)
+        return jnp.sum(p)
+    return f
+
+
+def chain_mul(n):
+    def f(p, q):
+        for _ in range(n):
+            p = fe.mul(p, q)
+        return jnp.sum(p)
+    return f
+
+
+bench("1000 elementwise -> sum", chain_elem(1000), a, b)
+bench("1x fe.mul -> sum", chain_mul(1), a, b)
+bench("16x fe.mul -> sum", chain_mul(16), a, b)
+bench("64x fe.mul -> sum", chain_mul(64), a, b, iters=5)

@@ -1,0 +1,102 @@
+"""Microbenchmark the pieces of the ed25519 kernel on the real TPU.
+
+Usage: python scripts/profile_kernel.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import ed25519 as dev
+from cometbft_tpu.ops import f25519 as fe
+from cometbft_tpu.ops import limbs as lb
+from cometbft_tpu.ops import sha2
+
+N = 4096
+rng = np.random.default_rng(0)
+
+
+def bench(name, fn, *args, iters=20):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt*1e6:10.1f} us  ({dt/N*1e9:8.1f} ns/elem)")
+    return dt
+
+
+a = jnp.asarray(rng.integers(0, 1 << 16, (N, 16), dtype=np.uint32))
+b = jnp.asarray(rng.integers(0, 1 << 16, (N, 16), dtype=np.uint32))
+af = jnp.asarray(rng.random((N, 16), dtype=np.float32))
+bf = jnp.asarray(rng.random((N, 16), dtype=np.float32))
+ai = a.astype(jnp.int32)
+bi = b.astype(jnp.int32)
+
+print(f"device: {jax.devices()[0]}  N={N}")
+bench("u32 elementwise mul", lambda x, y: x * y, a, b)
+bench("i32 elementwise mul", lambda x, y: x * y, ai, bi)
+bench("f32 elementwise mul", lambda x, y: x * y, af, bf)
+bench("u32 outer 16x16 (mul_raw core)", lambda x, y: x[..., :, None] * y[..., None, :], a, b)
+bench("mul_raw (products+antidiag)", lb.mul_raw, a, b)
+bench("carry_prop alone", lambda x: lb.carry_prop(x)[0], a)
+bench("fe.mul (full)", fe.mul, a, b)
+bench("fe.sqr", fe.sqr, a)
+bench("fe.add", fe.add, a, b)
+
+# point ops
+pt = jnp.stack([a, b, a, b], axis=-2) % jnp.uint32(1 << 16)
+bench("point_add", dev.point_add, pt, pt)
+bench("point_double", dev.point_double, pt)
+
+# f32 matmul-style product: 8-bit limbs (32) outer product + fixed T contraction
+T_np = np.zeros((32 * 32, 63), dtype=np.float32)
+for i in range(32):
+    for j in range(32):
+        T_np[i * 32 + j, i + j] = 1.0
+T = jnp.asarray(T_np)
+a8 = jnp.asarray(rng.integers(0, 256, (N, 32), dtype=np.int32).astype(np.float32))
+b8 = jnp.asarray(rng.integers(0, 256, (N, 32), dtype=np.int32).astype(np.float32))
+
+
+def matmul_mul(x, y):
+    p = (x[:, :, None] * y[:, None, :]).reshape(N, 1024)
+    return jax.lax.dot_general(p, T, (((1,), (0,)), ((), ())),
+                               precision=jax.lax.Precision.HIGHEST)
+
+
+bench("f32 outer(32x32)+matmul T", matmul_mul, a8, b8)
+
+# int8 MXU check
+a8i = jnp.asarray(rng.integers(0, 64, (N, 1024), dtype=np.int8))
+T8 = jnp.asarray(T_np.astype(np.int8))
+
+
+def int8_dot(x):
+    return jax.lax.dot_general(x, T8, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+
+bench("int8 [N,1024]@[1024,63] dot", int8_dot, a8i)
+
+# sha512 on 2-block messages
+msgs = [bytes(rng.integers(0, 256, 100, dtype=np.uint8)) for _ in range(N)]
+mh, ml, nb = sha2.pad_sha512(msgs, 2)
+bench("sha512 2-block batch", sha2.sha512_blocks, jnp.asarray(mh), jnp.asarray(ml), jnp.asarray(nb), iters=5)
+
+# decompress
+enc = np.zeros((N, 8), dtype=np.uint32)
+from cometbft_tpu.crypto import ed25519_ref as ref
+base_enc = np.frombuffer(ref.point_compress(ref.B), dtype=np.uint32)
+enc[:] = base_enc
+bench("decompress", lambda e: dev.decompress(e)[0], jnp.asarray(enc), iters=5)
+
+# full verify at N
+import __graft_entry__ as ge
+args = ge._example_batch(N, msg_len=40)
+t = bench("verify_kernel N=4096", dev.verify_kernel, *args, iters=3)
+print(f"full kernel: {N/t:.0f} sigs/s")

@@ -1,0 +1,130 @@
+"""Marginal per-op costs via in-program scan repetition (axon-safe).
+
+Each candidate op is repeated R times inside one jitted program with a
+data dependency, so per-op cost = (T(R) - T(0)) / R regardless of the
+~65ms readback latency.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import f25519 as fe
+from cometbft_tpu.ops import ed25519 as dev
+
+rng = np.random.default_rng(0)
+N = 4096
+
+
+def timed(f, *args):
+    out = np.asarray(f(*args))
+    t0 = time.perf_counter()
+    out = np.asarray(f(*args))
+    return time.perf_counter() - t0
+
+
+def marginal(name, make_body, x0, R=256, per_batch=N):
+    """make_body() -> fn(carry)->carry; cost printed per op per element."""
+    body = make_body()
+
+    def prog(x, r):
+        def step(c, _):
+            return body(c), ()
+        c, _ = jax.lax.scan(step, x, None, length=r)
+        return jax.tree.map(lambda v: jnp.sum(v, dtype=jnp.uint32)
+                            if v.dtype != jnp.float32 else jnp.sum(v),
+                            c)
+
+    f0 = jax.jit(lambda x: prog(x, 4))
+    fR = jax.jit(lambda x: prog(x, R + 4))
+    t0 = min(timed(f0, x0) for _ in range(3))
+    tR = min(timed(fR, x0) for _ in range(3))
+    per = (tR - t0) / R
+    print(f"{name:40s} {per*1e6:9.1f} us/op  {per/per_batch*1e9:8.2f} ns/elem")
+    return per
+
+
+a0 = jax.device_put(jnp.asarray(
+    rng.integers(0, 1 << 15, (N, 16), dtype=np.uint32)))
+
+marginal("fe.mul (current, 16x16 carry chains)",
+         lambda: (lambda x: fe.mul(x, x)), a0)
+marginal("fe.add (current)", lambda: (lambda x: fe.add(x, x)), a0)
+
+# --- candidate: 13-bit x 20-limb lazy mul -------------------------------
+NL = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1
+
+
+def lazy_mul(x, y):
+    # x, y: (N, 20) uint32, limbs < 2**17 (redundant)
+    p = x[..., :, None] * y[..., None, :]          # (N, 20, 20) < 2**34?? keep inputs < 2**15.9
+    # antidiagonal sums via skew trick
+    na = NL
+    w = 2 * NL
+    pad = [(0, 0)] * (p.ndim - 2) + [(0, 0), (0, na)]
+    skew = jnp.pad(p, pad).reshape(p.shape[:-2] + (na * w,))
+    skew = skew[..., :na * (w - 1)].reshape(p.shape[:-2] + (na, w - 1))
+    col = skew.sum(axis=-2, dtype=jnp.uint32)       # (N, 39)
+    # carry once to shrink columns
+    lo = col & jnp.uint32(MASK)
+    hi = col >> jnp.uint32(RADIX)
+    col = lo + jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]],
+                               axis=-1)
+    top = jnp.concatenate([hi[..., -1:], jnp.zeros_like(hi[..., :-1])],
+                          axis=-1)  # carry out of col 38 -> col 39 ~ handled in fold
+    # fold: 2**260 == 19*2**5 (mod p): lo[k] += 608 * col[20+k]
+    c608 = jnp.uint32(19 << 5)
+    out = col[..., :NL]
+    out = out + c608 * jnp.concatenate(
+        [col[..., NL:], top[..., :1]], axis=-1)
+    # one more parallel carry step
+    lo = out & jnp.uint32(MASK)
+    hi = out >> jnp.uint32(RADIX)
+    out = lo + jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]],
+                               axis=-1)
+    out = out.at[..., 0].add(hi[..., -1] * jnp.uint32(19 << 4))  # 2**260/2**13=2**247?? placeholder
+    return out
+
+
+b0 = jax.device_put(jnp.asarray(
+    rng.integers(0, 1 << 13, (N, NL), dtype=np.uint32)))
+marginal("lazy 13x20 mul (approx)", lambda: (lambda x: lazy_mul(x, x)), b0)
+
+# --- point ops ----------------------------------------------------------
+pt0 = jax.device_put(jnp.asarray(
+    rng.integers(0, 1 << 15, (N, 4, 16), dtype=np.uint32)))
+marginal("point_double (current)",
+         lambda: (lambda p: dev.point_double(p)), pt0, R=64)
+marginal("point_add (current)",
+         lambda: (lambda p: dev.point_add(p, p)), pt0, R=64)
+
+# --- MXU-based mul: int8 path honest test -------------------------------
+T_np = np.zeros((1024, 64), dtype=np.int8)
+for i in range(32):
+    for j in range(32):
+        T_np[i * 32 + j, i + j] = 1
+T8 = jax.device_put(jnp.asarray(T_np))
+p0 = jax.device_put(jnp.asarray(
+    rng.integers(0, 64, (N, 1024), dtype=np.int8)))
+
+
+def int8dot(x):
+    r = jax.lax.dot_general(x, T8, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    # feed something int8 back as carry to keep the scan shape stable
+    return (r[..., :16].astype(jnp.int8).reshape(N, 16).repeat(64, -1)
+            )[:, :1024]
+
+
+marginal("int8 [N,1024]@[1024,64] dot", lambda: (lambda x: int8dot(x)), p0,
+         R=64)
+
+# --- big batch scaling for fe.mul ---------------------------------------
+for NN in (16384, 65536):
+    aa = jax.device_put(jnp.asarray(
+        rng.integers(0, 1 << 15, (NN, 16), dtype=np.uint32)))
+    marginal(f"fe.mul N={NN}", lambda: (lambda x: fe.mul(x, x)), aa, R=64,
+             per_batch=NN)

@@ -1,0 +1,81 @@
+"""Isolate per-call vs per-op vs per-byte cost on the axon TPU."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import f25519 as fe
+
+N = 4096
+rng = np.random.default_rng(0)
+a = jnp.asarray(rng.integers(0, 1 << 15, (N, 16), dtype=np.uint32))
+b = jnp.asarray(rng.integers(0, 1 << 15, (N, 16), dtype=np.uint32))
+
+
+def bench(name, fn, *args, iters=30):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:40s} {dt*1e6:10.1f} us")
+    return dt
+
+
+def chain_mul(n):
+    def f(x, y):
+        for _ in range(n):
+            x = fe.mul(x, y)
+        return x
+    return f
+
+
+def chain_elem(n):
+    def f(x, y):
+        for _ in range(n):
+            x = (x * y + x) & jnp.uint32(0x7FFF)
+        return x
+    return f
+
+
+def seq_carry(n):
+    """n fully sequential dependent steps on tiny slices."""
+    def f(x):
+        c = x[..., 0]
+        for i in range(1, n):
+            c = (c + x[..., i % 16]) * jnp.uint32(3) >> jnp.uint32(1)
+        return c
+    return f
+
+
+print("device:", jax.devices()[0])
+bench("noop (return x)", lambda x: x, a)
+bench("1 elementwise op", lambda x, y: x * y, a, b)
+bench("10 chained elementwise", chain_elem(10), a, b)
+bench("100 chained elementwise", chain_elem(100), a, b)
+bench("1000 chained elementwise", chain_elem(1000), a, b)
+bench("seq_carry 16 steps", seq_carry(16), a)
+bench("seq_carry 64 steps", seq_carry(64), a)
+bench("seq_carry 256 steps", seq_carry(256), a)
+bench("1x fe.mul", chain_mul(1), a, b)
+bench("4x fe.mul", chain_mul(4), a, b)
+bench("16x fe.mul", chain_mul(16), a, b, iters=10)
+bench("64x fe.mul", chain_mul(64), a, b, iters=5)
+
+# big batch scaling
+for nn in (16384, 65536):
+    aa = jnp.asarray(rng.integers(0, 1 << 15, (nn, 16), dtype=np.uint32))
+    bb = jnp.asarray(rng.integers(0, 1 << 15, (nn, 16), dtype=np.uint32))
+    bench(f"16x fe.mul N={nn}", chain_mul(16), aa, bb, iters=10)
+
+# matmul at honest shapes
+x = jnp.asarray(rng.random((4096, 1024), dtype=np.float32))
+w = jnp.asarray(rng.random((1024, 1024), dtype=np.float32))
+bench("f32 matmul 4096x1024x1024", lambda p, q: p @ q, x, w)
+xb = x.astype(jnp.bfloat16)
+wb = w.astype(jnp.bfloat16)
+bench("bf16 matmul 4096x1024x1024", lambda p, q: p @ q, xb, wb)

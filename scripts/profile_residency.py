@@ -1,0 +1,70 @@
+"""Distinguish per-dispatch / transfer overhead from real compute."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((4096, 1024), dtype=np.float32)).astype(jnp.bfloat16)
+w = jnp.asarray(rng.random((1024, 1024), dtype=np.float32)).astype(jnp.bfloat16)
+x = jax.device_put(x)
+w = jax.device_put(w)
+
+
+def bench(name, fn, iters=30):
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e6:10.1f} us")
+    return dt
+
+
+mm = jax.jit(lambda a, b: a @ b)
+print("committed:", x.committed, x.devices())
+bench("matmul fresh args each call", lambda: mm(x, w))
+
+# chain output->input so data must stay on device
+xx = x
+
+
+def chained():
+    global xx
+    xx = mm(xx, w)
+    return xx
+
+
+bench("matmul chained x=f(x)", chained)
+
+
+# 10 matmuls inside one jitted program
+@jax.jit
+def loop10(a, b):
+    def body(c, _):
+        return c @ b, ()
+    c, _ = jax.lax.scan(body, a, None, length=10)
+    return c
+
+
+bench("10 matmuls in one program (scan)", lambda: loop10(x, w), iters=10)
+
+
+@jax.jit
+def loop100(a, b):
+    def body(c, _):
+        return c @ b, ()
+    c, _ = jax.lax.scan(body, a, None, length=100)
+    return c
+
+
+bench("100 matmuls in one program (scan)", lambda: loop100(x, w), iters=5)
+
+# dispatch pipelining: 30 dispatches, single block
+t0 = time.perf_counter()
+outs = [mm(x, w) for _ in range(30)]
+jax.block_until_ready(outs)
+print(f"{'30 parallel dispatches (total/30)':44s} {(time.perf_counter()-t0)/30*1e6:10.1f} us")

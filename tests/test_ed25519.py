@@ -121,14 +121,14 @@ def test_barrett_reduce():
 
 def test_point_ops_match_reference():
     """Device add/double vs Python ints on random points."""
-    from cometbft_tpu.ops import f25519 as fe
+    from cometbft_tpu.ops import fe
     pts = []
     for _ in range(3):
         k = rng.randrange(1, ref.L)
         pts.append(ref.point_mul(k, ref.B))
 
     def to_dev(p):
-        return np.stack([lb.int_to_limbs(c, 16) for c in p])[None]
+        return np.stack([fe.int_to_limbs(c % ref.P) for c in p])[None]
 
     add = jax.jit(dev.point_add)
     dbl = jax.jit(dev.point_double)
@@ -136,13 +136,54 @@ def test_point_ops_match_reference():
         for q in pts:
             got = np.asarray(add(to_dev(p), to_dev(q)))[0]
             want = ref.point_add(p, q)
-            gx, gy, gz, gt = [lb.limbs_to_int(row) % ref.P for row in got]
+            gx, gy, gz, gt = [fe.limbs_to_int(row) for row in got]
             assert (gx * want[2] - want[0] * gz) % ref.P == 0
             assert (gy * want[2] - want[1] * gz) % ref.P == 0
         got = np.asarray(dbl(to_dev(p)))[0]
         want = ref.point_double(p)
-        gx, gy, gz, gt = [lb.limbs_to_int(row) % ref.P for row in got]
+        gx, gy, gz, gt = [fe.limbs_to_int(row) for row in got]
         assert (gx * want[2] - want[0] * gz) % ref.P == 0
         assert (gy * want[2] - want[1] * gz) % ref.P == 0
         # T consistency: T*Z == X*Y
         assert (gt * gz - gx * gy) % ref.P == 0
+
+
+def test_single_verify_fast_path_consistent_with_zip215():
+    """PubKey.verify_signature (OpenSSL fast path + ZIP-215 fallback)
+    must agree with the from-scratch ZIP-215 oracle, including the
+    cofactored-only case OpenSSL rejects."""
+    import hashlib
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import ed25519_ref as ref
+
+    seed, pub = ref.keygen(b"\x11" * 32)
+    pk = ed.PubKey(pub)
+
+    sig = ref.sign(seed, b"fast-path")
+    assert pk.verify_signature(b"fast-path", sig)
+    assert not pk.verify_signature(b"other", sig)
+    assert not pk.verify_signature(b"fast-path", sig[:-1] + b"\x01")
+
+    # Craft a signature whose R carries an 8-torsion component: the
+    # cofactored ZIP-215 equation holds, the cofactorless one fails, so
+    # the OpenSSL fast path must fall back (not reject) for parity with
+    # the batch kernel's semantics.
+    t8 = ref.point_decompress(bytes.fromhex(
+        "26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05"))
+    assert t8 is not None
+    h = hashlib.sha512(seed).digest()
+    a = ref._clamp(h)
+    prefix = h[32:]
+    msg = b"torsion"
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(),
+                       "little") % ref.L
+    r_pt = ref.point_mul(r, ref.B)
+    r_enc = ref.point_compress(ref.point_add(r_pt, t8))
+    k = int.from_bytes(hashlib.sha512(r_enc + pub + msg).digest(),
+                       "little") % ref.L
+    s = (r + k * a) % ref.L
+    tsig = r_enc + s.to_bytes(32, "little")
+    assert ref.verify(pub, msg, tsig), "oracle: cofactored must accept"
+    assert pk.verify_signature(msg, tsig), \
+        "fast path must fall back to ZIP-215, not reject"

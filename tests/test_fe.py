@@ -1,0 +1,109 @@
+"""fe.py (v2 field layer: signed 20x13-bit limbs) vs Python ints.
+
+The invariant-stability chain is the critical test: limbs must stay
+inside the documented weak-form bounds through arbitrarily long
+mul/add/sub compositions (this is what the lazy-carry design promises).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cometbft_tpu.ops import fe
+
+P = fe.P
+rng = np.random.default_rng(7)
+
+VALS = [0, 1, 2, P - 1, P - 2, 19, (1 << 255) - 20, fe.D_INT, fe.D2_INT]
+VALS += [int(rng.integers(1, 1 << 62)) ** 4 % P for _ in range(23)]
+
+
+def to_dev(xs):
+    return jnp.asarray(np.stack([fe.int_to_limbs(x) for x in xs]))
+
+
+A_INT = VALS
+B_INT = list(reversed(VALS))
+A = to_dev(A_INT)
+B = to_dev(B_INT)
+
+
+class TestFieldOps:
+    def test_mul_add_sub_sqr(self):
+        mul = np.asarray(jax.jit(fe.mul)(A, B))
+        add = np.asarray(jax.jit(fe.add)(A, B))
+        sub = np.asarray(jax.jit(fe.sub)(A, B))
+        sq = np.asarray(jax.jit(fe.sqr)(A))
+        ng = np.asarray(jax.jit(fe.neg)(A))
+        for i, (x, y) in enumerate(zip(A_INT, B_INT)):
+            assert fe.limbs_to_int(mul[i]) == x * y % P
+            assert fe.limbs_to_int(add[i]) == (x + y) % P
+            assert fe.limbs_to_int(sub[i]) == (x - y) % P
+            assert fe.limbs_to_int(sq[i]) == x * x % P
+            assert fe.limbs_to_int(ng[i]) == (-x) % P
+
+    def test_freeze_canonical(self):
+        frz = np.asarray(jax.jit(fe.freeze)(A))
+        for i, x in enumerate(A_INT):
+            v = sum(int(l) << (13 * k) for k, l in enumerate(frz[i]))
+            assert v == x % P
+            assert all(0 <= l < 8192 for l in frz[i])
+
+    def test_invert(self):
+        inv = np.asarray(jax.jit(fe.invert)(A))
+        for i, x in enumerate(A_INT):
+            expect = pow(x, P - 2, P) if x % P else 0
+            assert fe.limbs_to_int(inv[i]) == expect
+
+    def test_chain_stability(self):
+        """50 rounds of mul/add/sub keep limbs in the weak-form bounds."""
+        @jax.jit
+        def chain(x, a, b):
+            def body(c, _):
+                return fe.sub(fe.add(fe.mul(c, b), a), b), ()
+            out, _ = jax.lax.scan(body, x, None, length=50)
+            return out
+
+        out = np.asarray(chain(A, A, B))
+        for i, (x0, y0) in enumerate(zip(A_INT, B_INT)):
+            v = x0
+            for _ in range(50):
+                v = (v * y0 + x0 - y0) % P
+            assert fe.limbs_to_int(out[i]) == v
+        assert out.min() >= -1300 and out.max() <= 10300
+
+    def test_sqrt_ratio(self):
+        x, ok = jax.jit(fe.sqrt_ratio)(A, B)
+        x, ok = np.asarray(x), np.asarray(ok)
+        for i, (ui, vi) in enumerate(zip(A_INT, B_INT)):
+            if vi % P == 0:
+                continue
+            r = ui * pow(vi, P - 2, P) % P
+            if r == 0:
+                assert ok[i]
+                continue
+            is_qr = pow(r, (P - 1) // 2, P) == 1
+            assert bool(ok[i]) == is_qr
+            if is_qr:
+                xv = fe.limbs_to_int(x[i])
+                assert xv * xv % P == r
+
+    def test_eq_is_zero_parity(self):
+        z = to_dev([0, 0])
+        assert np.asarray(jax.jit(fe.is_zero)(z)).all()
+        assert not np.asarray(jax.jit(fe.is_zero)(A[2:3])).any()
+        pr = np.asarray(jax.jit(fe.parity)(A))
+        for i, x in enumerate(A_INT):
+            assert pr[i] == (x % P) & 1
+        # equal values in different redundant forms
+        shifted = jax.jit(fe.sub)(jax.jit(fe.add)(A, B), B)
+        assert np.asarray(jax.jit(fe.eq)(shifted, A)).all()
+
+    def test_words32_roundtrip(self):
+        enc = rng.integers(0, 1 << 32, (6, 8), dtype=np.uint32)
+        limbs = np.asarray(jax.jit(fe.words32_to_limbs)(jnp.asarray(enc)))
+        for row_enc, row_l in zip(enc, limbs):
+            val = int.from_bytes(row_enc.tobytes(), "little") & ((1 << 255) - 1)
+            got = sum(int(v) << (13 * k) for k, v in enumerate(row_l))
+            assert got == val
