@@ -1,0 +1,13 @@
+from .reactor import StatesyncReactor, SNAPSHOT_CHANNEL, CHUNK_CHANNEL
+from .syncer import (
+    Syncer, SyncError, ErrNoSnapshots, ErrAbort, ErrRejectSnapshot,
+    ErrRetrySnapshot, ErrTimeout,
+)
+from .stateprovider import StateProvider, LightClientStateProvider
+
+__all__ = [
+    "StatesyncReactor", "SNAPSHOT_CHANNEL", "CHUNK_CHANNEL",
+    "Syncer", "SyncError", "ErrNoSnapshots", "ErrAbort",
+    "ErrRejectSnapshot", "ErrRetrySnapshot", "ErrTimeout",
+    "StateProvider", "LightClientStateProvider",
+]

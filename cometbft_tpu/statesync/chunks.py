@@ -1,0 +1,132 @@
+"""Chunk queue for an in-flight snapshot restore
+(reference statesync/chunks.go).
+
+Tracks per-chunk status (unallocated -> allocated -> received), hands
+chunks to the applier strictly in index order, and supports the app's
+retry/refetch/discard-sender verbs.  Chunks are kept in memory — our
+snapshots are app-defined blobs and the reference's temp-file layer is
+an implementation detail of Go's GC pressure, not of the protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+class ErrDone(Exception):
+    pass
+
+
+@dataclass
+class Chunk:
+    height: int
+    format: int
+    index: int
+    chunk: bytes
+    sender: str
+
+
+class ChunkQueue:
+    def __init__(self, height: int, format: int, n_chunks: int):
+        self.height = height
+        self.format = format
+        self.n = n_chunks
+        self._mtx = threading.Lock()
+        self._cv = threading.Condition(self._mtx)
+        self._allocated: set[int] = set()
+        self._received: dict[int, Chunk] = {}
+        self._returned: set[int] = set()   # handed to the applier
+        self._next = 0                     # next index Next() will serve
+        self._closed = False
+
+    def size(self) -> int:
+        return self.n
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def allocate(self) -> int:
+        """Assign an unallocated chunk index to a fetcher (chunks.go
+        Allocate); raises ErrDone when all chunks are allocated."""
+        with self._mtx:
+            if self._closed:
+                raise ErrDone
+            for i in range(self.n):
+                if i not in self._allocated and i not in self._received:
+                    self._allocated.add(i)
+                    return i
+            raise ErrDone
+
+    def add(self, chunk: Chunk) -> bool:
+        """Store a received chunk; False if dup/out-of-range."""
+        with self._cv:
+            if self._closed or not (0 <= chunk.index < self.n):
+                return False
+            if chunk.index in self._received:
+                return False
+            self._received[chunk.index] = chunk
+            self._allocated.discard(chunk.index)
+            self._cv.notify_all()
+            return True
+
+    def has(self, index: int) -> bool:
+        with self._mtx:
+            return index in self._received
+
+    def next(self, timeout: float = 30.0) -> Chunk:
+        """Next chunk in strict index order (blocks until received);
+        raises ErrDone when every chunk has been returned."""
+        with self._cv:
+            if self._next >= self.n:
+                raise ErrDone
+            deadline = None
+            while self._next not in self._received:
+                if self._closed:
+                    raise ErrDone
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"timed out waiting for chunk {self._next}")
+            chunk = self._received[self._next]
+            self._returned.add(self._next)
+            self._next += 1
+            return chunk
+
+    def retry(self, index: int) -> None:
+        """Re-serve this chunk to the applier (app said RETRY)."""
+        with self._cv:
+            self._next = min(self._next, index)
+            self._cv.notify_all()
+
+    def retry_all(self) -> None:
+        with self._cv:
+            self._next = 0
+            self._cv.notify_all()
+
+    def discard(self, index: int) -> None:
+        """Drop a chunk so it gets refetched (app's refetch_chunks)."""
+        with self._cv:
+            self._received.pop(index, None)
+            self._allocated.discard(index)
+            self._next = min(self._next, index)
+
+    def discard_sender(self, sender: str) -> None:
+        """Drop all NOT-yet-applied chunks from a rejected sender
+        (chunks.go DiscardSender keeps already-returned ones)."""
+        with self._cv:
+            for i, c in list(self._received.items()):
+                if c.sender == sender and i not in self._returned:
+                    self._received.pop(i)
+                    self._allocated.discard(i)
+
+    def wait_for(self, index: int, timeout: float) -> bool:
+        """Block until chunk `index` arrives; False on timeout/closed."""
+        with self._cv:
+            deadline_hit = False
+            while index not in self._received and not self._closed:
+                if not self._cv.wait(timeout=timeout):
+                    deadline_hit = True
+                    break
+            return index in self._received and not deadline_hit

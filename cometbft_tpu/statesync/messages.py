@@ -1,0 +1,162 @@
+"""Statesync wire messages (reference statesync/messages.go, proto
+cometbft/statesync/v1/types.proto).
+
+Top-level Message is a oneof: snapshots_request=1, snapshots_response=2,
+chunk_request=3, chunk_response=4.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..libs import protowire as pw
+
+# max sizes (reference statesync/messages.go:15-21)
+SNAPSHOT_MSG_SIZE = 4 * 1024 * 1024   # 4 MiB
+CHUNK_MSG_SIZE = 16 * 1024 * 1024     # 16 MiB
+
+
+@dataclass
+class SnapshotsRequest:
+    TAG = 1
+
+    def to_proto(self) -> bytes:
+        return b""
+
+    @staticmethod
+    def from_proto(p: bytes) -> "SnapshotsRequest":
+        return SnapshotsRequest()
+
+
+@dataclass
+class SnapshotsResponse:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+    TAG = 2
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().uvarint_field(1, self.height)
+                .uvarint_field(2, self.format)
+                .uvarint_field(3, self.chunks)
+                .bytes_field(4, self.hash)
+                .bytes_field(5, self.metadata).bytes())
+
+    @staticmethod
+    def from_proto(p: bytes) -> "SnapshotsResponse":
+        r = pw.Reader(p)
+        m = SnapshotsResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_uvarint()
+            elif f == 2 and w == pw.VARINT:
+                m.format = r.read_uvarint()
+            elif f == 3 and w == pw.VARINT:
+                m.chunks = r.read_uvarint()
+            elif f == 4 and w == pw.BYTES:
+                m.hash = r.read_bytes()
+            elif f == 5 and w == pw.BYTES:
+                m.metadata = r.read_bytes()
+            else:
+                r.skip(w)
+        return m
+
+    def validate_basic(self) -> None:
+        if self.height == 0:
+            raise ValueError("snapshot height cannot be 0")
+        if self.chunks == 0:
+            raise ValueError("snapshot has no chunks")
+        if not self.hash:
+            raise ValueError("snapshot has no hash")
+
+
+@dataclass
+class ChunkRequest:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+
+    TAG = 3
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().uvarint_field(1, self.height)
+                .uvarint_field(2, self.format)
+                .uvarint_field(3, self.index).bytes())
+
+    @staticmethod
+    def from_proto(p: bytes) -> "ChunkRequest":
+        r = pw.Reader(p)
+        m = ChunkRequest()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_uvarint()
+            elif f == 2 and w == pw.VARINT:
+                m.format = r.read_uvarint()
+            elif f == 3 and w == pw.VARINT:
+                m.index = r.read_uvarint()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class ChunkResponse:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+    chunk: bytes = b""
+    missing: bool = False
+
+    TAG = 4
+
+    def to_proto(self) -> bytes:
+        w = (pw.Writer().uvarint_field(1, self.height)
+             .uvarint_field(2, self.format)
+             .uvarint_field(3, self.index)
+             .bytes_field(4, self.chunk))
+        if self.missing:
+            w.int_field(5, 1)
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(p: bytes) -> "ChunkResponse":
+        r = pw.Reader(p)
+        m = ChunkResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_uvarint()
+            elif f == 2 and w == pw.VARINT:
+                m.format = r.read_uvarint()
+            elif f == 3 and w == pw.VARINT:
+                m.index = r.read_uvarint()
+            elif f == 4 and w == pw.BYTES:
+                m.chunk = r.read_bytes()
+            elif f == 5 and w == pw.VARINT:
+                m.missing = r.read_int() != 0
+            else:
+                r.skip(w)
+        return m
+
+
+_TYPES = {c.TAG: c for c in (SnapshotsRequest, SnapshotsResponse,
+                             ChunkRequest, ChunkResponse)}
+
+
+def wrap(msg) -> bytes:
+    return pw.Writer().message_field(msg.TAG, msg.to_proto()).bytes()
+
+
+def unwrap(payload: bytes):
+    r = pw.Reader(payload)
+    while not r.at_end():
+        f, w = r.read_tag()
+        if w == pw.BYTES and f in _TYPES:
+            return _TYPES[f].from_proto(r.read_bytes())
+        r.skip(w)
+    raise ValueError("empty statesync message")

@@ -1,0 +1,111 @@
+"""Statesync p2p reactor: snapshot discovery + chunk serving
+(reference statesync/reactor.go).
+
+Channels: 0x60 snapshot metadata, 0x61 chunk contents.  The serving
+side answers from the app over the snapshot ABCI connection; the
+syncing side feeds the Syncer's pool/queue.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..abci import types as at
+from ..p2p.base_reactor import Envelope, Reactor
+from ..p2p.conn.connection import ChannelDescriptor
+from . import messages as msgs
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+RECENT_SNAPSHOTS = 10   # reactor.go:31
+
+
+_log = logging.getLogger(__name__)
+
+
+class StatesyncReactor(Reactor):
+    def __init__(self, snapshot_conn, syncer=None):
+        """`snapshot_conn`: ABCI client for ListSnapshots /
+        LoadSnapshotChunk (serving side).  `syncer`: present only on a
+        node that is itself state-syncing."""
+        super().__init__("StatesyncReactor")
+        self._conn = snapshot_conn
+        self.syncer = syncer
+
+    def get_channels(self) -> list:
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10,
+                              recv_message_capacity=msgs.SNAPSHOT_MSG_SIZE),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=4,
+                              recv_message_capacity=msgs.CHUNK_MSG_SIZE),
+        ]
+
+    def add_peer(self, peer) -> None:
+        """reactor.go:110: when syncing, ask every new peer for its
+        snapshots."""
+        if self.syncer is not None:
+            peer.send(SNAPSHOT_CHANNEL, msgs.wrap(msgs.SnapshotsRequest()))
+
+    def remove_peer(self, peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.id)
+
+    def request_chunk(self, peer_id: str, req: msgs.ChunkRequest) -> None:
+        """Syncer callback: route a chunk request to a specific peer."""
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            peer.send(CHUNK_CHANNEL, msgs.wrap(req))
+
+    def receive(self, envelope: Envelope) -> None:
+        try:
+            msg = msgs.unwrap(envelope.message)
+        except ValueError:
+            return
+        peer = envelope.src
+        if isinstance(msg, msgs.SnapshotsRequest):
+            self._serve_snapshots(peer)
+        elif isinstance(msg, msgs.SnapshotsResponse):
+            if self.syncer is not None:
+                try:
+                    msg.validate_basic()
+                except ValueError:
+                    return
+                self.syncer.add_snapshot(peer.id, msg)
+        elif isinstance(msg, msgs.ChunkRequest):
+            self._serve_chunk(peer, msg)
+        elif isinstance(msg, msgs.ChunkResponse):
+            if self.syncer is not None:
+                self.syncer.add_chunk(peer.id, msg)
+
+    # -- serving side ------------------------------------------------------
+
+    def _serve_snapshots(self, peer) -> None:
+        """reactor.go:133: advertise the app's most recent snapshots."""
+        try:
+            resp = self._conn.list_snapshots(at.ListSnapshotsRequest())
+        except Exception as e:
+            _log.warning("failed to list snapshots: %s", e)
+            return
+        snaps = sorted(resp.snapshots,
+                       key=lambda s: (s.height, s.format), reverse=True)
+        for s in snaps[:RECENT_SNAPSHOTS]:
+            peer.send(SNAPSHOT_CHANNEL, msgs.wrap(msgs.SnapshotsResponse(
+                height=s.height, format=s.format, chunks=s.chunks,
+                hash=s.hash, metadata=s.metadata)))
+
+    def _serve_chunk(self, peer, req: msgs.ChunkRequest) -> None:
+        """reactor.go:171."""
+        try:
+            resp = self._conn.load_snapshot_chunk(
+                at.LoadSnapshotChunkRequest(height=req.height,
+                                            format=req.format,
+                                            chunk=req.index))
+            chunk = resp.chunk
+        except Exception as e:
+            _log.warning("failed to load chunk: %s", e)
+            chunk = b""
+        peer.send(CHUNK_CHANNEL, msgs.wrap(msgs.ChunkResponse(
+            height=req.height, format=req.format, index=req.index,
+            chunk=chunk, missing=not chunk)))

@@ -1,0 +1,99 @@
+"""Snapshot pool: dedups advertised snapshots and ranks candidates
+(reference statesync/snapshots.go).
+
+Ranking: newest height first, then highest format; peers advertising a
+snapshot are tracked so chunk requests rotate over them and bad actors
+can be blacklisted.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+    trusted_app_hash: bytes = field(default=b"", compare=False)
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.chunks, self.hash)
+
+
+class SnapshotPool:
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._snapshots: dict[tuple, Snapshot] = {}
+        self._peers: dict[tuple, set[str]] = {}
+        self._blacklist_hash: set[bytes] = set()
+        self._blacklist_format: set[int] = set()
+        self._blacklist_peer: set[str] = set()
+
+    def add(self, snapshot: Snapshot, peer_id: str) -> bool:
+        """Returns True if the snapshot is new (snapshots.go Add)."""
+        with self._mtx:
+            if snapshot.hash in self._blacklist_hash or \
+                    snapshot.format in self._blacklist_format or \
+                    peer_id in self._blacklist_peer:
+                return False
+            key = snapshot.key()
+            new = key not in self._snapshots
+            if new:
+                self._snapshots[key] = snapshot
+                self._peers[key] = set()
+            self._peers[key].add(peer_id)
+            return new
+
+    def best(self) -> Snapshot | None:
+        """Highest (height, format) candidate with at least one peer."""
+        with self._mtx:
+            ranked = sorted(
+                (s for k, s in self._snapshots.items() if self._peers[k]),
+                key=lambda s: (s.height, s.format), reverse=True)
+            return ranked[0] if ranked else None
+
+    def get_peer(self, snapshot: Snapshot) -> str | None:
+        with self._mtx:
+            peers = [p for p in self._peers.get(snapshot.key(), ())
+                     if p not in self._blacklist_peer]
+            return random.choice(peers) if peers else None
+
+    def get_peers(self, snapshot: Snapshot) -> list[str]:
+        with self._mtx:
+            return sorted(self._peers.get(snapshot.key(), ()))
+
+    def reject(self, snapshot: Snapshot) -> None:
+        with self._mtx:
+            self._blacklist_hash.add(snapshot.hash)
+            self._snapshots.pop(snapshot.key(), None)
+            self._peers.pop(snapshot.key(), None)
+
+    def reject_format(self, format: int) -> None:
+        with self._mtx:
+            self._blacklist_format.add(format)
+            for key in [k for k, s in self._snapshots.items()
+                        if s.format == format]:
+                self._snapshots.pop(key, None)
+                self._peers.pop(key, None)
+
+    def reject_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._blacklist_peer.add(peer_id)
+            self._remove_peer(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._remove_peer(peer_id)
+
+    def _remove_peer(self, peer_id: str) -> None:
+        for key in list(self._peers):
+            self._peers[key].discard(peer_id)
+            if not self._peers[key]:
+                # keep the snapshot; a new peer may re-advertise it
+                pass

@@ -1,0 +1,289 @@
+"""Snapshot restore driver (reference statesync/syncer.go).
+
+SyncAny picks the best advertised snapshot, light-verifies the app hash
+for its height, offers it to the app over the snapshot ABCI connection,
+fetches + applies chunks (with the app's retry/refetch/reject verbs),
+verifies the restored app, and returns the trusted (state, commit) the
+node bootstraps from.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..abci import types as at
+from . import messages as msgs
+from .chunks import Chunk, ChunkQueue, ErrDone
+from .snapshots import Snapshot, SnapshotPool
+
+_log = logging.getLogger(__name__)
+
+
+class SyncError(Exception):
+    pass
+
+
+class ErrNoSnapshots(SyncError):
+    pass
+
+
+class ErrAbort(SyncError):
+    pass
+
+
+class ErrRejectSnapshot(SyncError):
+    pass
+
+
+class ErrRejectFormat(SyncError):
+    pass
+
+
+class ErrRejectSender(SyncError):
+    pass
+
+
+class ErrRetrySnapshot(SyncError):
+    pass
+
+
+class ErrTimeout(SyncError):
+    pass
+
+
+class ErrNoProvider(SyncError):
+    pass
+
+
+class Syncer:
+    """statesync/syncer.go:68 newSyncer.
+
+    `snapshot_conn` / `query_conn`: ABCI clients (proxy AppConns).
+    `state_provider`: trusted state source (light-client backed).
+    `send_chunk_request(peer_id, ChunkRequest)`: reactor callback.
+    """
+
+    def __init__(self, snapshot_conn, query_conn, state_provider,
+                 send_chunk_request, chunk_fetchers: int = 4,
+                 retry_timeout: float = 5.0, chunk_timeout: float = 60.0):
+        self.pool = SnapshotPool()
+        self._conn = snapshot_conn
+        self._query = query_conn
+        self._provider = state_provider
+        self._send_chunk_request = send_chunk_request
+        self._fetchers = chunk_fetchers
+        self._retry_timeout = retry_timeout
+        self._chunk_timeout = chunk_timeout
+        self._mtx = threading.Lock()
+        self._chunks: ChunkQueue | None = None
+
+    # -- reactor-facing ----------------------------------------------------
+
+    def add_snapshot(self, peer_id: str, resp: msgs.SnapshotsResponse) -> bool:
+        snap = Snapshot(height=resp.height, format=resp.format,
+                        chunks=resp.chunks, hash=resp.hash,
+                        metadata=resp.metadata)
+        added = self.pool.add(snap, peer_id)
+        if added:
+            _log.info("discovered snapshot height=%d format=%d chunks=%d",
+                      snap.height, snap.format, snap.chunks)
+        return added
+
+    def add_chunk(self, peer_id: str, resp: msgs.ChunkResponse) -> bool:
+        with self._mtx:
+            q = self._chunks
+        if q is None or resp.height != q.height or resp.format != q.format:
+            return False
+        if resp.missing:
+            return False
+        return q.add(Chunk(resp.height, resp.format, resp.index,
+                           resp.chunk, peer_id))
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.pool.remove_peer(peer_id)
+
+    # -- sync loop ---------------------------------------------------------
+
+    def sync_any(self, discovery_time: float = 15.0, retry_hook=None,
+                 max_rounds: int = 0):
+        """syncer.go:144 SyncAny: loop over candidate snapshots until one
+        restores, handling the app's verdicts.  Returns (state, commit).
+        `max_rounds` bounds discovery waits (0 = forever)."""
+        snapshot = None
+        chunks = None
+        rounds = 0
+        while True:
+            if snapshot is None:
+                snapshot = self.pool.best()
+                chunks = None
+            if snapshot is None:
+                rounds += 1
+                if max_rounds and rounds > max_rounds:
+                    raise ErrNoSnapshots("no snapshots discovered")
+                if retry_hook:
+                    retry_hook()
+                time.sleep(discovery_time)
+                continue
+            if chunks is None:
+                chunks = ChunkQueue(snapshot.height, snapshot.format,
+                                    snapshot.chunks)
+            try:
+                return self._sync(snapshot, chunks)
+            except ErrAbort:
+                raise
+            except ErrRetrySnapshot:
+                chunks.retry_all()
+                _log.info("retrying snapshot height=%d", snapshot.height)
+                continue
+            except ErrTimeout:
+                self.pool.reject(snapshot)
+                _log.warning("chunk timeout; rejected snapshot height=%d",
+                             snapshot.height)
+            except ErrRejectFormat:
+                self.pool.reject_format(snapshot.format)
+            except ErrRejectSender:
+                for pid in self.pool.get_peers(snapshot):
+                    self.pool.reject_peer(pid)
+            except ErrNoProvider:
+                raise
+            except ErrRejectSnapshot:
+                self.pool.reject(snapshot)
+            chunks.close()
+            snapshot = None
+            chunks = None
+
+    def _sync(self, snapshot: Snapshot, chunks: ChunkQueue):
+        """syncer.go:240 Sync."""
+        with self._mtx:
+            if self._chunks is not None:
+                raise SyncError("a state sync is already in progress")
+            self._chunks = chunks
+        stop = threading.Event()
+        try:
+            # trusted app hash via the light client; failure rejects the
+            # snapshot (a lying peer, or the chain is too short)
+            try:
+                app_hash = self._provider.app_hash(snapshot.height)
+            except Exception as e:
+                _log.info("failed to verify app hash: %s", e)
+                raise ErrRejectSnapshot(str(e))
+            snapshot = Snapshot(snapshot.height, snapshot.format,
+                                snapshot.chunks, snapshot.hash,
+                                snapshot.metadata, app_hash)
+
+            self._offer_snapshot(snapshot)
+
+            threads = [threading.Thread(
+                target=self._fetch_chunks, args=(snapshot, chunks, stop),
+                name=f"chunk-fetcher-{i}", daemon=True)
+                for i in range(self._fetchers)]
+            for t in threads:
+                t.start()
+
+            # optimistically build the trusted state/commit (failures
+            # surface before we spend time applying chunks)
+            try:
+                state = self._provider.state(snapshot.height)
+                commit = self._provider.commit(snapshot.height)
+            except Exception as e:
+                _log.info("failed to build trusted state: %s", e)
+                raise ErrRejectSnapshot(str(e))
+
+            self._apply_chunks(chunks)
+            self._verify_app(snapshot)
+            _log.info("snapshot restored height=%d", snapshot.height)
+            return state, commit
+        finally:
+            stop.set()
+            with self._mtx:
+                self._chunks = None
+
+    def _offer_snapshot(self, snapshot: Snapshot) -> None:
+        """syncer.go:321."""
+        resp = self._conn.offer_snapshot(at.OfferSnapshotRequest(
+            snapshot=at.Snapshot(
+                height=snapshot.height, format=snapshot.format,
+                chunks=snapshot.chunks, hash=snapshot.hash,
+                metadata=snapshot.metadata),
+            app_hash=snapshot.trusted_app_hash))
+        r = resp.result
+        if r == at.OFFER_SNAPSHOT_ACCEPT:
+            return
+        if r == at.OFFER_SNAPSHOT_ABORT:
+            raise ErrAbort("app aborted snapshot restore")
+        if r == at.OFFER_SNAPSHOT_REJECT:
+            raise ErrRejectSnapshot("app rejected snapshot")
+        if r == at.OFFER_SNAPSHOT_REJECT_FORMAT:
+            raise ErrRejectFormat("app rejected snapshot format")
+        if r == at.OFFER_SNAPSHOT_REJECT_SENDER:
+            raise ErrRejectSender("app rejected snapshot senders")
+        raise SyncError(f"unknown OfferSnapshot result {r}")
+
+    def _apply_chunks(self, chunks: ChunkQueue) -> None:
+        """syncer.go:357."""
+        while True:
+            try:
+                chunk = chunks.next(timeout=self._chunk_timeout)
+            except ErrDone:
+                return
+            except TimeoutError as e:
+                raise ErrTimeout(str(e))
+            resp = self._conn.apply_snapshot_chunk(
+                at.ApplySnapshotChunkRequest(
+                    index=chunk.index, chunk=chunk.chunk,
+                    sender=chunk.sender))
+            for index in resp.refetch_chunks:
+                chunks.discard(index)
+            for sender in resp.reject_senders:
+                if sender:
+                    self.pool.reject_peer(sender)
+                    chunks.discard_sender(sender)
+            r = resp.result
+            if r == at.APPLY_CHUNK_ACCEPT:
+                continue
+            if r == at.APPLY_CHUNK_ABORT:
+                raise ErrAbort("app aborted chunk apply")
+            if r == at.APPLY_CHUNK_RETRY:
+                chunks.retry(chunk.index)
+                continue
+            if r == at.APPLY_CHUNK_RETRY_SNAPSHOT:
+                raise ErrRetrySnapshot("app requested snapshot retry")
+            if r == at.APPLY_CHUNK_REJECT_SNAPSHOT:
+                raise ErrRejectSnapshot("app rejected snapshot mid-apply")
+            raise SyncError(f"unknown ApplySnapshotChunk result {r}")
+
+    def _fetch_chunks(self, snapshot: Snapshot, chunks: ChunkQueue,
+                      stop: threading.Event) -> None:
+        """syncer.go:414: allocate -> request from a peer -> wait, with
+        re-request on timeout; loops for refetches until stopped."""
+        index = None
+        while not stop.is_set():
+            if index is None:
+                try:
+                    index = chunks.allocate()
+                except ErrDone:
+                    if stop.wait(timeout=1.0):
+                        return
+                    continue
+            peer_id = self.pool.get_peer(snapshot)
+            if peer_id is not None:
+                self._send_chunk_request(peer_id, msgs.ChunkRequest(
+                    height=snapshot.height, format=snapshot.format,
+                    index=index))
+            if chunks.wait_for(index, timeout=self._retry_timeout):
+                index = None     # delivered; allocate the next one
+
+    def _verify_app(self, snapshot: Snapshot) -> None:
+        """syncer.go:479: app hash + height must match after restore."""
+        resp = self._query.info(at.InfoRequest())
+        if resp.last_block_app_hash != snapshot.trusted_app_hash:
+            raise SyncError(
+                f"app hash mismatch after restore: expected "
+                f"{snapshot.trusted_app_hash.hex()}, got "
+                f"{resp.last_block_app_hash.hex()}")
+        if resp.last_block_height != snapshot.height:
+            raise SyncError(
+                f"app height mismatch after restore: expected "
+                f"{snapshot.height}, got {resp.last_block_height}")
