@@ -43,7 +43,7 @@ from ..types import events as ev
 from ..types.genesis import GenesisDoc
 
 # all gossip channels this node speaks
-NODE_CHANNELS = bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40,
+NODE_CHANNELS = bytes([0x00, 0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40,
                        0x60, 0x61])
 
 
@@ -235,6 +235,28 @@ class Node(BaseService):
         from ..statesync import StatesyncReactor
         self.statesync_reactor = StatesyncReactor(self.app_conns.snapshot)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
+        # peer exchange + address book (node.go:463-501)
+        self.addr_book = None
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from ..p2p.pex import AddrBook, NetAddress, PexReactor
+            self.addr_book = AddrBook(
+                os.path.join(config.base.root_dir,
+                             config.p2p.addr_book_file))
+            try:
+                self.addr_book.add_our_address(
+                    NetAddress(self.node_key.id, "0.0.0.0", 0))
+            except ValueError:
+                pass
+            self.addr_book.add_private_ids(
+                [i.strip()
+                 for i in config.p2p.private_peer_ids.split(",")
+                 if i.strip()])
+            seeds = [s.strip() for s in config.p2p.seeds.split(",")
+                     if s.strip()]
+            self.pex_reactor = PexReactor(self.addr_book, seeds=seeds)
+            self.switch.add_reactor("PEX", self.pex_reactor)
 
         self.rpc_server = None
 

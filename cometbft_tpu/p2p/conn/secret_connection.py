@@ -139,6 +139,13 @@ class SecretConnection:
                 view = view[len(chunk):]
         return n
 
+    def remote_host(self) -> str:
+        """Observed IP of the other side (for PEX address learning)."""
+        try:
+            return self._sock.getpeername()[0]
+        except OSError:
+            return ""
+
     def read(self) -> bytes:
         """One decrypted frame's payload (empty bytes = EOF)."""
         with self._recv_mtx:

@@ -1,0 +1,5 @@
+from .addrbook import AddrBook, NetAddress, KnownAddress
+from .reactor import PexReactor, PEX_CHANNEL
+
+__all__ = ["AddrBook", "NetAddress", "KnownAddress", "PexReactor",
+           "PEX_CHANNEL"]

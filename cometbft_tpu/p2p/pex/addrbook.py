@@ -1,0 +1,293 @@
+"""Bucketed peer address book (reference p2p/pex/addrbook.go).
+
+Addresses live in 256 "new" buckets (heard about, unvetted) and 64
+"old" buckets (connected successfully).  Bucket placement is a keyed
+hash of (address group, source group) so an attacker controlling one
+/16 cannot fill the whole book; promotion to old happens on mark_good,
+demotion back to new on mark_bad.  The book persists as JSON.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+NEW_BUCKETS_PER_ADDRESS = 4      # addrbook.go:34 maxNewBucketsPerAddress
+MAX_GET_SELECTION = 250          # addrbook.go GetSelection cap
+GET_SELECTION_PERCENT = 23       # % of book per PEX response
+NEED_ADDRESS_THRESHOLD = 1000    # addrbook.go:44
+BAD_ATTEMPTS = 3                 # attempts before an address is stale
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    """id@host:port."""
+    node_id: str
+    host: str
+    port: int
+
+    @staticmethod
+    def parse(s: str) -> "NetAddress":
+        node_id, _, hostport = s.partition("@")
+        host, _, port = hostport.rpartition(":")
+        if not node_id or not host or not port:
+            raise ValueError(f"invalid address {s!r}")
+        return NetAddress(node_id, host, int(port))
+
+    def __str__(self) -> str:
+        return f"{self.node_id}@{self.host}:{self.port}"
+
+    def group(self) -> str:
+        """Coarse locality key: /16 for dotted quads, else the host.
+        The sybil-resistance unit of bucket placement."""
+        parts = self.host.split(".")
+        if len(parts) == 4 and all(p.isdigit() for p in parts):
+            return ".".join(parts[:2])
+        return self.host
+
+
+@dataclass
+class KnownAddress:
+    """addrbook.go knownAddress."""
+    addr: NetAddress
+    src: NetAddress | None = None
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"
+    buckets: list = field(default_factory=list)
+
+    def is_old(self) -> bool:
+        return self.bucket_type == "old"
+
+    def is_bad(self) -> bool:
+        """Stale: several failed attempts and no success since."""
+        return (self.attempts >= BAD_ATTEMPTS and
+                self.last_success < self.last_attempt)
+
+    def to_json(self) -> dict:
+        return {"addr": str(self.addr),
+                "src": str(self.src) if self.src else None,
+                "attempts": self.attempts,
+                "last_attempt": self.last_attempt,
+                "last_success": self.last_success,
+                "bucket_type": self.bucket_type,
+                "buckets": self.buckets}
+
+    @staticmethod
+    def from_json(d: dict) -> "KnownAddress":
+        return KnownAddress(
+            addr=NetAddress.parse(d["addr"]),
+            src=NetAddress.parse(d["src"]) if d.get("src") else None,
+            attempts=d.get("attempts", 0),
+            last_attempt=d.get("last_attempt", 0.0),
+            last_success=d.get("last_success", 0.0),
+            bucket_type=d.get("bucket_type", "new"),
+            buckets=list(d.get("buckets", [])))
+
+
+class AddrBook:
+    def __init__(self, file_path: str = "", key: bytes | None = None):
+        self._path = file_path
+        self._key = key or os.urandom(16)    # keyed bucket hashing
+        self._mtx = threading.Lock()
+        self._rand = random.Random()
+        self._by_id: dict[str, KnownAddress] = {}
+        self._new: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]
+        self._old: list[set[str]] = [set() for _ in range(OLD_BUCKET_COUNT)]
+        self._our_ids: set[str] = set()
+        self._private_ids: set[str] = set()
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # -- identity filters --------------------------------------------------
+
+    def add_our_address(self, addr: NetAddress) -> None:
+        with self._mtx:
+            self._our_ids.add(addr.node_id)
+
+    def add_private_ids(self, ids: list[str]) -> None:
+        with self._mtx:
+            self._private_ids.update(ids)
+
+    # -- bucket placement --------------------------------------------------
+
+    def _bucket_idx(self, addr: NetAddress, src: NetAddress | None,
+                    n_buckets: int) -> int:
+        src_group = src.group() if src else ""
+        h = hashlib.sha256(
+            self._key + addr.group().encode() + b"|" +
+            src_group.encode()).digest()
+        return int.from_bytes(h[:4], "big") % n_buckets
+
+    # -- mutation ----------------------------------------------------------
+
+    def add_address(self, addr: NetAddress,
+                    src: NetAddress | None = None) -> bool:
+        """Heard about addr from src -> a new bucket (addrbook.go:213).
+        Re-adds are probabilistic, capped at 4 new buckets per address."""
+        with self._mtx:
+            if addr.node_id in self._our_ids or \
+                    addr.node_id in self._private_ids:
+                return False
+            ka = self._by_id.get(addr.node_id)
+            if ka is not None:
+                if ka.is_old():
+                    return False
+                if len(ka.buckets) >= NEW_BUCKETS_PER_ADDRESS:
+                    return False
+                # probabilistically spread across more buckets
+                if self._rand.random() > 1 / (2 ** len(ka.buckets)):
+                    return False
+            else:
+                ka = KnownAddress(addr=addr, src=src)
+                self._by_id[addr.node_id] = ka
+            idx = self._bucket_idx(addr, src, NEW_BUCKET_COUNT)
+            if idx not in ka.buckets:
+                self._evict_if_full(self._new[idx], old=False)
+                self._new[idx].add(addr.node_id)
+                ka.buckets.append(idx)
+            return True
+
+    def _evict_if_full(self, bucket: set, old: bool,
+                       cap: int = 64) -> None:
+        if len(bucket) < cap:
+            return
+        # drop the worst (stalest) entry
+        worst = max(bucket, key=lambda nid: (
+            self._by_id[nid].is_bad(), -self._by_id[nid].last_success))
+        self._remove_locked(worst)
+
+    def remove_address(self, addr: NetAddress) -> None:
+        with self._mtx:
+            self._remove_locked(addr.node_id)
+
+    def _remove_locked(self, node_id: str) -> None:
+        ka = self._by_id.pop(node_id, None)
+        if ka is None:
+            return
+        buckets = self._old if ka.is_old() else self._new
+        for idx in ka.buckets:
+            buckets[idx].discard(node_id)
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        with self._mtx:
+            ka = self._by_id.get(addr.node_id)
+            if ka:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+
+    def mark_good(self, addr: NetAddress) -> None:
+        """Successful handshake: promote to an old bucket
+        (addrbook.go MarkGood -> moveToOld)."""
+        with self._mtx:
+            ka = self._by_id.get(addr.node_id)
+            if ka is None:
+                ka = KnownAddress(addr=addr)
+                self._by_id[addr.node_id] = ka
+            ka.attempts = 0
+            ka.last_success = ka.last_attempt = time.time()
+            if ka.is_old():
+                return
+            for idx in ka.buckets:
+                self._new[idx].discard(addr.node_id)
+            idx = self._bucket_idx(ka.addr, ka.src, OLD_BUCKET_COUNT)
+            self._evict_if_full(self._old[idx], old=True)
+            ka.bucket_type = "old"
+            ka.buckets = [idx]
+            self._old[idx].add(addr.node_id)
+
+    def mark_bad(self, addr: NetAddress) -> None:
+        with self._mtx:
+            ka = self._by_id.get(addr.node_id)
+            if ka:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+                if ka.is_bad():
+                    self._remove_locked(addr.node_id)
+
+    # -- queries -----------------------------------------------------------
+
+    def has_address(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            return addr.node_id in self._by_id
+
+    def is_good(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            ka = self._by_id.get(addr.node_id)
+            return ka is not None and ka.is_old()
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._by_id)
+
+    def empty(self) -> bool:
+        return self.size() == 0
+
+    def need_more_addrs(self) -> bool:
+        return self.size() < NEED_ADDRESS_THRESHOLD
+
+    def pick_address(self, bias_towards_new: int = 30) -> NetAddress | None:
+        """Random address, biased between old/new books
+        (addrbook.go:272 PickAddress)."""
+        with self._mtx:
+            bias = max(0, min(100, bias_towards_new))
+            n_old = sum(len(b) for b in self._old)
+            n_new = sum(len(b) for b in self._new)
+            if n_old == 0 and n_new == 0:
+                return None
+            pick_old = n_old > 0 and (
+                n_new == 0 or self._rand.random() * 100 >= bias)
+            buckets = self._old if pick_old else self._new
+            nonempty = [b for b in buckets if b]
+            bucket = self._rand.choice(nonempty)
+            nid = self._rand.choice(sorted(bucket))
+            return self._by_id[nid].addr
+
+    def get_selection(self) -> list[NetAddress]:
+        """Random subset for a PEX response (addrbook.go GetSelection):
+        23% of the book, capped at 250."""
+        with self._mtx:
+            all_ids = list(self._by_id)
+            n = min(MAX_GET_SELECTION,
+                    max(1, len(all_ids) * GET_SELECTION_PERCENT // 100))
+            self._rand.shuffle(all_ids)
+            return [self._by_id[i].addr for i in all_ids[:n]]
+
+    def addresses(self) -> list[NetAddress]:
+        with self._mtx:
+            return [ka.addr for ka in self._by_id.values()]
+
+    # -- persistence (addrbook.go saveToFile/loadFromFile) -----------------
+
+    def save(self) -> None:
+        if not self._path:
+            return
+        with self._mtx:
+            data = {"key": self._key.hex(),
+                    "addrs": [ka.to_json()
+                              for ka in self._by_id.values()]}
+        tmp = self._path + ".tmp"
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path)
+
+    def _load(self) -> None:
+        with open(self._path) as f:
+            data = json.load(f)
+        self._key = bytes.fromhex(data["key"])
+        for d in data.get("addrs", []):
+            ka = KnownAddress.from_json(d)
+            self._by_id[ka.addr.node_id] = ka
+            buckets = self._old if ka.is_old() else self._new
+            for idx in ka.buckets:
+                if 0 <= idx < len(buckets):
+                    buckets[idx].add(ka.addr.node_id)

@@ -1,0 +1,258 @@
+"""PEX reactor: peer-exchange gossip + outbound peer maintenance
+(reference p2p/pex/pex_reactor.go).
+
+Channel 0x00.  Wire: Message{ oneof: PexRequest=1 | PexAddrs=2 } with
+NetAddress{id=1, ip=2, port=3} (proto cometbft/p2p/v1/pex.proto).
+
+An ensure-peers routine tops up outbound connections from the address
+book (biased toward new addresses when few peers are connected) and
+falls back to seeds when the book is empty.  Request throttling: a peer
+may only be asked / may only ask once per interval; unsolicited
+PexAddrs are a protocol offense.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ...libs import protowire as pw
+from ..base_reactor import Envelope, Reactor
+from ..conn.connection import ChannelDescriptor
+from .addrbook import AddrBook, NetAddress
+
+PEX_CHANNEL = 0x00
+DEFAULT_ENSURE_PEERS_PERIOD = 30.0
+MIN_RECEIVE_REQUEST_INTERVAL = 1.0   # tests shrink this
+MAX_MSG_SIZE = 64 * 1024
+
+_log = logging.getLogger(__name__)
+
+
+@dataclass
+class PexRequest:
+    TAG = 1
+
+    def to_proto(self) -> bytes:
+        return b""
+
+    @staticmethod
+    def from_proto(p: bytes) -> "PexRequest":
+        return PexRequest()
+
+
+@dataclass
+class PexAddrs:
+    addrs: list = field(default_factory=list)   # list[NetAddress]
+
+    TAG = 2
+
+    def to_proto(self) -> bytes:
+        w = pw.Writer()
+        for a in self.addrs:
+            inner = (pw.Writer().string_field(1, a.node_id)
+                     .string_field(2, a.host)
+                     .uvarint_field(3, a.port))
+            w.message_field(1, inner.bytes())
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(p: bytes) -> "PexAddrs":
+        r = pw.Reader(p)
+        m = PexAddrs()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.BYTES:
+                rr = pw.Reader(r.read_bytes())
+                nid, host, port = "", "", 0
+                while not rr.at_end():
+                    ff, ww = rr.read_tag()
+                    if ff == 1 and ww == pw.BYTES:
+                        nid = rr.read_string()
+                    elif ff == 2 and ww == pw.BYTES:
+                        host = rr.read_string()
+                    elif ff == 3 and ww == pw.VARINT:
+                        port = rr.read_uvarint()
+                    else:
+                        rr.skip(ww)
+                if nid and host and 0 < port < 65536:
+                    m.addrs.append(NetAddress(nid, host, port))
+            else:
+                r.skip(w)
+        return m
+
+
+def _wrap(msg) -> bytes:
+    return pw.Writer().message_field(msg.TAG, msg.to_proto()).bytes()
+
+
+def _unwrap(payload: bytes):
+    r = pw.Reader(payload)
+    while not r.at_end():
+        f, w = r.read_tag()
+        if w == pw.BYTES:
+            if f == PexRequest.TAG:
+                return PexRequest.from_proto(r.read_bytes())
+            if f == PexAddrs.TAG:
+                return PexAddrs.from_proto(r.read_bytes())
+        r.skip(w)
+    raise ValueError("empty pex message")
+
+
+class PexReactor(Reactor):
+    def __init__(self, book: AddrBook, seeds: list[str] | None = None,
+                 ensure_peers_period: float = DEFAULT_ENSURE_PEERS_PERIOD,
+                 min_request_interval: float = MIN_RECEIVE_REQUEST_INTERVAL):
+        super().__init__("PexReactor")
+        self.book = book
+        self.seeds = [NetAddress.parse(s) for s in (seeds or [])]
+        self._period = ensure_peers_period
+        self._min_interval = min_request_interval
+        self._last_received: dict[str, float] = {}
+        self._requested: set[str] = set()
+        self._stop = threading.Event()
+
+    def get_channels(self) -> list:
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10,
+                                  recv_message_capacity=MAX_MSG_SIZE)]
+
+    def on_start(self) -> None:
+        self._stop.clear()
+        threading.Thread(target=self._ensure_peers_routine,
+                         name="pex-ensure-peers", daemon=True).start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+        self.book.save()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        """pex_reactor.go:183: learn an outbound peer's self-reported
+        address; ask inbound peers for more addresses if we're short."""
+        addr = self._peer_net_address(peer)
+        if peer.outbound:
+            if addr is not None:
+                self.book.mark_good(addr)
+        else:
+            if addr is not None:
+                self.book.add_address(addr, src=addr)
+            if self.book.need_more_addrs():
+                self._request_addrs(peer)
+
+    def remove_peer(self, peer, reason) -> None:
+        self._requested.discard(peer.id)
+        self._last_received.pop(peer.id, None)
+
+    @staticmethod
+    def _peer_net_address(peer) -> NetAddress | None:
+        try:
+            if peer.socket_addr:
+                host, _, port = peer.socket_addr.rpartition(":")
+                listen = peer.node_info.listen_addr or ""
+                lport = listen.rsplit(":", 1)[-1] if ":" in listen else port
+                return NetAddress(peer.id, host, int(lport))
+        except (ValueError, AttributeError):
+            return None
+        return None
+
+    # -- gossip ------------------------------------------------------------
+
+    def _request_addrs(self, peer) -> None:
+        if peer.id in self._requested:
+            return
+        self._requested.add(peer.id)
+        peer.try_send(PEX_CHANNEL, _wrap(PexRequest()))
+
+    def receive(self, envelope: Envelope) -> None:
+        try:
+            msg = _unwrap(envelope.message)
+        except ValueError:
+            return
+        peer = envelope.src
+        if isinstance(msg, PexRequest):
+            now = time.monotonic()
+            last = self._last_received.get(peer.id, 0.0)
+            if now - last < self._min_interval:
+                # request flooding (pex_reactor.go:292): evict
+                if self.switch is not None:
+                    self.switch.stop_peer_for_error(
+                        peer, "pex request flood")
+                return
+            self._last_received[peer.id] = now
+            sel = self.book.get_selection()
+            peer.try_send(PEX_CHANNEL, _wrap(PexAddrs(addrs=sel)))
+        elif isinstance(msg, PexAddrs):
+            if peer.id not in self._requested:
+                # unsolicited addrs (pex_reactor.go:342): protocol abuse
+                if self.switch is not None:
+                    self.switch.stop_peer_for_error(
+                        peer, "unsolicited pex addrs")
+                return
+            self._requested.discard(peer.id)
+            src = self._peer_net_address(peer)
+            for addr in msg.addrs[:MAX_MSG_SIZE // 64]:
+                self.book.add_address(addr, src=src)
+
+    # -- outbound maintenance ----------------------------------------------
+
+    def _ensure_peers_routine(self) -> None:
+        # jittered initial wait like the reference, then periodic
+        self._ensure_peers()
+        while not self._stop.wait(self._period):
+            self._ensure_peers()
+
+    def _ensure_peers(self) -> None:
+        """pex_reactor.go:435: top up outbound peers from the book."""
+        if self.switch is None:
+            return
+        nums = self.switch.num_peers()
+        out = nums["outbound"] + nums.get("dialing", 0)
+        need = self.switch.max_outbound - out
+        if need <= 0:
+            return
+        # few peers -> explore (bias to new); many -> exploit (old)
+        total = nums["outbound"] + nums["inbound"]
+        bias = max(30, 100 - total * 10)
+        tried: set[str] = set()
+        dialed = 0
+        for _ in range(need * 3):
+            if dialed >= need:
+                break
+            cand = self.book.pick_address(bias)
+            if cand is None:
+                break
+            if cand.node_id in tried or \
+                    self.switch.peers.has(cand.node_id):
+                tried.add(cand.node_id)
+                continue
+            tried.add(cand.node_id)
+            self.book.mark_attempt(cand)
+            try:
+                self.switch.dial_peer(str(cand))
+                self.book.mark_good(cand)
+                dialed += 1
+            except Exception:
+                self.book.mark_bad(cand)
+        # ask a connected peer for more when the book runs dry
+        if self.book.need_more_addrs():
+            peers = self.switch.peers.list()
+            if peers:
+                import random
+                self._request_addrs(random.choice(peers))
+        if dialed == 0 and self.book.empty() and self.seeds:
+            self._dial_seeds()
+
+    def _dial_seeds(self) -> None:
+        import random
+        seeds = list(self.seeds)
+        random.shuffle(seeds)
+        for seed in seeds:
+            try:
+                self.switch.dial_peer(str(seed))
+                return
+            except Exception:
+                continue

@@ -1,0 +1,169 @@
+"""PEX + address book (reference p2p/pex/addrbook_test.go,
+pex_reactor_test.go): bucket behavior, persistence, gossip throttling,
+and the discovery integration — A learns about C through B and dials it.
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.node_info import NodeInfo
+from cometbft_tpu.p2p.pex import AddrBook, NetAddress, PexReactor
+from cometbft_tpu.p2p.pex.reactor import PexAddrs, PexRequest, _unwrap, _wrap
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport
+
+
+def addr(i, port=26656, host=None):
+    return NetAddress(f"id{i:04x}" + "0" * 32, host or f"10.0.{i % 256}.1",
+                      port)
+
+
+class TestAddrBook:
+    def test_add_pick_roundtrip(self):
+        book = AddrBook()
+        for i in range(50):
+            assert book.add_address(addr(i), src=addr(999))
+        assert book.size() == 50
+        picked = book.pick_address(bias_towards_new=100)
+        assert picked is not None and book.has_address(picked)
+
+    def test_mark_good_promotes_to_old(self):
+        book = AddrBook()
+        a = addr(1)
+        book.add_address(a, src=addr(2))
+        assert not book.is_good(a)
+        book.mark_good(a)
+        assert book.is_good(a)
+        # old addresses are not re-added to new buckets
+        assert not book.add_address(a, src=addr(3))
+
+    def test_mark_bad_eventually_removes(self):
+        book = AddrBook()
+        a = addr(1)
+        book.add_address(a, src=addr(2))
+        for _ in range(3):
+            book.mark_bad(a)
+        assert not book.has_address(a)
+
+    def test_our_and_private_addresses_rejected(self):
+        book = AddrBook()
+        me = addr(7)
+        book.add_our_address(me)
+        assert not book.add_address(me, src=addr(1))
+        priv = addr(8)
+        book.add_private_ids([priv.node_id])
+        assert not book.add_address(priv, src=addr(1))
+
+    def test_selection_capped(self):
+        book = AddrBook()
+        for i in range(300):
+            book.add_address(addr(i), src=addr(999))
+        sel = book.get_selection()
+        assert 1 <= len(sel) <= 250
+        assert len({a.node_id for a in sel}) == len(sel)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path)
+        good = addr(1)
+        book.add_address(good, src=addr(2))
+        book.mark_good(good)
+        book.add_address(addr(3), src=addr(2))
+        book.save()
+        book2 = AddrBook(path)
+        assert book2.size() == 2
+        assert book2.is_good(good)
+        assert not book2.is_good(addr(3))
+
+    def test_parse_format(self):
+        a = NetAddress.parse("abcd@1.2.3.4:26656")
+        assert (a.node_id, a.host, a.port) == ("abcd", "1.2.3.4", 26656)
+        assert str(a) == "abcd@1.2.3.4:26656"
+        with pytest.raises(ValueError):
+            NetAddress.parse("no-at-sign:26656")
+
+    def test_group_buckets_by_slash16(self):
+        assert addr(1, host="1.2.3.4").group() == "1.2"
+        assert addr(1, host="example.com").group() == "example.com"
+
+
+class TestPexMessages:
+    def test_roundtrip(self):
+        assert isinstance(_unwrap(_wrap(PexRequest())), PexRequest)
+        m = PexAddrs(addrs=[addr(1), addr(2)])
+        back = _unwrap(_wrap(m))
+        assert back.addrs == m.addrs
+
+
+def _mk_switch(name, with_pex=True, **pex_kwargs):
+    node_key = NodeKey(PrivKey.generate())
+    # reserve a real port so the self-reported listen_addr is dialable
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    info = NodeInfo(node_id=node_key.id, network="pex-test",
+                    channels=bytes([0x00]), moniker=name,
+                    listen_addr=f"127.0.0.1:{port}")
+    sw = Switch(MultiplexTransport(node_key, info),
+                listen_addr=f"127.0.0.1:{port}")
+    book = AddrBook()
+    pex = PexReactor(book, ensure_peers_period=0.3,
+                     min_request_interval=0.05, **pex_kwargs)
+    if with_pex:
+        sw.add_reactor("PEX", pex)
+    return sw, node_key, book, pex, port
+
+
+class TestPexDiscovery:
+    def test_a_learns_c_via_b_and_dials(self):
+        """pex_reactor_test.go discovery: A only knows B; C only knows
+        B; PEX spreads the addresses and A ends up connected to C."""
+        sw_a, key_a, book_a, _, port_a = _mk_switch("a")
+        sw_b, key_b, book_b, _, port_b = _mk_switch("b")
+        sw_c, key_c, book_c, _, port_c = _mk_switch("c")
+        for sw in (sw_a, sw_b, sw_c):
+            sw.start()
+        try:
+            # B in the middle: A and C both dial it
+            sw_a.dial_peer(f"{key_b.id}@127.0.0.1:{port_b}")
+            sw_c.dial_peer(f"{key_b.id}@127.0.0.1:{port_b}")
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if sw_a.peers.has(key_c.id) or sw_c.peers.has(key_a.id):
+                    break
+                time.sleep(0.1)
+            assert sw_a.peers.has(key_c.id) or sw_c.peers.has(key_a.id), \
+                (f"discovery failed: A-book={book_a.size()} "
+                 f"B-book={book_b.size()} C-book={book_c.size()}")
+        finally:
+            for sw in (sw_a, sw_b, sw_c):
+                sw.stop()
+
+    def test_request_flood_evicts(self):
+        sw_a, key_a, _, pex_a, port_a = _mk_switch("a")
+        sw_b, key_b, _, _, port_b = _mk_switch("b", with_pex=False)
+        sw_a.start()
+        sw_b.start()
+        try:
+            sw_b.dial_peer(f"{key_a.id}@127.0.0.1:{port_a}")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and sw_b.peers.size() == 0:
+                time.sleep(0.05)
+            peer_a = sw_b.peers.list()[0]
+            # hammer PEX requests well under the min interval
+            for _ in range(5):
+                peer_a.send(0x00, _wrap(PexRequest()))
+                time.sleep(0.01)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and sw_a.peers.size() > 0:
+                time.sleep(0.05)
+            assert sw_a.peers.size() == 0, "flooding peer not evicted"
+        finally:
+            sw_a.stop()
+            sw_b.stop()
