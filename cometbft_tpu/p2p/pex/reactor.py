@@ -131,17 +131,19 @@ class PexReactor(Reactor):
     # -- peer lifecycle ----------------------------------------------------
 
     def add_peer(self, peer) -> None:
-        """pex_reactor.go:183: learn an outbound peer's self-reported
-        address; ask inbound peers for more addresses if we're short."""
+        """pex_reactor.go:183: ask outbound peers (we chose them) for
+        more addresses when the book is short; record inbound peers'
+        self-reported addresses (but never solicit from them — an
+        attacker who connects in must not get to feed us a book)."""
         addr = self._peer_net_address(peer)
         if peer.outbound:
             if addr is not None:
                 self.book.mark_good(addr)
+            if self.book.need_more_addrs():
+                self._request_addrs(peer)
         else:
             if addr is not None:
                 self.book.add_address(addr, src=addr)
-            if self.book.need_more_addrs():
-                self._request_addrs(peer)
 
     def remove_peer(self, peer, reason) -> None:
         self._requested.discard(peer.id)
@@ -149,9 +151,13 @@ class PexReactor(Reactor):
 
     @staticmethod
     def _peer_net_address(peer) -> NetAddress | None:
+        """Dialable address: socket host (strip any id@ prefix) + the
+        peer's self-reported listen port (the socket port is ephemeral
+        for inbound peers)."""
         try:
             if peer.socket_addr:
-                host, _, port = peer.socket_addr.rpartition(":")
+                hostport = peer.socket_addr.split("@", 1)[-1]
+                host, _, port = hostport.rpartition(":")
                 listen = peer.node_info.listen_addr or ""
                 lport = listen.rsplit(":", 1)[-1] if ":" in listen else port
                 return NetAddress(peer.id, host, int(lport))

@@ -89,7 +89,8 @@ class Switch(BaseService):
         if inbound >= self.max_inbound:
             conn.close()
             return
-        self._add_peer_conn(conn, node_info, outbound=False)
+        self._add_peer_conn(conn, node_info, outbound=False,
+                            socket_addr=getattr(conn, "remote_addr", ""))
 
     def dial_peer(self, addr: str, persistent: bool = False) -> Peer:
         """Dial 'id@host:port' and add the peer (switch.go DialPeer...)."""

@@ -78,7 +78,12 @@ class MultiplexTransport:
 
     def _handle_inbound(self, raw: socket.socket) -> None:
         try:
+            peername = "%s:%d" % raw.getpeername()[:2]
+        except OSError:
+            peername = ""
+        try:
             conn, info = self.upgrade(raw, expected_id="")
+            conn.remote_addr = peername
         except Exception:
             try:
                 raw.close()
