@@ -76,9 +76,22 @@ class TpuEd25519BatchVerifier:
         n = len(self._pks)
         if n == 0:
             return False, []
+        # parse + hash ONCE; both packings below build from this
+        parsed = ed.parse_and_hash(self._pks, self._msgs, self._sigs)
+        # Fast path: one shared RLC equation for the whole batch; on
+        # failure (or structural rejects) fall back to the per-signature
+        # kernel for verdict localization — the reference's
+        # verifyCommitBatch -> verifyCommitSingle pattern
+        # (/root/reference/types/validation.go:115).
+        if n >= 2:
+            packed = ed.pack_rlc(self._pks, self._msgs, self._sigs,
+                                 parsed=parsed)
+            if packed is not None and bool(
+                    np.asarray(dev.rlc_verify_device(*packed))):
+                return True, [True] * n
         bucket = dev.bucket_size(n)
         a, r, s, h, valid = ed.pack_batch(self._pks, self._msgs,
-                                          self._sigs, bucket)
+                                          self._sigs, bucket, parsed=parsed)
         verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
         verdict = verdict & valid
         out = verdict[:n].tolist()

@@ -136,8 +136,29 @@ def parse_signature(sig: bytes) -> tuple[bytes, int] | None:
     return sig[:32], s
 
 
+def parse_and_hash(pubkeys: list[bytes], msgs: list[bytes],
+                   sigs: list[bytes]) -> list[tuple[bytes, int, int] | None]:
+    """Host-side structural parse + hash, done ONCE per batch: for each
+    entry (r_enc, s, h = SHA512(R||A||M) mod L) or None on a structural
+    reject.  Both device packings (per-signature and RLC) build from
+    this, so a fallback never re-hashes messages."""
+    import hashlib
+
+    out = []
+    for pk, msg, sig in zip(pubkeys, msgs, sigs):
+        parsed = parse_signature(sig) if len(pk) == PUBKEY_SIZE else None
+        if parsed is None:
+            out.append(None)
+            continue
+        r_enc, s = parsed
+        h = int.from_bytes(
+            hashlib.sha512(r_enc + pk + msg).digest(), "little") % L
+        out.append((r_enc, s, h))
+    return out
+
+
 def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
-               batch_size: int):
+               batch_size: int, parsed=None):
     """Pack a signature batch into device-ready numpy arrays.
 
     h = SHA512(R||A||M) mod L is computed HERE on the host (hashlib is
@@ -146,14 +167,17 @@ def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     host-side structural checks (bad lengths, s >= L) get a
     pre-determined False verdict via the `valid` mask; their slots are
     filled with benign data so the kernel stays branch-free.
-    Returns (a_words, r_words, s_limbs, h_limbs, valid).
-    """
-    import hashlib
 
+    Arrays are LIMBS-FIRST (v3 kernel layout: batch in the minor/lane
+    dimension): returns (a_words (8,B), r_words (8,B), s_limbs (16,B),
+    h_limbs (16,B), valid (B,)).
+    """
     from ..ops import limbs as lb
 
     n = len(pubkeys)
     assert batch_size >= n
+    if parsed is None:
+        parsed = parse_and_hash(pubkeys, msgs, sigs)
     valid = np.zeros(batch_size, dtype=bool)
     a_words = np.zeros((batch_size, 8), dtype=np.uint32)
     r_words = np.zeros((batch_size, 8), dtype=np.uint32)
@@ -161,20 +185,86 @@ def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     h_limbs = np.zeros((batch_size, 16), dtype=np.uint32)
     dummy = ref.point_compress(ref.B)
     for i in range(n):
-        pk, msg, sig = pubkeys[i], msgs[i], sigs[i]
-        parsed = parse_signature(sig) if len(pk) == PUBKEY_SIZE else None
-        if parsed is None:
+        if parsed[i] is None:
             continue
-        r_enc, s = parsed
+        r_enc, s, h = parsed[i]
         valid[i] = True
-        a_words[i] = np.frombuffer(pk, dtype=np.uint32)
+        a_words[i] = np.frombuffer(pubkeys[i], dtype=np.uint32)
         r_words[i] = np.frombuffer(r_enc, dtype=np.uint32)
         s_limbs[i] = lb.int_to_limbs(s, 16)
-        h = int.from_bytes(
-            hashlib.sha512(r_enc + pk + msg).digest(), "little") % L
         h_limbs[i] = lb.int_to_limbs(h, 16)
     # benign filler so decompression of invalid slots still succeeds
     filler = np.frombuffer(dummy, dtype=np.uint32)
     a_words[~valid] = filler
     r_words[~valid] = filler
-    return a_words, r_words, s_limbs, h_limbs, valid
+    return (np.ascontiguousarray(a_words.T),
+            np.ascontiguousarray(r_words.T),
+            np.ascontiguousarray(s_limbs.T),
+            np.ascontiguousarray(h_limbs.T), valid)
+
+
+def _neg_b_encoding() -> bytes:
+    """Compressed -B: flip the x-sign bit of the base point encoding."""
+    enc = bytearray(ref.point_compress(ref.B))
+    enc[31] ^= 0x80
+    return bytes(enc)
+
+
+_NEG_B_ENC = None
+
+
+def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
+             parsed=None):
+    """Pack a batch for the device RLC kernel (ops/ed25519.rlc_verify_kernel).
+
+    Host work per signature: h = SHA512(R||A||M) mod L (via
+    parse_and_hash, shared with the per-signature packing), a random
+    128-bit z, zh = z*h mod L.  The fixed-base term c = sum z_i*s_i
+    mod L rides in the first padding slot as (A=-B, zh=c, z=0);
+    remaining pads have z=zh=0 and contribute the identity.  Batch is
+    padded to a power of two (the kernel's tree reduction halves widths).
+
+    Returns (a_words, r_words, zh_limbs, z_limbs) limbs-first, or None
+    if any entry fails structural checks (caller falls back to the
+    per-signature kernel for verdicts).
+    """
+    import secrets
+
+    from ..ops import limbs as lb
+
+    global _NEG_B_ENC
+    if _NEG_B_ENC is None:
+        _NEG_B_ENC = _neg_b_encoding()
+
+    n = len(pubkeys)
+    if n == 0:
+        return None
+    if parsed is None:
+        parsed = parse_and_hash(pubkeys, msgs, sigs)
+    batch = 1 << (n + 1 - 1).bit_length()   # next pow2 >= n+1
+    batch = max(batch, 16)
+    a_words = np.zeros((batch, 8), dtype=np.uint32)
+    r_words = np.zeros((batch, 8), dtype=np.uint32)
+    zh_limbs = np.zeros((batch, 16), dtype=np.uint32)
+    z_limbs = np.zeros((batch, 8), dtype=np.uint32)
+    c = 0
+    for i in range(n):
+        if parsed[i] is None:
+            return None
+        r_enc, s, h = parsed[i]
+        a_words[i] = np.frombuffer(pubkeys[i], dtype=np.uint32)
+        r_words[i] = np.frombuffer(r_enc, dtype=np.uint32)
+        z = secrets.randbits(128) | (1 << 127)
+        zh_limbs[i] = lb.int_to_limbs(z * h % L, 16)
+        z_limbs[i] = lb.int_to_limbs(z, 8)
+        c = (c + z * s) % L
+    # fixed-base slot + benign fillers for the pads
+    filler = np.frombuffer(ref.point_compress(ref.B), dtype=np.uint32)
+    a_words[n:] = filler
+    r_words[n:] = filler
+    a_words[n] = np.frombuffer(_NEG_B_ENC, dtype=np.uint32)
+    zh_limbs[n] = lb.int_to_limbs(c, 16)
+    return (np.ascontiguousarray(a_words.T),
+            np.ascontiguousarray(r_words.T),
+            np.ascontiguousarray(zh_limbs.T),
+            np.ascontiguousarray(z_limbs.T))

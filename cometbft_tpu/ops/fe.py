@@ -1,27 +1,28 @@
-"""GF(2**255 - 19) arithmetic for TPU, v2: signed 20 x 13-bit limbs.
+"""GF(2**255 - 19) arithmetic for TPU, v3: limbs-first signed 20 x 13-bit.
 
-Round-2 redesign driven by on-chip profiling.  The round-1 field library
-(f25519.py, 16x16-bit limbs) spent most of each multiplication in three
-sequential 16-step carry chains plus per-partial-product lo/hi
-splitting — a deep graph of mini-ops.  This version keeps every field op
-a SHALLOW graph of fusable elementwise ops:
+Round-2 profiling on the real chip showed the v2 (batch, 20) layout ran
+~6x under the VPU's measured ~600 Gops/s: a 20-wide minor dimension
+fills 20 of 128 vector lanes, and the skew-reshape antidiagonal sum
+forced full relayouts of every (B, 20, 20) partial-product tensor
+through HBM.  v3 turns the layout inside out:
 
-- limbs are SIGNED int32 in radix 2**13 (20 limbs = 260 bits; the wrap
-  constant is 608 = 19 * 2**5, since 2**260 == 19 * 2**5 mod p).
-  Signed limbs make subtraction/negation plain elementwise arithmetic —
-  no "4p padding" constants in the hot path.
-- products of 13-bit limbs fit so comfortably in int32 that a whole
-  schoolbook COLUMN (20 products, <= 20 * 9800**2 < 2**31) accumulates
-  with NO splitting, and carries are THREE data-parallel passes over
-  whole limb vectors (concat-shift, no 16-step ripple).
+- field elements are (NLIMBS, ...batch): the LIMB axis is axis 0
+  (sublanes), the batch fills the 128-lane minor dimension.  Every op
+  is a shallow graph of (20, B)-shaped elementwise ops — no reshapes,
+  no gathers, no lane-crossing anywhere in the hot path.
+- the schoolbook product accumulates 20 statically-shifted
+  multiply-adds into a (39, B) column tensor (plain sublane slices),
+  then carries with whole-vector shifts along axis 0.
 
-Bound bookkeeping (the invariant every op maintains):
-  op outputs have limbs in [-1220, 9800]           ("weak" form)
-  mul inputs may have |limb| <= 10300:  20 * 10300**2 = 2.12e9 < 2**31.
+Numerics are unchanged from v2 (same bounds proof):
+- limbs are SIGNED int32 in radix 2**13 (20 limbs = 260 bits; wrap
+  608 = 19 * 2**5 since 2**260 == 19 * 2**5 mod p).
+- op outputs have limbs in [-1220, 9800] ("weak" form); mul accepts
+  |limb| <= 10300: 20 * 10300**2 = 2.12e9 < 2**31.
 
 Reference analog: the 64-bit limb arithmetic inside curve25519-voi
-consumed by /root/reference/crypto/ed25519/ed25519.go.  The layout is an
-original TPU design, not a translation.
+consumed by /root/reference/crypto/ed25519/ed25519.go.  The layout is
+an original TPU design, not a translation.
 """
 
 from __future__ import annotations
@@ -79,8 +80,8 @@ for _i in range(NLIMBS):
     _P_CANON[_i] = _t & MASK
     _t >>= RADIX
 
-# 8p in 20 digits, every digit >= 2047: [8040, 8191*18, 2047].  Adding it
-# makes any weak-form (limbs >= -1220) element nonnegative.
+# 8p in 20 digits, every digit >= 2047: adding it makes any weak-form
+# (limbs >= -1220) element nonnegative.
 _PAD_8P = np.zeros(NLIMBS, dtype=np.int32)
 _t = 8 * P
 for _i in range(NLIMBS - 1):
@@ -91,8 +92,13 @@ assert sum(int(v) << (RADIX * i) for i, v in enumerate(_PAD_8P)) == 8 * P
 assert (_PAD_8P >= 2047).all()
 
 
+def _bcast(limbs: np.ndarray, ndim: int) -> jnp.ndarray:
+    """(20,) host constant -> (20, 1, ...) broadcastable to ndim dims."""
+    return jnp.asarray(limbs.reshape((NLIMBS,) + (1,) * (ndim - 1)))
+
+
 # ---------------------------------------------------------------------------
-# carries: data-parallel whole-vector shifts, no ripple
+# carries: data-parallel whole-vector shifts along the limb axis
 # ---------------------------------------------------------------------------
 
 def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
@@ -102,21 +108,17 @@ def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
     hi = x >> RADIX
     lo = x - (hi << RADIX)
     wrapped = jnp.concatenate(
-        [hi[..., -1:] * jnp.int32(WRAP), hi[..., :-1]], axis=-1)
+        [hi[-1:] * jnp.int32(WRAP), hi[:-1]], axis=0)
     return lo + wrapped
 
 
 def norm_weak(x: jnp.ndarray) -> jnp.ndarray:
-    """Two passes: |limb| < 2**27 input -> limbs in [-1220, 9800].
-
-    Pass 1: lo in [0, 8191], carry-in |c| <= 2**14 + wrap |608*c_top|
-    ... after pass 2 carries are in [-2, 2] so limbs land in
-    [0-2*608, 8191+2+608] within the weak bound."""
+    """Two passes: |limb| < 2**27 input -> limbs in [-1220, 9800]."""
     return _carry_pass(_carry_pass(x))
 
 
 # ---------------------------------------------------------------------------
-# field ops (all outputs in weak form)
+# field ops (all outputs in weak form); arrays are (20, ...batch)
 # ---------------------------------------------------------------------------
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -132,37 +134,27 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """20x20 schoolbook -> anti-diagonal columns -> carry -> 608-fold ->
-    two carry passes.  Inputs: |limb| <= 10300.
+    """20 shifted multiply-accumulates -> (39, B) columns -> carry ->
+    608-fold -> two carry passes.  Inputs: |limb| <= 10300.
 
     Column bound: 20 * 10300**2 = 2.12e9 < 2**31.  After the first
     column-space carry pass, columns are < 2**13 + 2.12e9/2**13 ~ 267k;
     folding multiplies the high half by 608: <= 608*267k ~ 1.63e8 < 2**31.
     Two more passes land in weak form.
     """
-    p = a[..., :, None] * b[..., None, :]            # (..., 20, 20)
-    col = _antidiag_sum(p)                           # (..., 39)
+    batch = a.shape[1:]
+    acc = jnp.zeros((2 * NLIMBS - 1,) + batch, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        acc = acc.at[i:i + NLIMBS].add(a[i] * b)
     # carry pass in 40-wide column space (no wrap: col 39 catches it)
-    pad = [(0, 0)] * (col.ndim - 1) + [(0, 1)]
-    col = jnp.pad(col, pad)                          # (..., 40)
-    hi = col >> RADIX
-    lo = col - (hi << RADIX)
-    zero = jnp.zeros_like(hi[..., :1])
-    col = lo + jnp.concatenate([zero, hi[..., :-1]], axis=-1)
+    acc = jnp.concatenate([acc, jnp.zeros((1,) + batch, jnp.int32)], axis=0)
+    hi = acc >> RADIX
+    lo = acc - (hi << RADIX)
+    acc = lo + jnp.concatenate(
+        [jnp.zeros((1,) + batch, jnp.int32), hi[:-1]], axis=0)
     # fold: 2**260 == 608  =>  out_k = col_k + 608 * col_{20+k}
-    out = col[..., :NLIMBS] + jnp.int32(WRAP) * col[..., NLIMBS:]
+    out = acc[:NLIMBS] + jnp.int32(WRAP) * acc[NLIMBS:]
     return norm_weak(out)
-
-
-def _antidiag_sum(p: jnp.ndarray) -> jnp.ndarray:
-    """Sum p[..., i, j] over equal i+j -> (..., 39) via the skew-reshape
-    trick: one pad, one reshape, ONE reduction."""
-    n = NLIMBS
-    w = 2 * n
-    pad = [(0, 0)] * (p.ndim - 2) + [(0, 0), (0, n)]
-    skew = jnp.pad(p, pad).reshape(p.shape[:-2] + (n * w,))
-    skew = skew[..., :n * (w - 1)].reshape(p.shape[:-2] + (n, w - 1))
-    return skew.sum(axis=-2, dtype=jnp.int32)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -213,25 +205,25 @@ def pow_p58(z: jnp.ndarray) -> jnp.ndarray:
 def _seq_canonical_pass(x: jnp.ndarray) -> jnp.ndarray:
     """Exact sequential carry over nonneg limbs, then reduce the bits at
     and above 2**255 (limb 19 bits >= 8) through the 19-wrap."""
-    c = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    c = jnp.zeros(x.shape[1:], dtype=jnp.int32)
     outs = []
     for i in range(NLIMBS):
-        v = x[..., i] + c
+        v = x[i] + c
         lo = v & jnp.int32(MASK)
         outs.append(lo)
         c = (v - lo) >> RADIX
-    x = jnp.stack(outs, axis=-1)
+    x = jnp.stack(outs, axis=0)
     # c is the carry out of limb 19 (units of 2**260 == 608)
-    top = x[..., 19] >> jnp.int32(8)         # bits 255.. of the value
-    x = x.at[..., 19].set(x[..., 19] & jnp.int32(0xFF))
+    top = x[19] >> jnp.int32(8)         # bits 255.. of the value
+    x = x.at[19].set(x[19] & jnp.int32(0xFF))
     add0 = top * jnp.int32(19) + c * jnp.int32(WRAP)
-    return x.at[..., 0].add(add0)
+    return x.at[0].add(add0)
 
 
 def freeze(a: jnp.ndarray) -> jnp.ndarray:
     """Canonical representative in [0, p).  Rare (eq/identity checks),
     so a few exact 20-step ripples are fine."""
-    x = norm_weak(a) + jnp.asarray(_PAD_8P)   # all limbs > 0
+    x = norm_weak(a) + _bcast(_PAD_8P, a.ndim)   # all limbs > 0
     for _ in range(3):
         x = _seq_canonical_pass(x)
     # value now < 2**255; subtract p once if needed
@@ -241,26 +233,26 @@ def freeze(a: jnp.ndarray) -> jnp.ndarray:
 def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
     """x - p if x >= p else x, for canonical digits (value < 2**255)."""
     p_l = jnp.asarray(_P_CANON)
-    gt = jnp.zeros(x.shape[:-1], dtype=bool)
-    eq_ = jnp.ones(x.shape[:-1], dtype=bool)
+    gt = jnp.zeros(x.shape[1:], dtype=bool)
+    eq_ = jnp.ones(x.shape[1:], dtype=bool)
     for i in range(NLIMBS - 1, -1, -1):
-        gt = gt | (eq_ & (x[..., i] > p_l[i]))
-        eq_ = eq_ & (x[..., i] == p_l[i])
-    take = (gt | eq_)[..., None]
-    diff = x - p_l
-    c = jnp.zeros(diff.shape[:-1], dtype=jnp.int32)
+        gt = gt | (eq_ & (x[i] > p_l[i]))
+        eq_ = eq_ & (x[i] == p_l[i])
+    take = (gt | eq_)[None]
+    diff = x - _bcast(_P_CANON, x.ndim)
+    c = jnp.zeros(diff.shape[1:], dtype=jnp.int32)
     outs = []
     for i in range(NLIMBS):
-        v = diff[..., i] + c
+        v = diff[i] + c
         lo = v & jnp.int32(MASK)
         outs.append(lo)
         c = (v - lo) >> RADIX
-    diff = jnp.stack(outs, axis=-1)
+    diff = jnp.stack(outs, axis=0)
     return jnp.where(take, diff, x)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(freeze(a) == 0, axis=-1)
+    return jnp.all(freeze(a) == 0, axis=0)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -268,7 +260,7 @@ def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def parity(a: jnp.ndarray) -> jnp.ndarray:
-    return (freeze(a)[..., 0] & jnp.int32(1)).astype(jnp.uint32)
+    return (freeze(a)[0] & jnp.int32(1)).astype(jnp.uint32)
 
 
 def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -279,28 +271,28 @@ def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray
     check = mul(v, sqr(r))
     correct = eq(check, u)
     flipped = eq(check, neg(u))
-    r_alt = mul(r, jnp.asarray(SQRT_M1_LIMBS))
-    x = jnp.where(flipped[..., None], r_alt, r)
+    r_alt = mul(r, _bcast(SQRT_M1_LIMBS, r.ndim))
+    x = jnp.where(flipped[None], r_alt, r)
     return x, correct | flipped
 
 
 # ---------------------------------------------------------------------------
-# packing: 8 little-endian uint32 words -> limbs
+# packing: 8 little-endian uint32 words -> limbs (words on axis 0)
 # ---------------------------------------------------------------------------
 
 def words32_to_limbs(words: jnp.ndarray) -> jnp.ndarray:
-    """(..., 8) uint32 LE words -> (..., 20) int32 limbs.  Bit 255 (the
+    """(8, ...) uint32 LE words -> (20, ...) int32 limbs.  Bit 255 (the
     sign bit of point encodings) is EXCLUDED: limb 19 holds bits
     247..254 only."""
     w = jnp.concatenate(
-        [words, jnp.zeros_like(words[..., :1])], axis=-1).astype(jnp.uint32)
+        [words, jnp.zeros_like(words[:1])], axis=0).astype(jnp.uint32)
     limbs = []
     for i in range(NLIMBS):
         bit = RADIX * i
         j, r = bit // 32, bit % 32
-        v = w[..., j] >> jnp.uint32(r)
+        v = w[j] >> jnp.uint32(r)
         if r + RADIX > 32:
-            v = v | (w[..., j + 1] << jnp.uint32(32 - r))
+            v = v | (w[j + 1] << jnp.uint32(32 - r))
         mask = MASK if i < NLIMBS - 1 else 0xFF   # drop the sign bit
         limbs.append((v & jnp.uint32(mask)).astype(jnp.int32))
-    return jnp.stack(limbs, axis=-1)
+    return jnp.stack(limbs, axis=0)

@@ -103,7 +103,7 @@ def test_zip215_noncanonical_y():
     assert ref.point_decompress(noncanon) is not None
     assert ref.point_decompress(noncanon, zip215=False) is None
     # device decompression agrees
-    words = np.frombuffer(noncanon, dtype=np.uint32)[None, :]
+    words = np.frombuffer(noncanon, dtype=np.uint32)[:, None]
     _, ok = jax.jit(dev.decompress)(words)
     assert bool(np.asarray(ok)[0])
 
@@ -128,18 +128,18 @@ def test_point_ops_match_reference():
         pts.append(ref.point_mul(k, ref.B))
 
     def to_dev(p):
-        return np.stack([fe.int_to_limbs(c % ref.P) for c in p])[None]
+        return np.stack([fe.int_to_limbs(c % ref.P) for c in p])[..., None]
 
     add = jax.jit(dev.point_add)
     dbl = jax.jit(dev.point_double)
     for p in pts:
         for q in pts:
-            got = np.asarray(add(to_dev(p), to_dev(q)))[0]
+            got = np.asarray(add(to_dev(p), to_dev(q)))[..., 0]
             want = ref.point_add(p, q)
             gx, gy, gz, gt = [fe.limbs_to_int(row) for row in got]
             assert (gx * want[2] - want[0] * gz) % ref.P == 0
             assert (gy * want[2] - want[1] * gz) % ref.P == 0
-        got = np.asarray(dbl(to_dev(p)))[0]
+        got = np.asarray(dbl(to_dev(p)))[..., 0]
         want = ref.point_double(p)
         gx, gy, gz, gt = [fe.limbs_to_int(row) for row in got]
         assert (gx * want[2] - want[0] * gz) % ref.P == 0
@@ -187,3 +187,29 @@ def test_single_verify_fast_path_consistent_with_zip215():
     assert ref.verify(pub, msg, tsig), "oracle: cofactored must accept"
     assert pk.verify_signature(msg, tsig), \
         "fast path must fall back to ZIP-215, not reject"
+
+
+def test_rlc_batch_equation():
+    """RLC whole-batch verify: accepts honest batches, rejects tampered,
+    and the verifier falls back to per-signature verdicts on failure."""
+    import numpy as np
+    from cometbft_tpu.ops import ed25519 as devk
+
+    pks, msgs, sigs = _batch(10)
+    packed = ed.pack_rlc(pks, msgs, sigs)
+    assert bool(np.asarray(devk.rlc_verify_device(*packed)))
+
+    bad = bytearray(sigs[3]); bad[5] ^= 0x40; sigs[3] = bytes(bad)
+    packed = ed.pack_rlc(pks, msgs, sigs)
+    assert not bool(np.asarray(devk.rlc_verify_device(*packed)))
+
+    bv = cb.TpuEd25519BatchVerifier()
+    for pk, m, s in zip(pks, msgs, sigs):
+        bv.add(pk, m, s)
+    ok, verdicts = bv.verify()
+    assert not ok
+    assert verdicts == [True] * 3 + [False] + [True] * 6
+
+    # structural reject (s >= L) never reaches the RLC path
+    sigs[7] = sigs[7][:32] + (ref.L + 1).to_bytes(32, "little")
+    assert ed.pack_rlc(pks, msgs, sigs) is None

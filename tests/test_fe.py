@@ -1,4 +1,7 @@
-"""fe.py (v2 field layer: signed 20x13-bit limbs) vs Python ints.
+"""fe.py (v3 field layer: limbs-first signed 20x13-bit) vs Python ints.
+
+Arrays are (20, B): the limb axis is axis 0, batch in the minor (lane)
+dimension.
 
 The invariant-stability chain is the critical test: limbs must stay
 inside the documented weak-form bounds through arbitrarily long
@@ -20,7 +23,7 @@ VALS += [int(rng.integers(1, 1 << 62)) ** 4 % P for _ in range(23)]
 
 
 def to_dev(xs):
-    return jnp.asarray(np.stack([fe.int_to_limbs(x) for x in xs]))
+    return jnp.asarray(np.stack([fe.int_to_limbs(x) for x in xs], axis=-1))
 
 
 A_INT = VALS
@@ -37,24 +40,24 @@ class TestFieldOps:
         sq = np.asarray(jax.jit(fe.sqr)(A))
         ng = np.asarray(jax.jit(fe.neg)(A))
         for i, (x, y) in enumerate(zip(A_INT, B_INT)):
-            assert fe.limbs_to_int(mul[i]) == x * y % P
-            assert fe.limbs_to_int(add[i]) == (x + y) % P
-            assert fe.limbs_to_int(sub[i]) == (x - y) % P
-            assert fe.limbs_to_int(sq[i]) == x * x % P
-            assert fe.limbs_to_int(ng[i]) == (-x) % P
+            assert fe.limbs_to_int(mul[:, i]) == x * y % P
+            assert fe.limbs_to_int(add[:, i]) == (x + y) % P
+            assert fe.limbs_to_int(sub[:, i]) == (x - y) % P
+            assert fe.limbs_to_int(sq[:, i]) == x * x % P
+            assert fe.limbs_to_int(ng[:, i]) == (-x) % P
 
     def test_freeze_canonical(self):
         frz = np.asarray(jax.jit(fe.freeze)(A))
         for i, x in enumerate(A_INT):
-            v = sum(int(l) << (13 * k) for k, l in enumerate(frz[i]))
+            v = sum(int(l) << (13 * k) for k, l in enumerate(frz[:, i]))
             assert v == x % P
-            assert all(0 <= l < 8192 for l in frz[i])
+            assert all(0 <= l < 8192 for l in frz[:, i])
 
     def test_invert(self):
         inv = np.asarray(jax.jit(fe.invert)(A))
         for i, x in enumerate(A_INT):
             expect = pow(x, P - 2, P) if x % P else 0
-            assert fe.limbs_to_int(inv[i]) == expect
+            assert fe.limbs_to_int(inv[:, i]) == expect
 
     def test_chain_stability(self):
         """50 rounds of mul/add/sub keep limbs in the weak-form bounds."""
@@ -70,7 +73,7 @@ class TestFieldOps:
             v = x0
             for _ in range(50):
                 v = (v * y0 + x0 - y0) % P
-            assert fe.limbs_to_int(out[i]) == v
+            assert fe.limbs_to_int(out[:, i]) == v
         assert out.min() >= -1300 and out.max() <= 10300
 
     def test_sqrt_ratio(self):
@@ -86,13 +89,13 @@ class TestFieldOps:
             is_qr = pow(r, (P - 1) // 2, P) == 1
             assert bool(ok[i]) == is_qr
             if is_qr:
-                xv = fe.limbs_to_int(x[i])
+                xv = fe.limbs_to_int(x[:, i])
                 assert xv * xv % P == r
 
     def test_eq_is_zero_parity(self):
         z = to_dev([0, 0])
         assert np.asarray(jax.jit(fe.is_zero)(z)).all()
-        assert not np.asarray(jax.jit(fe.is_zero)(A[2:3])).any()
+        assert not np.asarray(jax.jit(fe.is_zero)(A[:, 2:3])).any()
         pr = np.asarray(jax.jit(fe.parity)(A))
         for i, x in enumerate(A_INT):
             assert pr[i] == (x % P) & 1
@@ -101,9 +104,9 @@ class TestFieldOps:
         assert np.asarray(jax.jit(fe.eq)(shifted, A)).all()
 
     def test_words32_roundtrip(self):
-        enc = rng.integers(0, 1 << 32, (6, 8), dtype=np.uint32)
+        enc = rng.integers(0, 1 << 32, (8, 6), dtype=np.uint32)
         limbs = np.asarray(jax.jit(fe.words32_to_limbs)(jnp.asarray(enc)))
-        for row_enc, row_l in zip(enc, limbs):
+        for row_enc, row_l in zip(enc.T, limbs.T):
             val = int.from_bytes(row_enc.tobytes(), "little") & ((1 << 255) - 1)
             got = sum(int(v) << (13 * k) for k, v in enumerate(row_l))
             assert got == val
