@@ -115,6 +115,30 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_rollback(args) -> int:
+    """commands/rollback.go: revert state one height (--hard also
+    deletes the block) to recover from app-hash divergence."""
+    cfg = _load_config(args.home)
+    from ..state.rollback import RollbackError, rollback_state
+    from ..state.store import StateStore
+    from ..store.blockstore import BlockStore
+    from ..store.kv import open_db
+    backend = cfg.base.db_backend
+    block_store = BlockStore(
+        open_db(backend, os.path.join(cfg.db_dir(), "blockstore.db")))
+    state_store = StateStore(
+        open_db(backend, os.path.join(cfg.db_dir(), "state.db")))
+    try:
+        height, app_hash = rollback_state(state_store, block_store,
+                                          remove_block=args.hard)
+    except RollbackError as e:
+        print(f"rollback failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Rolled back state to height {height} and hash "
+          f"{app_hash.hex().upper()}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(SOFTWARE_VERSION)
     return 0
@@ -167,6 +191,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("replay", help="replay the consensus WAL")
     p.add_argument("--console", action="store_true")
     p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("rollback",
+                       help="roll chain state back one height")
+    p.add_argument("--hard", action="store_true",
+                   help="also delete the invalidated block")
+    p.set_defaults(fn=cmd_rollback)
 
     args = parser.parse_args(argv)
     return args.fn(args)

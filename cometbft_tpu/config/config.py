@@ -123,6 +123,12 @@ class StorageConfig:
 
 
 @dataclass
+class TxIndexConfig:
+    """reference config.go TxIndexConfig: "kv" or "null"."""
+    indexer: str = "kv"
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -141,6 +147,7 @@ class Config:
     consensus: ConsensusTimeoutConfig = field(
         default_factory=ConsensusTimeoutConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
 
@@ -218,7 +225,8 @@ _SECTIONS = [
     ("", "base"), ("rpc", "rpc"), ("p2p", "p2p"),
     ("mempool", "mempool"), ("statesync", "statesync"),
     ("blocksync", "blocksync"), ("consensus", "consensus"),
-    ("storage", "storage"), ("instrumentation", "instrumentation"),
+    ("storage", "storage"), ("tx_index", "tx_index"),
+    ("instrumentation", "instrumentation"),
 ]
 
 

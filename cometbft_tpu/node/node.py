@@ -116,6 +116,20 @@ class Node(BaseService):
         # event bus
         self.event_bus = ev.EventBus()
 
+        # tx/block event indexers (node.go createAndStartIndexerService)
+        self.tx_indexer = None
+        self.block_indexer = None
+        self.indexer_service = None
+        if config.tx_index.indexer == "kv":
+            from ..state.indexer import (BlockIndexer, IndexerService,
+                                         TxIndexer)
+            self.tx_indexer = TxIndexer(
+                open_db(backend, os.path.join(db_dir, "tx_index.db")))
+            self.block_indexer = BlockIndexer(
+                open_db(backend, os.path.join(db_dir, "block_index.db")))
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus)
+
         # privval
         self.priv_validator = FilePV.load_or_generate(
             config.priv_validator_key_file(),
@@ -150,11 +164,18 @@ class Node(BaseService):
             open_db(backend, os.path.join(db_dir, "evidence.db")),
             self.state_store, self.block_store)
 
+        # background pruner (node.go:1033 createPruner)
+        from ..state.pruner import Pruner
+        self.pruner = Pruner(self.state_store, self.block_store,
+                             tx_indexer=self.tx_indexer,
+                             block_indexer=self.block_indexer)
+
         # block executor
         self.block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus, self.mempool,
             evidence_pool=self.evidence_pool,
-            block_store=self.block_store, event_bus=self.event_bus)
+            block_store=self.block_store, event_bus=self.event_bus,
+            pruner=self.pruner)
 
         # consensus (WAL + state machine + reactor)
         cc = config.consensus
@@ -263,6 +284,9 @@ class Node(BaseService):
     # -- lifecycle ---------------------------------------------------------
     def on_start(self) -> None:
         self.event_bus.start()
+        if self.indexer_service is not None:
+            self.indexer_service.start()
+        self.pruner.start()
         self.switch.start()
         if self.config.rpc.laddr:
             self._start_rpc()
@@ -336,6 +360,9 @@ class Node(BaseService):
         self.switch.stop()
         self.wal.close()
         self.app_conns.stop()
+        self.pruner.stop()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
         self.event_bus.stop()
 
     def _start_rpc(self) -> None:
@@ -352,7 +379,9 @@ class Node(BaseService):
             genesis=self.genesis,
             app_conns=self.app_conns,
             node_info=self.node_info,
-            config=self.config)
+            config=self.config,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer)
         addr = self.config.rpc.laddr.replace("tcp://", "")
         self.rpc_server = RPCServer(env, addr)
         self.rpc_server.start()

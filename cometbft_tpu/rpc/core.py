@@ -361,6 +361,118 @@ class Environment:
             except KeyError:
                 pass
 
+    # -- tx / event queries (rpc/core/tx.go, blocks.go BlockSearch) --------
+    @staticmethod
+    def _decode_hash_param(hash) -> bytes:  # noqa: A002
+        """Accept hex (URI style, optional 0x) or base64 (JSON style)."""
+        if not hash:
+            raise RPCError(-32602, "hash is required")
+        s = str(hash)
+        if s.startswith("0x") or s.startswith("0X"):
+            s = s[2:]
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            try:
+                return base64.b64decode(s, validate=True)
+            except Exception:
+                raise RPCError(-32602, f"invalid hash {hash!r}")
+
+    @staticmethod
+    def _paginate(total: int, page, per_page) -> tuple[int, int]:
+        """Clamp like the reference's validatePage/validatePerPage."""
+        per = max(1, min(int(per_page) if per_page else 30, 100))
+        pages = max(1, (total + per - 1) // per)
+        p = int(page) if page else 1
+        if not 1 <= p <= pages:
+            raise RPCError(-32603,
+                           f"page must be in [1, {pages}], got {p}")
+        return (p - 1) * per, per
+
+    def _tx_result_json(self, rec: dict, prove=False) -> dict:
+        tx = base64.b64decode(rec["tx"])
+        out = {
+            "hash": ser.hex_upper(tx_hash(tx)),
+            "height": str(rec["height"]),
+            "index": rec["index"],
+            "tx_result": rec["result"],
+            "tx": ser.b64(tx),
+        }
+        if prove:
+            block = self.block_store.load_block(rec["height"])
+            if block is not None and rec["index"] < len(block.data.txs):
+                from ..crypto.merkle import proofs_from_byte_slices
+                root, proofs = proofs_from_byte_slices(
+                    [bytes(t) for t in block.data.txs])
+                pf = proofs[rec["index"]]
+                out["proof"] = {
+                    "root_hash": ser.hex_upper(root),
+                    "data": ser.b64(tx),
+                    "proof": {
+                        "total": str(pf.total),
+                        "index": str(pf.index),
+                        "leaf_hash": ser.b64(pf.leaf_hash),
+                        "aunts": [ser.b64(a) for a in pf.aunts],
+                    },
+                }
+        return out
+
+    def tx(self, hash=None, prove=None) -> dict:  # noqa: A002
+        """rpc/core/tx.go Tx: look a transaction up by hash."""
+        if self.tx_indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        rec = self.tx_indexer.get(self._decode_hash_param(hash))
+        if rec is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        return self._tx_result_json(rec, prove in (True, "true", "1"))
+
+    def tx_search(self, query=None, prove=None, page=None, per_page=None,
+                  order_by=None) -> dict:
+        """rpc/core/tx.go TxSearch: event-query over indexed txs."""
+        if self.tx_indexer is None:
+            raise RPCError(-32603, "transaction indexing is disabled")
+        if not query:
+            raise RPCError(-32602, "query is required")
+        from ..libs import pubsub
+        try:
+            q = pubsub.Query.parse(str(query))
+        except pubsub.QueryError as e:
+            raise RPCError(-32602, f"invalid query: {e}")
+        recs = self.tx_indexer.search(q)
+        recs.sort(key=lambda r: (r["height"], r["index"]),
+                  reverse=(order_by == "desc"))
+        start, per = self._paginate(len(recs), page, per_page)
+        prove_b = prove in (True, "true", "1")
+        return {
+            "txs": [self._tx_result_json(r, prove_b)
+                    for r in recs[start:start + per]],
+            "total_count": str(len(recs)),
+        }
+
+    def block_search(self, query=None, page=None, per_page=None,
+                     order_by=None) -> dict:
+        """rpc/core/blocks.go BlockSearch: block-event query."""
+        if self.block_indexer is None:
+            raise RPCError(-32603, "block indexing is disabled")
+        if not query:
+            raise RPCError(-32602, "query is required")
+        from ..libs import pubsub
+        try:
+            q = pubsub.Query.parse(str(query))
+        except pubsub.QueryError as e:
+            raise RPCError(-32602, f"invalid query: {e}")
+        heights = self.block_indexer.search(q)
+        heights.sort(reverse=(order_by == "desc"))
+        start, per = self._paginate(len(heights), page, per_page)
+        blocks = []
+        for h in heights[start:start + per]:
+            meta = self.block_store.load_block_meta(h)
+            block = self.block_store.load_block(h)
+            if meta is not None and block is not None:
+                blocks.append({"block_id": ser.block_id_json(meta.block_id),
+                               "block": ser.block_json(block)})
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
     def unconfirmed_txs(self, limit=None) -> dict:
         txs = self.mempool.reap_max_txs(int(limit) if limit else 30)
         return {
@@ -410,4 +522,7 @@ ROUTES = {
     "unconfirmed_txs": "unconfirmed_txs",
     "num_unconfirmed_txs": "num_unconfirmed_txs",
     "broadcast_evidence": "broadcast_evidence",
+    "tx": "tx",
+    "tx_search": "tx_search",
+    "block_search": "block_search",
 }

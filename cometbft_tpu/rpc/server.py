@@ -118,9 +118,32 @@ def _make_handler(env: Environment):
             self.end_headers()
             self.wfile.write(body)
 
+        # -- WebSocket upgrade (reference ws_handler.go) -------------------
+        def _do_websocket(self) -> None:
+            from . import websocket as ws
+
+            key = self.headers.get("Sec-WebSocket-Key", "")
+            if not key:
+                self._reply(400, {"error": "missing Sec-WebSocket-Key"})
+                return
+            self.send_response(101, "Switching Protocols")
+            self.send_header("Upgrade", "websocket")
+            self.send_header("Connection", "Upgrade")
+            self.send_header("Sec-WebSocket-Accept", ws.accept_key(key))
+            self.end_headers()
+            self.close_connection = True
+            session = ws.WSSession(
+                env, self.rfile, self.wfile,
+                "%s:%d" % self.client_address[:2], self._call)
+            session.run()
+
         # -- URI-style GET -------------------------------------------------
         def do_GET(self) -> None:  # noqa: N802
             parsed = urlparse(self.path)
+            if parsed.path.strip("/") == "websocket" and \
+                    "upgrade" in self.headers.get("Connection", "").lower():
+                self._do_websocket()
+                return
             method = parsed.path.strip("/")
             if method == "":
                 # route listing (reference serves an HTML index)

@@ -1,0 +1,260 @@
+"""Minimal RFC 6455 WebSocket endpoint for JSON-RPC subscriptions
+(reference rpc/jsonrpc/server/ws_handler.go + rpc/core/events.go).
+
+The /websocket endpoint accepts the standard JSON-RPC routes plus
+subscribe/unsubscribe/unsubscribe_all.  Event notifications are sent as
+JSON-RPC responses carrying the ORIGINAL subscribe request id, the
+reference's wire behavior (ws_handler.go sends rpctypes.RPCResponse
+with the subscription's id for every event).
+
+No external websocket dependency: the handshake (SHA-1 accept key) and
+text/close/ping frames are implemented here — the server side of the
+protocol is ~100 lines.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+
+from ..libs import pubsub
+from ..types import events as ev
+from . import serialize as ser
+
+_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# opcodes
+TEXT, CLOSE, PING, PONG = 0x1, 0x8, 0x9, 0xA
+
+
+def accept_key(client_key: str) -> str:
+    digest = hashlib.sha1(client_key.encode() + _GUID).digest()
+    return base64.b64encode(digest).decode()
+
+
+def write_frame(sock_lock, wfile, opcode: int, payload: bytes) -> None:
+    """Server frames are unmasked."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    with sock_lock:
+        wfile.write(head + payload)
+        wfile.flush()
+
+
+def _read_raw_frame(rfile) -> tuple[bool, int, bytes] | None:
+    """One wire frame -> (fin, opcode, payload); None on EOF/oversize."""
+    head = rfile.read(2)
+    if len(head) < 2:
+        return None
+    fin_op, mask_len = head
+    fin = bool(fin_op & 0x80)
+    opcode = fin_op & 0x0F
+    masked = mask_len & 0x80
+    n = mask_len & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", rfile.read(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", rfile.read(8))[0]
+    if n > 1_000_000:
+        return None
+    mask = rfile.read(4) if masked else b""
+    payload = rfile.read(n)
+    if len(payload) < n:
+        return None
+    if masked:
+        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    return fin, opcode, payload
+
+
+def read_frame(rfile) -> tuple[int, bytes] | None:
+    """One client MESSAGE -> (opcode, payload), reassembling
+    fragmented frames (FIN=0 + continuations, RFC 6455 §5.4).  Control
+    frames (close/ping/pong) may interleave and are returned as-is."""
+    first = _read_raw_frame(rfile)
+    if first is None:
+        return None
+    fin, opcode, payload = first
+    if fin:
+        return opcode, payload
+    parts = [payload]
+    while True:
+        nxt = _read_raw_frame(rfile)
+        if nxt is None:
+            return None
+        nfin, nop, npay = nxt
+        if nop == CLOSE:        # interleaved close ends the message too
+            return nop, npay
+        if nop & 0x8:           # other control frames: skip mid-message
+            continue
+        parts.append(npay)
+        if nfin:
+            return opcode, b"".join(parts)
+
+
+def event_data_json(data) -> dict:
+    """Typed event payload -> {type, value} envelope (libs/json type
+    registry analog for the event types RPC clients consume)."""
+    if isinstance(data, ev.EventDataTx):
+        return {"type": "tendermint/event/Tx", "value": {
+            "TxResult": {
+                "height": str(data.height),
+                "index": data.index,
+                "tx": ser.b64(data.tx),
+                "result": ser.exec_tx_result_json(data.result)
+                if data.result else None,
+            }}}
+    if isinstance(data, ev.EventDataNewBlock):
+        return {"type": "tendermint/event/NewBlock", "value": {
+            "block": ser.block_json(data.block) if data.block else None,
+            "block_id": ser.block_id_json(data.block_id)
+            if data.block_id else None,
+        }}
+    if isinstance(data, ev.EventDataNewBlockHeader):
+        return {"type": "tendermint/event/NewBlockHeader", "value": {
+            "header": ser.header_json(data.header)
+            if data.header else None}}
+    if isinstance(data, ev.EventDataNewBlockEvents):
+        return {"type": "tendermint/event/NewBlockEvents", "value": {
+            "height": str(data.height),
+            "events": [ser.event_json(e) for e in data.events],
+            "num_txs": str(data.num_txs)}}
+    # round-state style events and anything else: best-effort fields
+    value = {}
+    for k in ("height", "round", "step"):
+        if hasattr(data, k):
+            v = getattr(data, k)
+            value[k] = str(v) if k == "height" else v
+    return {"type": f"tendermint/event/{type(data).__name__}",
+            "value": value}
+
+
+class WSSession:
+    """One upgraded connection: routes JSON-RPC, owns subscriptions."""
+
+    def __init__(self, env, rfile, wfile, remote: str, call_fn):
+        self.env = env
+        self.rfile = rfile
+        self.wfile = wfile
+        self.subscriber = f"ws-{remote}"
+        self._call = call_fn        # (method, params, id) -> response dict
+        self._lock = threading.Lock()
+        self._subs: dict[str, tuple[pubsub.Query, object]] = {}
+        self._closed = threading.Event()
+
+    # -- subscription plumbing --------------------------------------------
+
+    def _send_json(self, payload: dict) -> None:
+        try:
+            write_frame(self._lock, self.wfile, TEXT,
+                        json.dumps(payload).encode())
+        except OSError:
+            self._closed.set()
+
+    def _pump(self, sub, query_str: str, req_id) -> None:
+        while not self._closed.is_set() and not sub.canceled.is_set():
+            msg = sub.next(timeout=0.1)
+            if msg is None:
+                continue
+            self._send_json({
+                "jsonrpc": "2.0", "id": req_id,
+                "result": {
+                    "query": query_str,
+                    "data": event_data_json(msg.data),
+                    "events": msg.events,
+                }})
+
+    def _subscribe(self, params: dict, req_id) -> dict:
+        qs = str(params.get("query") or "")
+        if not qs:
+            return _err(req_id, -32602, "query is required")
+        try:
+            q = pubsub.Query.parse(qs)
+        except pubsub.QueryError as e:
+            return _err(req_id, -32602, f"invalid query: {e}")
+        bus = self.env.event_bus
+        if bus is None:
+            return _err(req_id, -32603, "event bus unavailable")
+        try:
+            sub = bus.subscribe(self.subscriber, q, capacity=200)
+        except ValueError as e:
+            return _err(req_id, -32603, str(e))
+        self._subs[qs] = (q, sub)
+        threading.Thread(target=self._pump, args=(sub, qs, req_id),
+                         daemon=True).start()
+        return {"jsonrpc": "2.0", "id": req_id, "result": {}}
+
+    def _unsubscribe(self, params: dict, req_id) -> dict:
+        qs = str(params.get("query") or "")
+        ent = self._subs.pop(qs, None)
+        if ent is None:
+            return _err(req_id, -32603, f"not subscribed to {qs!r}")
+        try:
+            self.env.event_bus.unsubscribe(self.subscriber, ent[0])
+        except KeyError:
+            pass
+        return {"jsonrpc": "2.0", "id": req_id, "result": {}}
+
+    def _unsubscribe_all(self, req_id) -> dict:
+        self._subs.clear()
+        try:
+            self.env.event_bus.unsubscribe_all(self.subscriber)
+        except KeyError:
+            pass
+        return {"jsonrpc": "2.0", "id": req_id, "result": {}}
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            while not self._closed.is_set():
+                frame = read_frame(self.rfile)
+                if frame is None:
+                    break
+                opcode, payload = frame
+                if opcode == CLOSE:
+                    try:
+                        write_frame(self._lock, self.wfile, CLOSE, payload[:2])
+                    except OSError:
+                        pass
+                    break
+                if opcode == PING:
+                    write_frame(self._lock, self.wfile, PONG, payload)
+                    continue
+                if opcode != TEXT:
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    self._send_json(_err(None, -32700, "parse error"))
+                    continue
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                req_id = req.get("id")
+                if method == "subscribe":
+                    self._send_json(self._subscribe(params, req_id))
+                elif method == "unsubscribe":
+                    self._send_json(self._unsubscribe(params, req_id))
+                elif method == "unsubscribe_all":
+                    self._send_json(self._unsubscribe_all(req_id))
+                else:
+                    self._send_json(self._call(method, params, req_id))
+        finally:
+            self._closed.set()
+            try:
+                self.env.event_bus.unsubscribe_all(self.subscriber)
+            except Exception:
+                pass
+
+
+def _err(req_id, code: int, message: str) -> dict:
+    return {"jsonrpc": "2.0", "id": req_id,
+            "error": {"code": code, "message": message}}

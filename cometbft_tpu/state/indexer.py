@@ -1,0 +1,236 @@
+"""Tx and block event indexers + the indexer service.
+
+Reference analogs: state/txindex/kv/kv.go (tx indexer),
+state/indexer/block/kv/kv.go (block indexer),
+state/txindex/indexer_service.go (event-bus consumer).
+
+Layout (one ordered KV namespace each):
+  tx indexer:    b"h/" + be64(height) + be32(index) -> record JSON
+                 b"t/" + tx_hash                    -> primary key
+  block indexer: b"e/" + be64(height)               -> events-map JSON
+
+Records carry the flattened composite-key event map (`type.attr` ->
+values) alongside the result, so searches evaluate the same
+libs/pubsub Query the event bus uses — semantics identical to the
+subscription path, by construction (the reference re-implements the
+query matching against KV postings; here the stored map is matched
+directly, trading raw speed for exact semantic parity).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import threading
+
+from ..libs import pubsub
+from ..libs.service import BaseService
+from ..store.kv import KVStore, be64
+from ..types import events as ev
+
+
+def be32(i: int) -> bytes:
+    return struct.pack(">I", i)
+
+
+class TxIndexer:
+    """state/txindex/kv/kv.go TxIndex."""
+
+    def __init__(self, db: KVStore):
+        self._db = db
+        self._mtx = threading.Lock()
+
+    # -- writes ------------------------------------------------------------
+
+    def index(self, height: int, index: int, tx: bytes, result,
+              events_map: dict[str, list[str]]) -> None:
+        """Store one tx result under (height, index) + hash pointer.
+
+        Matches the reference's per-tx AddBatch entry: later writes for
+        the same hash win (kv.go:69 comment on duplicate txs)."""
+        from ..types.block import tx_hash as hash_fn
+        from ..rpc.serialize import exec_tx_result_json
+
+        h = hash_fn(tx)
+        rec = {
+            "height": height,
+            "index": index,
+            "tx": base64.b64encode(tx).decode(),
+            "result": exec_tx_result_json(result) if result else None,
+            "events": events_map,
+        }
+        key = b"h/" + be64(height) + be32(index)
+        with self._mtx:
+            self._db.write_batch([
+                (key, json.dumps(rec).encode()),
+                (b"t/" + h, key),
+            ])
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, tx_hash: bytes) -> dict | None:
+        ptr = self._db.get(b"t/" + tx_hash)
+        if ptr is None:
+            return None
+        raw = self._db.get(ptr)
+        return json.loads(raw) if raw is not None else None
+
+    def prune(self, retain_height: int) -> int:
+        """Drop tx records below retain_height (txindex pruning,
+        state/txindex/kv/kv.go Prune)."""
+        from ..types.block import tx_hash as hash_fn
+
+        deletes: list[bytes] = []
+        with self._mtx:
+            for k, raw in self._db.iterate(b"h/" + be64(0),
+                                           b"h/" + be64(retain_height)):
+                deletes.append(k)
+                rec = json.loads(raw)
+                h = hash_fn(base64.b64decode(rec["tx"]))
+                if self._db.get(b"t/" + h) == k:
+                    deletes.append(b"t/" + h)
+            if deletes:
+                self._db.write_batch([], deletes)
+        return len(deletes)
+
+    def search(self, query: pubsub.Query) -> list[dict]:
+        """All indexed txs matching the query, (height, index) order.
+
+        tx.hash equality short-circuits to a point lookup; tx.height
+        equality/range conditions bound the height scan; remaining
+        conditions evaluate against the stored event map."""
+        # hash short-circuit: point lookup, then evaluate the REMAINING
+        # conditions (the lookup itself proves the hash condition; string
+        # matching it again would be case-sensitive on hex)
+        for c in query.conditions:
+            if c.key == ev.TX_HASH_KEY and c.op == "=":
+                try:
+                    rec = self.get(bytes.fromhex(str(c.value)))
+                except ValueError:
+                    return []
+                rest = pubsub.Query(
+                    [o for o in query.conditions if o is not c],
+                    query.source)
+                return [rec] if rec is not None and \
+                    rest.matches(rec["events"]) else []
+        lo, hi = _height_bounds(query, ev.TX_HEIGHT_KEY)
+        start = b"h/" + be64(lo)
+        end = b"h/" + (be64(hi + 1) if hi is not None else b"\xff" * 8)
+        out = []
+        for _k, raw in self._db.iterate(start, end):
+            rec = json.loads(raw)
+            if query.matches(rec["events"]):
+                out.append(rec)
+        return out
+
+
+class BlockIndexer:
+    """state/indexer/block/kv/kv.go BlockerIndexer: indexes
+    FinalizeBlock events by height."""
+
+    def __init__(self, db: KVStore):
+        self._db = db
+
+    def index(self, height: int, events_map: dict[str, list[str]]) -> None:
+        self._db.set(b"e/" + be64(height),
+                     json.dumps(events_map).encode())
+
+    def has(self, height: int) -> bool:
+        return self._db.get(b"e/" + be64(height)) is not None
+
+    def prune(self, retain_height: int) -> int:
+        deletes = [k for k, _ in self._db.iterate(
+            b"e/" + be64(0), b"e/" + be64(retain_height))]
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
+
+    def search(self, query: pubsub.Query) -> list[int]:
+        """Heights whose block events match, ascending."""
+        lo, hi = _height_bounds(query, ev.BLOCK_HEIGHT_KEY)
+        start = b"e/" + be64(lo)
+        end = b"e/" + (be64(hi + 1) if hi is not None else b"\xff" * 8)
+        out = []
+        for k, raw in self._db.iterate(start, end):
+            if query.matches(json.loads(raw)):
+                out.append(struct.unpack(">Q", k[2:10])[0])
+        return out
+
+
+def _height_bounds(query: pubsub.Query, key: str) -> tuple[int, int | None]:
+    """Tight [lo, hi] height window implied by the query's conditions on
+    `key` (kv.go lookForHeight + the range postings)."""
+    lo, hi = 0, None
+    for c in query.conditions:
+        if c.key != key or c.value is None:
+            continue
+        try:
+            v = int(float(c.value))
+        except (TypeError, ValueError):
+            continue
+        if c.op == "=":
+            lo, hi = v, v
+        elif c.op == ">":
+            lo = max(lo, v + 1)
+        elif c.op == ">=":
+            lo = max(lo, v)
+        elif c.op == "<":
+            hi = v - 1 if hi is None else min(hi, v - 1)
+        elif c.op == "<=":
+            hi = v if hi is None else min(hi, v)
+    return lo, hi
+
+
+class IndexerService(BaseService):
+    """Subscribes to the event bus and feeds both indexers
+    (state/txindex/indexer_service.go)."""
+
+    def __init__(self, tx_indexer: TxIndexer, block_indexer: BlockIndexer,
+                 event_bus):
+        super().__init__("IndexerService")
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def on_start(self) -> None:
+        self._sub_tx = self.event_bus.subscribe(
+            "indexer-tx", ev.query_for_event(ev.EVENT_TX), capacity=1000)
+        self._sub_blk = self.event_bus.subscribe(
+            "indexer-blk", ev.query_for_event(ev.EVENT_NEW_BLOCK_EVENTS),
+            capacity=1000)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="indexer-service", daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+        for name in ("indexer-tx", "indexer-blk"):
+            try:
+                self.event_bus.unsubscribe_all(name)
+            except KeyError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _index_tx_msg(self, msg) -> None:
+        data = msg.data
+        self.tx_indexer.index(data.height, data.index, data.tx,
+                              data.result, msg.events)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            busy = False
+            while (msg := self._sub_blk.next(timeout=0)) is not None:
+                self.block_indexer.index(msg.data.height, msg.events)
+                busy = True
+            while (msg := self._sub_tx.next(timeout=0)) is not None:
+                self._index_tx_msg(msg)
+                busy = True
+            if not busy:
+                msg = self._sub_tx.next(timeout=0.05)
+                if msg is not None:
+                    self._index_tx_msg(msg)

@@ -1,0 +1,126 @@
+"""Background pruning service (reference state/pruner.go).
+
+Reconciles two retain-height sources — the application (set via the
+Commit response's retain_height, execution.go -> SetApplicationBlockRetainHeight)
+and an optional data companion — and periodically prunes everything
+below the lower bound: blocks, state history (validators/params/ABCI
+responses), and the tx/block indexers.
+
+Retain heights persist in the state DB so a restart resumes where
+pruning left off (pruner.go loads them back through the store).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from ..libs.service import BaseService
+
+_K_APP_RETAIN = b"prune/app_retain_height"
+_K_COMPANION_RETAIN = b"prune/companion_retain_height"
+_K_ABCI_RES_RETAIN = b"prune/abci_res_retain_height"
+
+DEFAULT_PRUNING_INTERVAL = 10.0   # pruner.go defaultPruningInterval
+
+
+class Pruner(BaseService):
+    def __init__(self, state_store, block_store, tx_indexer=None,
+                 block_indexer=None, data_companion_enabled: bool = False,
+                 interval: float = DEFAULT_PRUNING_INTERVAL):
+        super().__init__("Pruner")
+        self.state_store = state_store
+        self.block_store = block_store
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.companion_enabled = data_companion_enabled
+        self.interval = interval
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- retain heights (persisted) ----------------------------------------
+
+    def _get(self, key: bytes) -> int:
+        raw = self.state_store._db.get(key)
+        return struct.unpack(">Q", raw)[0] if raw else 0
+
+    def _set(self, key: bytes, h: int) -> None:
+        self.state_store._db.set(key, struct.pack(">Q", h))
+
+    def set_application_block_retain_height(self, height: int) -> None:
+        """pruner.go SetApplicationBlockRetainHeight: monotone, wakes
+        the loop."""
+        if height <= self._get(_K_APP_RETAIN):
+            return
+        self._set(_K_APP_RETAIN, height)
+        self._wake.set()
+
+    def set_companion_block_retain_height(self, height: int) -> None:
+        if height <= self._get(_K_COMPANION_RETAIN):
+            return
+        self._set(_K_COMPANION_RETAIN, height)
+        self._wake.set()
+
+    def set_abci_res_retain_height(self, height: int) -> None:
+        if height <= self._get(_K_ABCI_RES_RETAIN):
+            return
+        self._set(_K_ABCI_RES_RETAIN, height)
+        self._wake.set()
+
+    def application_block_retain_height(self) -> int:
+        return self._get(_K_APP_RETAIN)
+
+    def companion_block_retain_height(self) -> int:
+        return self._get(_K_COMPANION_RETAIN)
+
+    def target_retain_height(self) -> int:
+        """Lower bound of the enabled retain heights
+        (pruner.go findMinBlockRetainHeight).  An unset (0) height means
+        that party has released nothing — it blocks all pruning."""
+        app = self._get(_K_APP_RETAIN)
+        if not self.companion_enabled:
+            return app
+        comp = self._get(_K_COMPANION_RETAIN)
+        return min(app, comp)
+
+    # -- service -----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="pruner", daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.prune_once()
+            except Exception:   # never die; retry next tick
+                pass
+
+    def prune_once(self) -> tuple[int, int]:
+        """One reconciliation pass; returns (new_block_base, pruned)."""
+        target = self.target_retain_height()
+        pruned = 0
+        if target > self.block_store.base():
+            pruned = self.block_store.prune_blocks(target)
+            self.state_store.prune_states(target)
+            if self.tx_indexer is not None:
+                self.tx_indexer.prune(target)
+            if self.block_indexer is not None:
+                self.block_indexer.prune(target)
+        abci_target = self._get(_K_ABCI_RES_RETAIN)
+        if abci_target:
+            self.state_store.prune_abci_responses(abci_target)
+        return self.block_store.base(), pruned

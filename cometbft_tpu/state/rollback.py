@@ -1,0 +1,76 @@
+"""Roll the chain state back one height (reference state/rollback.go).
+
+Recovers from app-hash divergence: the state at height H is discarded
+and reconstructed as of H-1 from the stores, so the node re-executes
+block H against a fixed application.  `remove_block` additionally
+deletes block H itself (the CLI's --hard flag).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback_state(state_store, block_store, remove_block: bool = False):
+    """rollback.go Rollback: returns (new_height, new_app_hash)."""
+    invalid_state = state_store.load()
+    if invalid_state is None or invalid_state.is_empty():
+        raise RollbackError("no state found to roll back")
+    height = invalid_state.last_block_height
+
+    if block_store.height() == height - 1 and not remove_block:
+        # the block itself was already removed (prior hard rollback):
+        # state is one ahead of the store; rolling back re-aligns them
+        pass
+    elif block_store.height() < height:
+        raise RollbackError(
+            f"block store height {block_store.height()} below state "
+            f"height {height}; nothing to roll back to")
+
+    rollback_height = height - 1
+    rollback_meta = block_store.load_block_meta(rollback_height)
+    if rollback_meta is None:
+        raise RollbackError(
+            f"block at height {rollback_height} not found")
+    # the invalidated block carries the app hash state rolls back to
+    latest_meta = block_store.load_block_meta(height)
+    if latest_meta is None:
+        raise RollbackError(f"block at height {height} not found")
+
+    prev_validators = state_store.load_validators(rollback_height)
+    validators = state_store.load_validators(rollback_height + 1)
+    next_validators = state_store.load_validators(rollback_height + 2)
+    params = state_store.load_consensus_params(rollback_height + 1)
+
+    valset_changed = rollback_meta.header.validators_hash != \
+        latest_meta.header.validators_hash
+    params_changed = rollback_meta.header.consensus_hash != \
+        latest_meta.header.consensus_hash
+
+    rolled = replace(
+        invalid_state.copy(),
+        last_block_height=rollback_height,
+        last_block_id=rollback_meta.block_id,
+        last_block_time=rollback_meta.header.time,
+        last_validators=prev_validators,
+        validators=validators,
+        next_validators=next_validators,
+        last_height_validators_changed=(
+            rollback_height + 1 if valset_changed
+            else invalid_state.last_height_validators_changed),
+        consensus_params=params,
+        last_height_consensus_params_changed=(
+            rollback_height + 1 if params_changed
+            else invalid_state.last_height_consensus_params_changed),
+        last_results_hash=rollback_meta.header.last_results_hash,
+        app_hash=latest_meta.header.app_hash,
+    )
+
+    if remove_block:
+        block_store.delete_latest_block()
+    state_store.save(rolled)
+    return rolled.last_block_height, rolled.app_hash

@@ -216,3 +216,13 @@ class StateStore:
             if deletes:
                 self._db.write_batch([], deletes)
             return len(deletes)
+
+    def prune_abci_responses(self, retain_height: int) -> int:
+        """Delete only FinalizeBlock responses below retain_height — the
+        data companion's independent knob (state/store.go pruneABCIResponses)."""
+        with self._mtx:
+            deletes = [k for k, _ in self._db.iterate(
+                _k_fbresp(0), _k_fbresp(retain_height))]
+            if deletes:
+                self._db.write_batch([], deletes)
+            return len(deletes)

@@ -231,6 +231,23 @@ class BlockStore:
 
     # -- prune -------------------------------------------------------------
 
+    def delete_latest_block(self) -> None:
+        """Remove the highest block (store/store.go DeleteLatestBlock) —
+        the rollback --hard path."""
+        with self._mtx:
+            h = self._height
+            if h < self._base or h == 0:
+                raise ValueError("no block to delete")
+            meta = self.load_block_meta(h)
+            deletes = [_k_seen_commit(h), _k_ext_commit(h), _k_commit(h)]
+            if meta is not None:
+                deletes.append(_k_meta(h))
+                deletes.append(_k_hash(meta.block_id.hash))
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_k_part(h, i))
+            self._height = h - 1
+            self._db.write_batch([(_K_STATE, self._state_bytes())], deletes)
+
     def prune_blocks(self, retain_height: int) -> int:
         """Remove blocks below retain_height; keep the commit for
         retain_height-1 (needed to verify retain_height). Returns the

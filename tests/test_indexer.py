@@ -1,0 +1,247 @@
+"""Tx/block indexers + query RPCs + WebSocket subscriptions
+(reference state/txindex/kv/kv_test.go, rpc/core/tx.go,
+rpc/jsonrpc/server/ws_handler_test.go).
+
+End-to-end: a live node indexes committed txs; /tx finds them by hash,
+/tx_search and /block_search answer event queries, and a raw-socket
+WebSocket client receives the Tx event for a broadcast_tx_commit.
+"""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+from cometbft_tpu.libs import pubsub
+from cometbft_tpu.state.indexer import BlockIndexer, TxIndexer
+from cometbft_tpu.store.kv import MemDB
+from cometbft_tpu.types.block import tx_hash
+
+from tests.test_node_rpc import node, rpc_get, rpc_post  # noqa: F401
+from tests.test_consensus import wait_for_height
+
+
+def _result(events=None):
+    return ExecTxResult(code=0, events=events or [])
+
+
+def _ev(type_, **attrs):
+    return Event(type=type_, attributes=[
+        EventAttribute(key=k, value=v, index=True)
+        for k, v in attrs.items()])
+
+
+class TestTxIndexer:
+    def make(self):
+        idx = TxIndexer(MemDB())
+        for h in (1, 2, 3):
+            for i in range(3):
+                tx = b"tx-%d-%d" % (h, i)
+                events = {
+                    "tx.height": [str(h)],
+                    "tx.hash": [tx_hash(tx).hex().upper()],
+                    "transfer.amount": [str(100 * h + i)],
+                    "transfer.sender": ["addr%d" % i],
+                }
+                idx.index(h, i, tx, _result(), events)
+        return idx
+
+    def test_get_by_hash(self):
+        idx = self.make()
+        rec = idx.get(tx_hash(b"tx-2-1"))
+        assert rec is not None
+        assert (rec["height"], rec["index"]) == (2, 1)
+        assert base64.b64decode(rec["tx"]) == b"tx-2-1"
+        assert idx.get(b"\x00" * 32) is None
+
+    def test_search_height_range(self):
+        idx = self.make()
+        q = pubsub.Query.parse("tx.height >= 2 AND tx.height < 3")
+        recs = idx.search(q)
+        assert [r["height"] for r in recs] == [2, 2, 2]
+
+    def test_search_event_attr(self):
+        idx = self.make()
+        recs = idx.search(pubsub.Query.parse("transfer.sender = 'addr1'"))
+        assert len(recs) == 3
+        assert all(r["index"] == 1 for r in recs)
+        recs = idx.search(pubsub.Query.parse(
+            "transfer.sender = 'addr1' AND transfer.amount > 200"))
+        assert [r["height"] for r in recs] == [2, 3]
+
+    def test_search_hash_shortcircuit(self):
+        idx = self.make()
+        h = tx_hash(b"tx-3-0").hex().upper()
+        recs = idx.search(pubsub.Query.parse(f"tx.hash = '{h}'"))
+        assert len(recs) == 1 and recs[0]["height"] == 3
+
+
+class TestBlockIndexer:
+    def test_index_and_search(self):
+        idx = BlockIndexer(MemDB())
+        for h in range(1, 6):
+            idx.index(h, {"block.height": [str(h)],
+                          "begin.oddness": ["odd" if h % 2 else "even"]})
+        assert idx.has(3) and not idx.has(7)
+        got = idx.search(pubsub.Query.parse("begin.oddness = 'odd'"))
+        assert got == [1, 3, 5]
+        got = idx.search(pubsub.Query.parse(
+            "block.height > 2 AND begin.oddness = 'even'"))
+        assert got == [4]
+
+
+# -- minimal WebSocket client for the subscription test ---------------------
+
+class WSClient:
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.sock = socket.create_connection((host, int(port)), timeout=15)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (f"GET /websocket HTTP/1.1\r\nHost: {addr}\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               "Sec-WebSocket-Version: 13\r\n\r\n")
+        self.sock.sendall(req.encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        status = resp.split(b"\r\n", 1)[0]
+        assert b"101" in status, status
+        accept = hashlib.sha1(
+            key.encode() + b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+        ).digest()
+        assert base64.b64encode(accept) in resp
+        self._buf = b""
+
+    def send_json(self, obj) -> None:
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        head = bytes([0x81])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        else:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + masked)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def recv_json(self):
+        head = self._read_exact(2)
+        n = head[1] & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", self._read_exact(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", self._read_exact(8))[0]
+        payload = self._read_exact(n)
+        opcode = head[0] & 0x0F
+        if opcode != 0x1:
+            return self.recv_json()
+        return json.loads(payload)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class TestNodeQueriesAndSubscriptions:
+    def test_tx_lifecycle_and_queries(self, node):  # noqa: F811
+        addr = node.rpc_addr
+        tx = b"idx-key=idx-val"
+        resp = rpc_post(addr, "broadcast_tx_commit",
+                        tx=base64.b64encode(tx).decode())
+        assert "result" in resp, resp
+        height = int(resp["result"]["height"])
+        h = tx_hash(tx).hex().upper()
+
+        # indexer service consumes the event bus asynchronously
+        deadline = time.monotonic() + 10
+        rec = None
+        while time.monotonic() < deadline:
+            rec = node.tx_indexer.get(tx_hash(tx))
+            if rec is not None:
+                break
+            time.sleep(0.1)
+        assert rec is not None, "tx never indexed"
+
+        # /tx by hash (hex), with proof
+        got = rpc_post(addr, "tx", hash=h, prove=True)["result"]
+        assert got["hash"] == h
+        assert int(got["height"]) == height
+        assert base64.b64decode(got["tx"]) == tx
+        assert got["proof"]["proof"]["leaf_hash"]
+
+        # /tx_search by height query
+        got = rpc_post(addr, "tx_search",
+                       query=f"tx.height = {height}")["result"]
+        assert int(got["total_count"]) >= 1
+        assert any(t["hash"] == h for t in got["txs"])
+
+        # /block_search by height
+        got = rpc_post(addr, "block_search",
+                       query=f"block.height = {height}")["result"]
+        assert int(got["total_count"]) >= 1
+        assert int(got["blocks"][0]["block"]["header"]["height"]) == height
+
+        # GET URI form
+        got = rpc_get(addr, "tx", hash=h)
+        assert got["result"]["hash"] == h
+
+    def test_ws_subscription_receives_tx_event(self, node):  # noqa: F811
+        addr = node.rpc_addr
+        ws = WSClient(addr)
+        try:
+            ws.send_json({"jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                          "params": {"query": "tm.event = 'Tx'"}})
+            ack = ws.recv_json()
+            assert ack["id"] == 7 and ack.get("result") == {}, ack
+
+            tx = b"ws-key=ws-val"
+            rpc_post(addr, "broadcast_tx_sync",
+                     tx=base64.b64encode(tx).decode())
+            evmsg = ws.recv_json()
+            assert evmsg["id"] == 7
+            res = evmsg["result"]
+            assert res["query"] == "tm.event = 'Tx'"
+            assert res["data"]["type"] == "tendermint/event/Tx"
+            got_tx = base64.b64decode(res["data"]["value"]["TxResult"]["tx"])
+            assert got_tx == tx
+            assert tx_hash(tx).hex().upper() in res["events"]["tx.hash"]
+
+            # regular RPC over the same socket
+            ws.send_json({"jsonrpc": "2.0", "id": 8, "method": "health",
+                          "params": {}})
+            # may interleave with more events; scan a few messages
+            for _ in range(10):
+                msg = ws.recv_json()
+                if msg.get("id") == 8:
+                    assert msg["result"] == {}
+                    break
+            else:
+                pytest.fail("health reply never arrived")
+
+            ws.send_json({"jsonrpc": "2.0", "id": 9,
+                          "method": "unsubscribe",
+                          "params": {"query": "tm.event = 'Tx'"}})
+            for _ in range(10):
+                msg = ws.recv_json()
+                if msg.get("id") == 9:
+                    assert msg.get("result") == {}
+                    break
+            else:
+                pytest.fail("unsubscribe ack never arrived")
+        finally:
+            ws.close()
