@@ -1,4 +1,4 @@
-"""Benchmark: Ed25519 batch-verify throughput on one TPU chip.
+"""Benchmark: Ed25519 verify throughput on one TPU chip.
 
 Metric of record (BASELINE.json): sig-verifies/sec/chip, Ed25519 batch.
 Baseline: the reference's Go CPU batch verifier (curve25519-voi behind
@@ -8,8 +8,20 @@ no absolute number; Go single verify is ~70-100 µs/op on server x86 and
 voi's batch path roughly halves per-sig cost at batch >= 64, so we take
 25,000 sigs/s (40 µs/sig) as the CPU baseline.
 
+Primary metric: the RLC whole-batch equation (ops/ed25519.rlc_verify_kernel)
+on a 4095-signature batch — the honest-batch hot path used by
+types.VerifyCommit* via crypto/batch.py.  The `extra` field carries the
+secondary metrics of record:
+  - per_sig_kernel_sigs_per_sec: the per-signature-verdict kernel
+    (the fallback/localization path)
+  - light_client_headers_per_sec: 150-validator commit verifications
+    (BASELINE's 10k-headers x 150-validators sync config), RLC-verified
+    with dispatches pipelined the way a syncing light client overlaps
+    header verification.
+
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "sigs/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "sigs/sec/chip", "vs_baseline": N,
+   "extra": {...}}
 """
 
 from __future__ import annotations
@@ -23,49 +35,102 @@ import numpy as np
 GO_CPU_BASELINE_SIGS_PER_SEC = 25_000.0
 
 
-def main() -> None:
+def _make_sigs(n, n_keys=64, msg_len=128):
+    from cometbft_tpu.crypto import ed25519_ref as ref
+
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey)
+
+        def sign(seed, msg):
+            return Ed25519PrivateKey.from_private_bytes(seed).sign(msg)
+    except ImportError:           # pragma: no cover
+        sign = ref.sign
+
+    keys = [ref.keygen(bytes([i + 1, (i >> 8) + 1] + [7] * 30))
+            for i in range(n_keys)]
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        seed, pub = keys[i % n_keys]
+        msg = i.to_bytes(8, "little") * (msg_len // 8)
+        pks.append(pub)
+        msgs.append(msg)
+        sigs.append(sign(seed, msg))
+    return pks, msgs, sigs
+
+
+def bench_rlc(batch: int, iters: int) -> float:
+    """Pipelined RLC dispatches; one readback syncs the chain."""
     import jax
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.ops import ed25519 as dev
 
-    batch = int(os.environ.get("BENCH_BATCH", "4096"))
-    iters = int(os.environ.get("BENCH_ITERS", "8"))
-    msg_len = 128  # vote sign-bytes are ~120 bytes (canonical proto)
-
-    import __graft_entry__ as ge
-    pks, msgs, sigs = [], [], []
-    from cometbft_tpu.crypto import ed25519_ref as ref
-    keys = [ref.keygen(bytes([i + 1]) * 32) for i in range(64)]
-    for i in range(batch):
-        seed, pub = keys[i % 64]
-        msg = i.to_bytes(8, "little") * (msg_len // 8)
-        pks.append(pub)
-        msgs.append(msg)
-        sigs.append(ge._sign(seed, msg))
-
-    bucket = dev.bucket_size(batch)
-    a, r, s, h, valid = ed.pack_batch(pks, msgs, sigs, bucket)
-    assert valid.all()
-
-    # compile + correctness (np.asarray forces a real device round-trip;
-    # under the axon tunnel block_until_ready alone can return early)
-    verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
-    assert verdict[:batch].all(), "benchmark batch failed to verify"
-
-    # dispatches pipeline on-device; the single final np.asarray forces
-    # completion (one ~fixed readback amortized over iters)
+    pks, msgs, sigs = _make_sigs(batch)
+    packed = [jax.device_put(x) for x in ed.pack_rlc(pks, msgs, sigs)]
+    ok = bool(np.asarray(dev.rlc_verify_device(*packed)))
+    assert ok, "benchmark batch failed RLC verification"
     t0 = time.perf_counter()
-    for _ in range(iters - 1):
-        dev.verify_batch_device(a, r, s, h)
-    out = np.asarray(dev.verify_batch_device(a, r, s, h))
+    outs = [dev.rlc_verify_device(*packed) for _ in range(iters)]
+    assert np.asarray(outs[-1])
     dt = (time.perf_counter() - t0) / iters
+    return batch / dt
 
-    sigs_per_sec = batch / dt
+
+def bench_per_sig(batch: int, iters: int) -> float:
+    import jax
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs = _make_sigs(batch)
+    a, r, s, h, valid = ed.pack_batch(pks, msgs, sigs,
+                                      dev.bucket_size(batch))
+    args = [jax.device_put(x) for x in (a, r, s, h)]
+    verdict = np.asarray(dev.verify_batch_device(*args))
+    assert verdict[:batch].all(), "benchmark batch failed to verify"
+    t0 = time.perf_counter()
+    outs = [dev.verify_batch_device(*args) for _ in range(iters)]
+    np.asarray(outs[-1])
+    dt = (time.perf_counter() - t0) / iters
+    return batch / dt
+
+
+def bench_light_headers(n_validators: int, n_headers: int) -> float:
+    """Headers/sec: one 150-sig commit verification per header,
+    dispatches pipelined across headers."""
+    import jax
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs = _make_sigs(n_validators, n_keys=n_validators,
+                                 msg_len=120)
+    packed = [jax.device_put(x) for x in ed.pack_rlc(pks, msgs, sigs)]
+    assert bool(np.asarray(dev.rlc_verify_device(*packed)))
+    t0 = time.perf_counter()
+    outs = [dev.rlc_verify_device(*packed) for _ in range(n_headers)]
+    assert np.asarray(outs[-1])
+    dt = time.perf_counter() - t0
+    return n_headers / dt
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "4095"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+
+    rlc = bench_rlc(batch, iters)
+    per_sig = bench_per_sig(min(batch + 1, 4096), iters)
+    light = bench_light_headers(150, 32)
+
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
-        "value": round(sigs_per_sec, 1),
+        "value": round(rlc, 1),
         "unit": "sigs/sec/chip",
-        "vs_baseline": round(sigs_per_sec / GO_CPU_BASELINE_SIGS_PER_SEC, 3),
+        "vs_baseline": round(rlc / GO_CPU_BASELINE_SIGS_PER_SEC, 3),
+        "extra": {
+            "per_sig_kernel_sigs_per_sec": round(per_sig, 1),
+            "light_client_headers_per_sec": round(light, 1),
+            "light_client_config": "150 validators/commit, RLC, pipelined",
+            "rlc_batch": batch,
+        },
     }))
 
 

@@ -13,10 +13,13 @@ locking/unlocking per the Tendermint algorithm (arXiv:1807.04938).
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from dataclasses import dataclass
+
+_log = logging.getLogger(__name__)
 
 from ..libs.fail import fail_point
 from ..libs.service import BaseService
@@ -90,6 +93,9 @@ class ConsensusState(BaseService):
         self.block_exec = block_exec
         self.block_store = block_store
         self.wal = wal
+        # optional ConsensusMetrics (libs/metrics.py), assigned by the node
+        self.metrics = None
+        self._last_commit_monotonic = None
         self.priv_validator = priv_validator
         self.priv_validator_pub_key = \
             priv_validator.get_pub_key() if priv_validator else None
@@ -434,6 +440,8 @@ class ConsensusState(BaseService):
             validators.increment_proposer_priority(round_ - self.round)
 
         self.validators = validators
+        if self.metrics is not None:
+            self.metrics.rounds.set(round_)
         if round_ != 0:
             # round catchup: clear the proposal from the earlier round
             self.proposal = None
@@ -812,11 +820,33 @@ class ConsensusState(BaseService):
 
         fail_point("cs-after-apply")
 
+        if self.metrics is not None:
+            import time as _t
+
+            m = self.metrics
+            m.height.set(block.header.height)
+            m.num_txs.set(len(block.data.txs))
+            m.block_size_bytes.set(len(block.to_proto()))
+            m.total_txs.add(len(block.data.txs))
+            m.validators.set(len(self.validators.validators))
+            m.validators_power.set(self.validators.total_voting_power())
+            if self._last_commit_monotonic is not None:
+                m.block_interval_seconds.observe(
+                    _t.monotonic() - self._last_commit_monotonic)
+            self._last_commit_monotonic = _t.monotonic()
+
         self.update_to_state(state_copy)
 
-        # the validator key might have rotated
+        # The validator key might have rotated.  With a remote signer
+        # this is a network round trip and may transiently fail — never
+        # let it stall consensus (the reference logs and keeps the old
+        # key, state.go updatePrivValidatorPubKey).
         if self.priv_validator is not None:
-            self.priv_validator_pub_key = self.priv_validator.get_pub_key()
+            try:
+                self.priv_validator_pub_key = \
+                    self.priv_validator.get_pub_key()
+            except Exception as e:
+                _log.warning("failed to refresh privval pub key: %s", e)
 
         self.schedule_round_0()
 

@@ -104,6 +104,8 @@ class CListMempool:
         # shares _mtx so notify (under _mtx) and wait (which reads the
         # tx map) cannot deadlock on two locks taken in opposite order
         self._change_cond = threading.Condition(self._mtx)
+        # optional MempoolMetrics (libs/metrics.py), assigned by the node
+        self.metrics = None
 
     # -- locking (execution.go Commit holds this across app Commit) -------
     def lock(self) -> None:
@@ -166,6 +168,8 @@ class CListMempool:
                 entry = self._txs.get(tx_key(tx))
                 if entry is not None and sender:
                     entry.senders.add(sender)
+                if self.metrics is not None:
+                    self.metrics.already_received_txs.inc()
                 raise ErrTxInCache()
 
             res = self.app_conn.check_tx(at.CheckTxRequest(
@@ -184,6 +188,8 @@ class CListMempool:
         if res.code != at.CODE_TYPE_OK or not post_ok:
             if not self.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
+            if self.metrics is not None:
+                self.metrics.failed_txs.inc()
             raise ErrAppCheckTx(res.code, res.log)
 
         with self._mtx:
@@ -201,6 +207,9 @@ class CListMempool:
             self._txs[key] = entry
             self._txs_bytes += len(tx)
         self._notify_txs_available()
+        if self.metrics is not None:
+            self.metrics.tx_size_bytes.observe(len(tx))
+            self._update_gauges()
         with self._change_cond:
             self._change_cond.notify_all()
 
@@ -252,14 +261,22 @@ class CListMempool:
 
         if self._txs and self.recheck_enabled:
             self._recheck_txs()
+            if self.metrics is not None:
+                self.metrics.recheck_times.inc()
         if self._txs:
             self._notify_txs_available()
+        self._update_gauges()
 
     def _remove_tx(self, key: bytes) -> None:
         with self._mtx:
             entry = self._txs.pop(key, None)
             if entry is not None:
                 self._txs_bytes -= len(entry.tx)
+
+    def _update_gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.size.set(self.size())
+            self.metrics.size_bytes.set(self.size_bytes())
 
     def remove_tx_by_key(self, key: bytes) -> None:
         self._remove_tx(key)

@@ -130,10 +130,26 @@ class Node(BaseService):
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus)
 
-        # privval
-        self.priv_validator = FilePV.load_or_generate(
-            config.priv_validator_key_file(),
-            config.priv_validator_state_file())
+        # privval: remote signer when priv_validator_laddr is set
+        # (node.go:347-353 createAndStartPrivValidatorSocketClient),
+        # file-backed otherwise
+        self.signer_endpoint = None
+        if config.base.priv_validator_laddr:
+            from ..privval.signer import (SignerClient,
+                                          SignerListenerEndpoint)
+            self.signer_endpoint = SignerListenerEndpoint(
+                config.base.priv_validator_laddr)
+            self.priv_validator = SignerClient(
+                self.signer_endpoint, self.genesis.chain_id)
+            if not self.signer_endpoint.wait_for_connection(30.0):
+                self.signer_endpoint.close()
+                raise RuntimeError(
+                    "no remote signer connected to "
+                    f"{config.base.priv_validator_laddr} within 30s")
+        else:
+            self.priv_validator = FilePV.load_or_generate(
+                config.priv_validator_key_file(),
+                config.priv_validator_state_file())
 
         # ABCI handshake: replay to sync app with store (node.go:372)
         handshaker = Handshaker(self.state_store, state,
@@ -281,12 +297,28 @@ class Node(BaseService):
 
         self.rpc_server = None
 
+        # Prometheus metrics (node.go:868 startPrometheusServer;
+        # per-package metrics.go structs)
+        self.metrics_server = None
+        if config.instrumentation.prometheus:
+            from ..libs.metrics import (ConsensusMetrics, MempoolMetrics,
+                                        MetricsServer, P2PMetrics, Registry)
+            registry = Registry(config.instrumentation.namespace)
+            self.metrics_registry = registry
+            self.consensus_state.metrics = ConsensusMetrics(registry)
+            self.mempool.metrics = MempoolMetrics(registry)
+            self.switch.metrics = P2PMetrics(registry)
+            self.metrics_server = MetricsServer(
+                registry, config.instrumentation.prometheus_listen_addr)
+
     # -- lifecycle ---------------------------------------------------------
     def on_start(self) -> None:
         self.event_bus.start()
         if self.indexer_service is not None:
             self.indexer_service.start()
         self.pruner.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
         self.switch.start()
         if self.config.rpc.laddr:
             self._start_rpc()
@@ -363,6 +395,10 @@ class Node(BaseService):
         self.pruner.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.signer_endpoint is not None:
+            self.signer_endpoint.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self.event_bus.stop()
 
     def _start_rpc(self) -> None:

@@ -41,6 +41,8 @@ class Switch(BaseService):
         self.reactors_by_ch: dict[int, Reactor] = {}
         self.peers = PeerSet()
         self.dialing: set[str] = set()
+        # optional P2PMetrics (libs/metrics.py), assigned by the node
+        self.metrics = None
         self.reconnecting: set[str] = set()
         self.persistent_peers: set[str] = set()  # addresses 'id@host:port'
         self._mtx = threading.Lock()
@@ -154,6 +156,7 @@ class Switch(BaseService):
         except ValueError as e:
             conn.close()
             raise SwitchError(str(e)) from e
+        self._update_peer_gauge()
         try:
             for reactor in self.reactors.values():
                 reactor.init_peer(peer)
@@ -162,9 +165,14 @@ class Switch(BaseService):
                 reactor.add_peer(peer)
         except Exception:
             self.peers.remove(peer)
+            self._update_peer_gauge()
             conn.close()
             raise
         return peer
+
+    def _update_peer_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.peers.set(self.peers.size())
 
     # -- peer removal ------------------------------------------------------
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
@@ -182,6 +190,7 @@ class Switch(BaseService):
     def _remove_peer(self, peer: Peer, reason) -> bool:
         if not self.peers.remove(peer):
             return False
+        self._update_peer_gauge()
         peer.stop()
         for reactor in self.reactors.values():
             reactor.remove_peer(peer, reason)

@@ -1,0 +1,388 @@
+"""Remote signer: socket privval protocol
+(reference privval/signer_client.go, signer_listener_endpoint.go,
+signer_server.go, signer_dialer_endpoint.go, msgs.go).
+
+Topology matches the reference: the NODE LISTENS on
+`priv_validator_laddr`; the external signer process (HSM/KMS front-end)
+DIALS IN and serves signing requests over one long-lived connection,
+kept alive with pings.  Wire format: length-delimited protobuf
+`privval.Message` (proto/cometbft/privval/v1/types.proto oneof tags
+1-9), so an existing KMS speaking the CometBFT protocol lines up with
+the same message framing.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..libs import protowire as pw
+from ..types.vote import Proposal, Vote
+
+# Message oneof tags (types.proto:74-84)
+T_PUBKEY_REQ = 1
+T_PUBKEY_RESP = 2
+T_SIGN_VOTE_REQ = 3
+T_SIGNED_VOTE_RESP = 4
+T_SIGN_PROPOSAL_REQ = 5
+T_SIGNED_PROPOSAL_RESP = 6
+T_PING_REQ = 7
+T_PING_RESP = 8
+
+DEFAULT_TIMEOUT_READ_WRITE = 5.0     # signer_endpoint.go
+DEFAULT_TIMEOUT_ACCEPT = 30.0
+DEFAULT_PING_INTERVAL = 3.0          # ~ timeout * 2/3
+
+
+class RemoteSignerError(Exception):
+    def __init__(self, code: int, description: str):
+        super().__init__(f"remote signer error {code}: {description}")
+        self.code = code
+        self.description = description
+
+
+def _wrap(tag: int, payload: bytes) -> bytes:
+    return pw.Writer().message_field(tag, payload).bytes()
+
+
+def _unwrap(raw: bytes) -> tuple[int, bytes]:
+    r = pw.Reader(raw)
+    while not r.at_end():
+        f, w = r.read_tag()
+        if w == pw.BYTES:
+            return f, r.read_bytes()
+        r.skip(w)
+    raise ValueError("empty privval message")
+
+
+def _err_proto(code: int, desc: str) -> bytes:
+    return (pw.Writer().int_field(1, code)
+            .string_field(2, desc).bytes())
+
+
+def _parse_err(payload: bytes) -> RemoteSignerError:
+    r = pw.Reader(payload)
+    code, desc = 0, ""
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == 1 and w == pw.VARINT:
+            code = r.read_int()
+        elif f == 2 and w == pw.BYTES:
+            desc = r.read_string()
+        else:
+            r.skip(w)
+    return RemoteSignerError(code, desc)
+
+
+def _send_msg(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(pw.encode_uvarint(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> bytes | None:
+    """Length-delimited read (libs/protoio semantics)."""
+    # read the varint length byte-by-byte
+    n, shift = 0, 0
+    while True:
+        b = sock.recv(1)
+        if not b:
+            return None
+        n |= (b[0] & 0x7F) << shift
+        if not (b[0] & 0x80):
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("varint too long")
+    if n > 1 << 20:
+        raise ValueError("privval message too large")
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class SignerListenerEndpoint:
+    """Node side: accepts the signer's inbound connection and issues
+    requests over it (signer_listener_endpoint.go)."""
+
+    def __init__(self, addr: str,
+                 timeout_read_write: float = DEFAULT_TIMEOUT_READ_WRITE,
+                 timeout_accept: float = DEFAULT_TIMEOUT_ACCEPT):
+        host, _, port = addr.replace("tcp://", "").rpartition(":")
+        self._listener = socket.create_server(
+            (host or "127.0.0.1", int(port)))
+        self._listener.settimeout(timeout_accept)
+        self.bound_addr = "%s:%d" % self._listener.getsockname()[:2]
+        self._timeout = timeout_read_write
+        self._conn: socket.socket | None = None
+        self._mtx = threading.Lock()
+        self._connected = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="privval-accept", daemon=True)
+        self._stopped = False
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _ = self._listener.accept()
+            except (socket.timeout, OSError):
+                if self._stopped:
+                    return
+                continue
+            conn.settimeout(self._timeout)
+            with self._mtx:
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                self._conn = conn
+            self._connected.set()
+
+    def wait_for_connection(self, max_wait: float) -> bool:
+        return self._connected.wait(timeout=max_wait)
+
+    def is_connected(self) -> bool:
+        return self._connected.is_set()
+
+    def send_request(self, tag: int, payload: bytes) -> tuple[int, bytes]:
+        with self._mtx:
+            conn = self._conn
+            if conn is None:
+                raise RemoteSignerError(-1, "no signer connected")
+            try:
+                _send_msg(conn, _wrap(tag, payload))
+                raw = _recv_msg(conn)
+            except (OSError, socket.timeout) as e:
+                self._drop_conn_locked()
+                raise RemoteSignerError(-1, f"connection failed: {e}")
+            if raw is None:
+                self._drop_conn_locked()
+                raise RemoteSignerError(-1, "signer closed connection")
+            return _unwrap(raw)
+
+    def _drop_conn_locked(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+        self._connected.clear()
+
+    def close(self) -> None:
+        self._stopped = True
+        with self._mtx:
+            self._drop_conn_locked()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _resp_field(payload: bytes, data_field: int,
+                err_field: int = 2) -> bytes:
+    """Extract `data_field` from a response, raising any RemoteSignerError."""
+    r = pw.Reader(payload)
+    data = b""
+    err = None
+    while not r.at_end():
+        f, w = r.read_tag()
+        if f == data_field and w == pw.BYTES:
+            data = r.read_bytes()
+        elif f == err_field and w == pw.BYTES:
+            err = _parse_err(r.read_bytes())
+        else:
+            r.skip(w)
+    if err is not None and (err.code or err.description):
+        raise err
+    return data
+
+
+class SignerClient:
+    """types.PrivValidator backed by the remote signer
+    (signer_client.go) — drop-in for FilePV in the consensus state."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+
+    def ping(self) -> bool:
+        try:
+            tag, _ = self.endpoint.send_request(T_PING_REQ, b"")
+            return tag == T_PING_RESP
+        except RemoteSignerError:
+            return False
+
+    def get_pub_key(self):
+        from ..crypto import encoding as enc
+
+        req = pw.Writer().string_field(1, self.chain_id).bytes()
+        tag, payload = self.endpoint.send_request(T_PUBKEY_REQ, req)
+        if tag != T_PUBKEY_RESP:
+            raise RemoteSignerError(-1, f"unexpected response tag {tag}")
+        r = pw.Reader(payload)
+        key_bytes, key_type, err = b"", "", None
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 3 and w == pw.BYTES:
+                key_bytes = r.read_bytes()
+            elif f == 4 and w == pw.BYTES:
+                key_type = r.read_string()
+            elif f == 2 and w == pw.BYTES:
+                err = _parse_err(r.read_bytes())
+            else:
+                r.skip(w)
+        if err is not None and (err.code or err.description):
+            raise err
+        return enc.make_pubkey(key_type, key_bytes)
+
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool = False) -> None:
+        req = (pw.Writer()
+               .message_field(1, vote.to_proto())
+               .string_field(2, chain_id)
+               .bool_field(3, not sign_extension).bytes())
+        tag, payload = self.endpoint.send_request(T_SIGN_VOTE_REQ, req)
+        if tag != T_SIGNED_VOTE_RESP:
+            raise RemoteSignerError(-1, f"unexpected response tag {tag}")
+        signed = Vote.from_proto(_resp_field(payload, 1))
+        vote.signature = signed.signature
+        vote.extension_signature = signed.extension_signature
+        vote.timestamp = signed.timestamp
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        req = (pw.Writer()
+               .message_field(1, proposal.to_proto())
+               .string_field(2, chain_id).bytes())
+        tag, payload = self.endpoint.send_request(T_SIGN_PROPOSAL_REQ, req)
+        if tag != T_SIGNED_PROPOSAL_RESP:
+            raise RemoteSignerError(-1, f"unexpected response tag {tag}")
+        signed = Proposal.from_proto(_resp_field(payload, 1))
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+
+class SignerServer:
+    """External signer process: dials the node and serves its FilePV
+    over the socket (signer_server.go + signer_dialer_endpoint.go)."""
+
+    def __init__(self, addr: str, chain_id: str, priv_validator,
+                 timeout_read_write: float = DEFAULT_TIMEOUT_READ_WRITE,
+                 max_retries: int = 10, retry_wait: float = 0.1):
+        self.addr = addr.replace("tcp://", "")
+        self.chain_id = chain_id
+        self.pv = priv_validator
+        self._timeout = timeout_read_write
+        self._max_retries = max_retries
+        self._retry_wait = retry_wait
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="signer-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _dial(self) -> socket.socket | None:
+        host, _, port = self.addr.rpartition(":")
+        for _ in range(self._max_retries):
+            if self._stopped.is_set():
+                return None
+            try:
+                conn = socket.create_connection(
+                    (host, int(port)), timeout=self._timeout)
+                conn.settimeout(self._timeout)
+                return conn
+            except OSError:
+                time.sleep(self._retry_wait)
+        return None
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            conn = self._dial()
+            if conn is None:
+                return
+            try:
+                self._serve(conn)
+            except (OSError, ValueError):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _serve(self, conn: socket.socket) -> None:
+        while not self._stopped.is_set():
+            try:
+                raw = _recv_msg(conn)
+            except socket.timeout:
+                continue
+            if raw is None:
+                return
+            tag, payload = _unwrap(raw)
+            _send_msg(conn, self._handle(tag, payload))
+
+    # signer_requestHandler.go DefaultValidationRequestHandler
+    def _handle(self, tag: int, payload: bytes) -> bytes:
+        if tag == T_PING_REQ:
+            return _wrap(T_PING_RESP, b"")
+        if tag == T_PUBKEY_REQ:
+            pub = self.pv.get_pub_key()
+            resp = (pw.Writer()
+                    .bytes_field(3, pub.bytes())
+                    .string_field(4, pub.type()).bytes())
+            return _wrap(T_PUBKEY_RESP, resp)
+        if tag == T_SIGN_VOTE_REQ:
+            r = pw.Reader(payload)
+            vote, chain_id, skip_ext = None, self.chain_id, False
+            while not r.at_end():
+                f, w = r.read_tag()
+                if f == 1 and w == pw.BYTES:
+                    vote = Vote.from_proto(r.read_bytes())
+                elif f == 2 and w == pw.BYTES:
+                    chain_id = r.read_string()
+                elif f == 3 and w == pw.VARINT:
+                    skip_ext = bool(r.read_uvarint())
+                else:
+                    r.skip(w)
+            try:
+                self.pv.sign_vote(chain_id, vote,
+                                  sign_extension=not skip_ext)
+                resp = pw.Writer().message_field(1, vote.to_proto()).bytes()
+            except Exception as e:
+                resp = pw.Writer().message_field(
+                    2, _err_proto(1, str(e))).bytes()
+            return _wrap(T_SIGNED_VOTE_RESP, resp)
+        if tag == T_SIGN_PROPOSAL_REQ:
+            r = pw.Reader(payload)
+            proposal, chain_id = None, self.chain_id
+            while not r.at_end():
+                f, w = r.read_tag()
+                if f == 1 and w == pw.BYTES:
+                    proposal = Proposal.from_proto(r.read_bytes())
+                elif f == 2 and w == pw.BYTES:
+                    chain_id = r.read_string()
+                else:
+                    r.skip(w)
+            try:
+                self.pv.sign_proposal(chain_id, proposal)
+                resp = pw.Writer().message_field(
+                    1, proposal.to_proto()).bytes()
+            except Exception as e:
+                resp = pw.Writer().message_field(
+                    2, _err_proto(1, str(e))).bytes()
+            return _wrap(T_SIGNED_PROPOSAL_RESP, resp)
+        return _wrap(tag + 1, pw.Writer().message_field(
+            2, _err_proto(2, f"unsupported request tag {tag}")).bytes())

@@ -1,0 +1,72 @@
+"""Metrics registry + Prometheus exposition + node wiring
+(reference: per-package metrics.go, node/node.go:868 prometheus server).
+"""
+
+import urllib.request
+
+from cometbft_tpu.libs.metrics import (
+    Counter, Gauge, Histogram, MetricsServer, Registry)
+
+
+class TestRegistry:
+    def test_counter_gauge_exposition(self):
+        reg = Registry("tns")
+        c = reg.counter("consensus", "total_txs", "Total txs.")
+        g = reg.gauge("consensus", "height", "Height.")
+        c.inc()
+        c.add(4)
+        g.set(42)
+        text = reg.expose()
+        assert "# TYPE tns_consensus_total_txs counter" in text
+        assert "tns_consensus_total_txs 5" in text
+        assert "tns_consensus_height 42" in text
+
+    def test_labels(self):
+        reg = Registry("t")
+        c = reg.counter("p2p", "bytes", "Bytes.", labels=("chID",))
+        c.labels("0x20").add(100)
+        c.labels("0x30").add(7)
+        text = reg.expose()
+        assert 't_p2p_bytes{chID="0x20"} 100' in text
+        assert 't_p2p_bytes{chID="0x30"} 7' in text
+
+    def test_histogram_buckets(self):
+        reg = Registry("t")
+        h = reg.histogram("consensus", "interval", "Interval.",
+                          buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = reg.expose()
+        assert 't_consensus_interval_bucket{le="0.1"} 1' in text
+        assert 't_consensus_interval_bucket{le="1.0"} 2' in text
+        assert 't_consensus_interval_bucket{le="+Inf"} 3' in text
+        assert "t_consensus_interval_count 3" in text
+        assert "t_consensus_interval_sum 5.55" in text
+
+
+class TestNodeMetrics:
+    def test_node_exposes_prometheus(self, tmp_path):
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import Node, init_files
+        from tests.test_consensus import wait_for_height
+
+        cfg = _tcfg(str(tmp_path))
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        init_files(cfg, chain_id="metrics-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 3, timeout=60)
+            with urllib.request.urlopen(
+                    f"http://{n.metrics_server.bound_addr}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            assert "# TYPE cometbft_tpu_consensus_height gauge" in text
+            height_line = [ln for ln in text.splitlines()
+                           if ln.startswith("cometbft_tpu_consensus_height ")]
+            assert height_line and float(height_line[0].split()[-1]) >= 2
+            assert "cometbft_tpu_consensus_block_interval_seconds_count" \
+                in text
+        finally:
+            n.stop()
