@@ -139,6 +139,159 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_gen_validator(args) -> int:
+    """commands/gen_validator.go: print a fresh validator key."""
+    import base64
+
+    from ..crypto.ed25519 import PrivKey
+    priv = PrivKey.generate()
+    pub = priv.pub_key()
+    print(json.dumps({
+        "address": pub.address().hex().upper(),
+        "pub_key": {"type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(pub.bytes()).decode()},
+        "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                     "value": base64.b64encode(priv.bytes()).decode()},
+    }, indent=2))
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    """internal/inspect/inspect.go:51: serve RPC over the stores of a
+    crashed/stopped node WITHOUT running consensus."""
+    from ..rpc.core import Environment
+    from ..rpc.server import RPCServer
+    from ..state.store import StateStore
+    from ..store.blockstore import BlockStore
+    from ..store.kv import open_db
+    from ..types.genesis import GenesisDoc
+
+    cfg = _load_config(args.home)
+    backend = cfg.base.db_backend
+    env = Environment(
+        state_store=StateStore(open_db(
+            backend, os.path.join(cfg.db_dir(), "state.db"))),
+        block_store=BlockStore(open_db(
+            backend, os.path.join(cfg.db_dir(), "blockstore.db"))),
+        genesis=GenesisDoc.from_file(cfg.genesis_file())
+        if os.path.exists(cfg.genesis_file()) else None,
+        config=cfg)
+    if cfg.tx_index.indexer == "kv":
+        from ..state.indexer import BlockIndexer, TxIndexer
+        env.tx_indexer = TxIndexer(open_db(
+            backend, os.path.join(cfg.db_dir(), "tx_index.db")))
+        env.block_indexer = BlockIndexer(open_db(
+            backend, os.path.join(cfg.db_dir(), "block_index.db")))
+    addr = (args.rpc_laddr or cfg.rpc.laddr).replace("tcp://", "")
+    server = RPCServer(env, addr)
+    server.start()
+    print(f"Inspect RPC serving on {server.bound_addr} (no consensus); "
+          "Ctrl-C to stop")
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+def cmd_light(args) -> int:
+    """commands/light.go: verifying RPC proxy over an untrusted node."""
+    from ..light.client import Client, TrustOptions
+    from ..light.provider import HttpProvider
+    from ..light.proxy import LightProxy
+
+    if not args.trusted_height or not args.trusted_hash:
+        print("--trusted-height and --trusted-hash are required",
+              file=sys.stderr)
+        return 1
+    def _norm(addr: str) -> str:
+        return addr if "://" in addr else "http://" + addr
+
+    primary = HttpProvider(args.chain_id, _norm(args.primary))
+    witnesses = [HttpProvider(args.chain_id, _norm(w))
+                 for w in (args.witnesses.split(",")
+                           if args.witnesses else []) if w]
+    client = Client(
+        args.chain_id,
+        TrustOptions(period_ns=int(args.trust_period * 1e9),
+                     height=int(args.trusted_height),
+                     hash=bytes.fromhex(args.trusted_hash)),
+        primary, witnesses)
+    proxy = LightProxy(client, args.laddr)
+    proxy.start()
+    print(f"Light proxy serving verified RPC on {proxy.bound_addr}; "
+          "Ctrl-C to stop")
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    proxy.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go: generate N validator homes with a shared
+    genesis and fully-meshed persistent peers."""
+    from ..config import load_config, write_config_file
+    from ..p2p.key import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+    from ..types.timestamp import Timestamp
+
+    n = args.v
+    out = args.o or os.path.join(args.home, "testnet")
+    chain_id = args.chain_id or "chain-%s" % os.urandom(3).hex()
+    homes, validators, node_ids = [], [], []
+    for i in range(n):
+        home = os.path.join(out, f"{args.node_dir_prefix}{i}")
+        cfg = load_config(home)
+        cfg.base.root_dir = home
+        cfg.ensure_dirs()
+        pv = FilePV.load_or_generate(cfg.priv_validator_key_file(),
+                                     cfg.priv_validator_state_file())
+        key = NodeKey.load_or_gen(cfg.node_key_file())
+        homes.append((home, cfg))
+        node_ids.append(key.id)
+        validators.append(GenesisValidator(pub_key=pv.get_pub_key(),
+                                           power=1))
+    genesis = GenesisDoc(chain_id=chain_id, genesis_time=Timestamp.now(),
+                         validators=validators)
+    base_p2p, base_rpc = args.starting_port, args.starting_port + 1000
+    peers = ",".join(
+        f"{node_ids[i]}@127.0.0.1:{base_p2p + i}" for i in range(n))
+    for i, (home, cfg) in enumerate(homes):
+        genesis.save_as(cfg.genesis_file())
+        cfg.p2p.laddr = f"tcp://0.0.0.0:{base_p2p + i}"
+        cfg.rpc.laddr = f"tcp://0.0.0.0:{base_rpc + i}"
+        cfg.p2p.persistent_peers = ",".join(
+            p for j, p in enumerate(peers.split(",")) if j != i)
+        write_config_file(os.path.join(home, "config", "config.toml"),
+                          cfg)
+    print(f"Generated {n} node homes under {out} (chain_id={chain_id})")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """commands/compact.go analog: VACUUM the sqlite stores."""
+    import sqlite3
+    cfg = _load_config(args.home)
+    n = 0
+    for name in os.listdir(cfg.db_dir()):
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(cfg.db_dir(), name)
+        try:
+            conn = sqlite3.connect(path)
+            conn.execute("VACUUM")
+            conn.close()
+            n += 1
+        except sqlite3.DatabaseError as e:
+            print(f"skip {name}: {e}", file=sys.stderr)
+    print(f"Compacted {n} databases in {cfg.db_dir()}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(SOFTWARE_VERSION)
     return 0
@@ -197,6 +350,40 @@ def main(argv=None) -> int:
     p.add_argument("--hard", action="store_true",
                    help="also delete the invalidated block")
     p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser("gen-validator",
+                       help="print a fresh validator keypair")
+    p.set_defaults(fn=cmd_gen_validator)
+
+    p = sub.add_parser("inspect",
+                       help="serve RPC over the stores, no consensus")
+    p.add_argument("--rpc-laddr", default="")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("light", help="light-verifying RPC proxy")
+    p.add_argument("chain_id")
+    p.add_argument("--primary", required=True,
+                   help="primary full-node RPC address (host:port)")
+    p.add_argument("--witnesses", default="",
+                   help="comma-separated witness RPC addresses")
+    p.add_argument("--trusted-height", type=int, default=0)
+    p.add_argument("--trusted-hash", default="")
+    p.add_argument("--trust-period", type=float, default=168 * 3600,
+                   help="trusting period in seconds")
+    p.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser("testnet", help="generate a local testnet")
+    p.add_argument("--v", type=int, default=4,
+                   help="number of validators")
+    p.add_argument("--o", default="", help="output directory")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--node-dir-prefix", default="node")
+    p.add_argument("--starting-port", type=int, default=26656)
+    p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser("compact-db", help="compact the sqlite stores")
+    p.set_defaults(fn=cmd_compact_db)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -821,8 +821,6 @@ class ConsensusState(BaseService):
         fail_point("cs-after-apply")
 
         if self.metrics is not None:
-            import time as _t
-
             m = self.metrics
             m.height.set(block.header.height)
             m.num_txs.set(len(block.data.txs))
@@ -832,8 +830,8 @@ class ConsensusState(BaseService):
             m.validators_power.set(self.validators.total_voting_power())
             if self._last_commit_monotonic is not None:
                 m.block_interval_seconds.observe(
-                    _t.monotonic() - self._last_commit_monotonic)
-            self._last_commit_monotonic = _t.monotonic()
+                    time.monotonic() - self._last_commit_monotonic)
+            self._last_commit_monotonic = time.monotonic()
 
         self.update_to_state(state_copy)
 

@@ -1,0 +1,146 @@
+"""Light-client RPC proxy (reference cmd/cometbft/commands/light.go +
+light/proxy/): serves a JSON-RPC subset where every header/commit
+handed out has been light-verified against the trust root, so a wallet
+can point at an untrusted full node through this proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from ..rpc import serialize as ser
+
+
+class LightProxy:
+    """Verifying proxy over a light.Client."""
+
+    def __init__(self, client, addr: str):
+        self._client = client
+        host, _, port = addr.replace("tcp://", "").rpartition(":")
+        self._httpd = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), _make_handler(self))
+        self._httpd.daemon_threads = True
+        self.bound_addr = "%s:%d" % self._httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="light-proxy",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- verified handlers -------------------------------------------------
+
+    def _verified_block(self, height):
+        from ..types.timestamp import Timestamp
+        h = int(height) if height else 0
+        if h <= 0:
+            lb = self._client.update(Timestamp.now())
+            if lb is None:
+                lb = self._client.latest_trusted()
+        else:
+            lb = self._client.verify_light_block_at_height(
+                h, Timestamp.now())
+        if lb is None:
+            raise ValueError("no verifiable block")
+        return lb
+
+    def header(self, height=None) -> dict:
+        lb = self._verified_block(height)
+        return {"header": ser.header_json(lb.signed_header.header)}
+
+    def commit(self, height=None) -> dict:
+        lb = self._verified_block(height)
+        return {
+            "signed_header": {
+                "header": ser.header_json(lb.signed_header.header),
+                "commit": ser.commit_json(lb.signed_header.commit),
+            },
+            "canonical": True,
+        }
+
+    def validators(self, height=None) -> dict:
+        lb = self._verified_block(height)
+        vs = lb.validator_set
+        return {
+            "block_height": str(lb.height),
+            "validators": [ser.validator_json(v) for v in vs.validators],
+            "count": str(len(vs.validators)),
+            "total": str(len(vs.validators)),
+        }
+
+    def status(self) -> dict:
+        latest = self._client.latest_trusted()
+        return {
+            "node_info": {"moniker": "light-proxy"},
+            "sync_info": {
+                "latest_block_height":
+                    str(latest.height) if latest else "0",
+                "latest_block_hash":
+                    ser.hex_upper(latest.hash()) if latest else "",
+            },
+        }
+
+
+_ROUTES = {"header": "header", "commit": "commit",
+           "validators": "validators", "status": "status"}
+
+
+def _make_handler(proxy: LightProxy):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a) -> None:  # noqa: N802
+            pass
+
+        def _reply(self, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _call(self, method, params, req_id) -> dict:
+            fn_name = _ROUTES.get(method)
+            if fn_name is None:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": -32601,
+                                  "message": f"method {method} not found "
+                                  "(light proxy serves verified routes "
+                                  "only)"}}
+            try:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "result": getattr(proxy, fn_name)(**params)}
+            except Exception as e:
+                return {"jsonrpc": "2.0", "id": req_id,
+                        "error": {"code": -32603, "message": str(e)}}
+
+        def do_GET(self) -> None:  # noqa: N802
+            parsed = urlparse(self.path)
+            method = parsed.path.strip("/")
+            params = dict(parse_qsl(parsed.query))
+            self._reply(self._call(method, params, -1))
+
+        def do_POST(self) -> None:  # noqa: N802
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError:
+                self._reply({"jsonrpc": "2.0", "id": None,
+                             "error": {"code": -32700,
+                                       "message": "parse error"}})
+                return
+            self._reply(self._call(req.get("method", ""),
+                                   req.get("params") or {},
+                                   req.get("id")))
+
+    return Handler

@@ -260,9 +260,10 @@ class CListMempool:
             self._remove_tx(tx_key(tx))
 
         if self._txs and self.recheck_enabled:
+            n_recheck = len(self._txs)
             self._recheck_txs()
             if self.metrics is not None:
-                self.metrics.recheck_times.inc()
+                self.metrics.recheck_times.inc(n_recheck)
         if self._txs:
             self._notify_txs_available()
         self._update_gauges()

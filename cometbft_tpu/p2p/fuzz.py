@@ -1,0 +1,79 @@
+"""FuzzedConnection: fault injection for peer links
+(reference p2p/fuzz.go).
+
+Wraps a connection (SecretConnection or anything with write/read/close)
+and injects faults per the config:
+
+- "delay": sleep up to max_delay before each write — models slow/
+  congested links; the protocol must stay live.
+- "drop": with probability p, swallow a write while reporting success —
+  models packet loss past the transport's guarantees.  Because peer
+  traffic is AEAD-framed, a dropped frame desyncs the receiver's nonce
+  stream, which must surface as a clean SecretConnectionError eviction,
+  never a hang or a crash.
+
+The reference starts fuzzing after a delay (fuzz.go start), so
+handshakes always complete; mirrored here.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class FuzzConfig:
+    MODE_DELAY = "delay"
+    MODE_DROP = "drop"
+
+    def __init__(self, mode: str = MODE_DELAY, prob_drop: float = 0.1,
+                 max_delay: float = 0.01, start_after: float = 0.0,
+                 seed: int | None = None):
+        self.mode = mode
+        self.prob_drop = prob_drop
+        self.max_delay = max_delay
+        self.start_after = start_after
+        self.seed = seed
+
+
+class FuzzedConnection:
+    def __init__(self, conn, config: FuzzConfig | None = None):
+        self._conn = conn
+        self.config = config or FuzzConfig()
+        self._rand = random.Random(self.config.seed)
+        self._start = time.monotonic() + self.config.start_after
+        self._mtx = threading.Lock()
+
+    def _active(self) -> bool:
+        return time.monotonic() >= self._start
+
+    def _fuzz_write(self) -> bool:
+        """Returns True if the write should be swallowed."""
+        if not self._active():
+            return False
+        with self._mtx:
+            if self.config.mode == FuzzConfig.MODE_DELAY:
+                delay = self._rand.random() * self.config.max_delay
+                if delay > 0:
+                    time.sleep(delay)
+                return False
+            if self.config.mode == FuzzConfig.MODE_DROP:
+                return self._rand.random() < self.config.prob_drop
+        return False
+
+    # -- conn interface ----------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        if self._fuzz_write():
+            return len(data)          # swallowed: pretend success
+        return self._conn.write(data)
+
+    def read(self) -> bytes:
+        return self._conn.read()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)

@@ -75,18 +75,33 @@ def _parse_err(payload: bytes) -> RemoteSignerError:
     return RemoteSignerError(code, desc)
 
 
+class IdleTimeout(Exception):
+    """Read timed out before ANY byte arrived — the stream is still in
+    sync and the caller may safely retry."""
+
+
 def _send_msg(sock: socket.socket, data: bytes) -> None:
-    sock.sendall(pw.encode_uvarint(len(data)) + data)
+    sock.sendall(pw.marshal_delimited(data))
 
 
 def _recv_msg(sock: socket.socket) -> bytes | None:
-    """Length-delimited read (libs/protoio semantics)."""
-    # read the varint length byte-by-byte
-    n, shift = 0, 0
+    """Length-delimited read (libs/protoio semantics).
+
+    A timeout with zero bytes consumed raises IdleTimeout (retryable);
+    a timeout MID-message raises ValueError — the framing is desynced
+    and the connection must be dropped."""
+    n, shift, consumed = 0, 0, False
     while True:
-        b = sock.recv(1)
+        try:
+            b = sock.recv(1)
+        except socket.timeout:
+            if not consumed:
+                raise IdleTimeout() from None
+            raise ValueError("timeout mid-message: stream desynced") \
+                from None
         if not b:
             return None
+        consumed = True
         n |= (b[0] & 0x7F) << shift
         if not (b[0] & 0x80):
             break
@@ -97,7 +112,11 @@ def _recv_msg(sock: socket.socket) -> bytes | None:
         raise ValueError("privval message too large")
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            raise ValueError("timeout mid-message: stream desynced") \
+                from None
         if not chunk:
             return None
         buf += chunk
@@ -157,7 +176,10 @@ class SignerListenerEndpoint:
             try:
                 _send_msg(conn, _wrap(tag, payload))
                 raw = _recv_msg(conn)
-            except (OSError, socket.timeout) as e:
+            except (OSError, socket.timeout, IdleTimeout,
+                    ValueError) as e:
+                # on the requester side ANY timeout/desync is fatal for
+                # this connection: the in-flight request is lost
                 self._drop_conn_locked()
                 raise RemoteSignerError(-1, f"connection failed: {e}")
             if raw is None:
@@ -327,7 +349,7 @@ class SignerServer:
         while not self._stopped.is_set():
             try:
                 raw = _recv_msg(conn)
-            except socket.timeout:
+            except IdleTimeout:
                 continue
             if raw is None:
                 return
