@@ -1,0 +1,4 @@
+from .manifest import Manifest, NodeManifest
+from .runner import Testnet
+
+__all__ = ["Manifest", "NodeManifest", "Testnet"]

@@ -1,0 +1,69 @@
+"""E2E testnet manifests (reference test/e2e/pkg/manifest.go).
+
+A manifest declares the testnet shape — validators, full nodes, which
+nodes start late, which get perturbed — and loads from TOML:
+
+    [node.validator0]
+    [node.validator1]
+    [node.full0]
+    mode = "full"
+    start_at = 3
+    perturb = ["kill", "restart"]
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+PERTURBATIONS = ("kill", "pause", "restart", "disconnect")
+
+
+@dataclass
+class NodeManifest:
+    name: str
+    mode: str = "validator"          # validator | full
+    start_at: int = 0                # join when the chain reaches height
+    perturb: list[str] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.mode not in ("validator", "full"):
+            raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+        for p in self.perturb:
+            if p not in PERTURBATIONS:
+                raise ValueError(f"{self.name}: unknown perturbation {p!r}")
+
+
+@dataclass
+class Manifest:
+    nodes: list[NodeManifest] = field(default_factory=list)
+    initial_height: int = 1
+    load_tx_rate: int = 10           # txs/sec injected during the run
+    run_blocks: int = 8              # target height before teardown
+
+    @staticmethod
+    def parse(text: str) -> "Manifest":
+        data = tomllib.loads(text)
+        m = Manifest(
+            initial_height=int(data.get("initial_height", 1)),
+            load_tx_rate=int(data.get("load_tx_rate", 10)),
+            run_blocks=int(data.get("run_blocks", 8)))
+        for name, spec in (data.get("node") or {}).items():
+            m.nodes.append(NodeManifest(
+                name=name,
+                mode=spec.get("mode", "validator"),
+                start_at=int(spec.get("start_at", 0)),
+                perturb=list(spec.get("perturb", []))))
+        m.validate()
+        return m
+
+    def validate(self) -> None:
+        if not self.nodes:
+            raise ValueError("manifest has no nodes")
+        if not any(n.mode == "validator" for n in self.nodes):
+            raise ValueError("manifest needs at least one validator")
+        for n in self.nodes:
+            n.validate()
+
+    def validators(self) -> list[NodeManifest]:
+        return [n for n in self.nodes if n.mode == "validator"]

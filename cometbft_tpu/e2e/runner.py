@@ -1,0 +1,287 @@
+"""E2E testnet runner (reference test/e2e/runner/: setup.go, start.go,
+load.go, perturb.go, tests/ invariants).
+
+Runs a manifest as REAL node processes (python -m cometbft_tpu.cmd.main
+start) on localhost — the docker-compose-on-one-host topology of the
+reference collapsed to plain subprocesses.  Supports:
+
+- phased start (start_at: join once the chain reaches a height,
+  exercising blocksync catch-up)
+- load injection via broadcast_tx_sync against rotating nodes
+- perturbations: kill (SIGKILL + restart), pause (SIGSTOP/SIGCONT),
+  restart (graceful SIGTERM + start), disconnect (drop the node's
+  switch listener by pausing long enough to evict peers)
+- invariant checks over RPC: all nodes agree on block hashes for every
+  common height, and app hashes match (reference tests/block_test.go).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+from ..config import load_config, write_config_file
+from ..p2p.key import NodeKey
+from ..privval import FilePV
+from ..types.genesis import GenesisDoc, GenesisValidator
+from ..types.timestamp import Timestamp
+from .manifest import Manifest, NodeManifest
+
+
+class E2EError(Exception):
+    pass
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestnetNode:
+    def __init__(self, manifest: NodeManifest, home: str, p2p_port: int,
+                 rpc_port: int):
+        self.manifest = manifest
+        self.home = home
+        self.p2p_port = p2p_port
+        self.rpc_port = rpc_port
+        self.node_id = ""
+        self.proc: subprocess.Popen | None = None
+        self.log_path = os.path.join(home, "node.log")
+
+    @property
+    def name(self) -> str:
+        return self.manifest.name
+
+    @property
+    def p2p_addr(self) -> str:
+        return f"{self.node_id}@127.0.0.1:{self.p2p_port}"
+
+    def rpc(self, method: str, timeout: float = 5.0, **params):
+        qs = "&".join(f"{k}={v}" for k, v in params.items())
+        url = f"http://127.0.0.1:{self.rpc_port}/{method}"
+        if qs:
+            url += f"?{qs}"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+        if "error" in body and body["error"]:
+            raise E2EError(f"{self.name} {method}: {body['error']}")
+        return body["result"]
+
+    def height(self) -> int:
+        try:
+            return int(self.rpc("status")["sync_info"]
+                       ["latest_block_height"])
+        except (OSError, E2EError, KeyError):
+            return -1
+
+    def start(self) -> None:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu.cmd.main",
+             "--home", self.home, "start"],
+            env=env, stdout=log, stderr=log)
+
+    def stop(self, sig=signal.SIGTERM, timeout: float = 20.0) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        self.proc = None
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Testnet:
+    """Orchestrates one manifest run (runner/main.go Cleanup/Setup/
+    Start/Load/Perturb/Test collapsed into methods)."""
+
+    __test__ = False     # not a pytest class despite the name
+
+    def __init__(self, manifest: Manifest, out_dir: str,
+                 chain_id: str = "e2e-chain", fast: bool = True):
+        self.manifest = manifest
+        self.out_dir = out_dir
+        self.chain_id = chain_id
+        self.fast = fast
+        self.nodes: list[TestnetNode] = []
+
+    def node(self, name: str) -> TestnetNode:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    # -- setup (runner/setup.go) ------------------------------------------
+
+    def setup(self) -> None:
+        validators = []
+        for nm in self.manifest.nodes:
+            home = os.path.join(self.out_dir, nm.name)
+            node = TestnetNode(nm, home, _free_port(), _free_port())
+            cfg = load_config(home)
+            cfg.base.root_dir = home
+            cfg.ensure_dirs()
+            pv = FilePV.load_or_generate(
+                cfg.priv_validator_key_file(),
+                cfg.priv_validator_state_file())
+            node.node_id = NodeKey.load_or_gen(cfg.node_key_file()).id
+            if nm.mode == "validator":
+                validators.append(
+                    GenesisValidator(pub_key=pv.get_pub_key(), power=10))
+            self.nodes.append(node)
+
+        genesis = GenesisDoc(
+            chain_id=self.chain_id, genesis_time=Timestamp.now(),
+            initial_height=self.manifest.initial_height,
+            validators=validators)
+
+        for node in self.nodes:
+            cfg = load_config(node.home)
+            cfg.base.root_dir = node.home
+            cfg.base.db_backend = "sqlite"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{node.p2p_port}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
+            cfg.p2p.persistent_peers = ",".join(
+                p.p2p_addr for p in self.nodes if p is not node)
+            if self.fast:
+                cfg.consensus.timeout_propose = 0.3
+                cfg.consensus.timeout_propose_delta = 0.05
+                cfg.consensus.timeout_prevote = 0.1
+                cfg.consensus.timeout_prevote_delta = 0.05
+                cfg.consensus.timeout_precommit = 0.1
+                cfg.consensus.timeout_precommit_delta = 0.05
+                cfg.consensus.timeout_commit = 0.2
+            genesis.save_as(cfg.genesis_file())
+            write_config_file(
+                os.path.join(node.home, "config", "config.toml"), cfg)
+
+    # -- lifecycle (runner/start.go) --------------------------------------
+
+    def start(self) -> None:
+        for node in self.nodes:
+            if node.manifest.start_at == 0:
+                node.start()
+
+    def wait_for_height(self, height: int, timeout: float = 120.0,
+                        nodes: list[TestnetNode] | None = None) -> None:
+        """Also handles phased starts: late nodes join when the chain
+        reaches their start_at height (runner/start.go:47)."""
+        deadline = time.monotonic() + timeout
+        targets = nodes or [n for n in self.nodes
+                            if n.manifest.start_at == 0]
+        pending = [n for n in self.nodes
+                   if n.manifest.start_at > 0 and not n.running()]
+        while time.monotonic() < deadline:
+            heights = [n.height() for n in targets if n.running()]
+            tip = max(heights, default=-1)
+            for late in list(pending):
+                if tip >= late.manifest.start_at:
+                    late.start()
+                    pending.remove(late)
+            if heights and min(heights) >= height and not pending:
+                return
+            time.sleep(0.3)
+        raise E2EError(
+            f"testnet never reached height {height}: "
+            f"{[(n.name, n.height()) for n in self.nodes]}")
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            if node.running():
+                node.stop()
+
+    # -- load (runner/load.go) --------------------------------------------
+
+    def load(self, n_txs: int) -> list[bytes]:
+        txs = []
+        live = [n for n in self.nodes if n.running()]
+        for i in range(n_txs):
+            tx = b"e2e-%d=val-%d" % (i, i)
+            node = live[i % len(live)]
+            try:
+                node.rpc("broadcast_tx_sync",
+                         tx=base64.b64encode(tx).decode())
+                txs.append(tx)
+            except (OSError, E2EError):
+                pass
+            time.sleep(1.0 / max(self.manifest.load_tx_rate, 1))
+        return txs
+
+    # -- perturbations (runner/perturb.go) --------------------------------
+
+    def perturb(self, node: TestnetNode, kind: str) -> None:
+        if kind == "kill":
+            node.stop(sig=signal.SIGKILL)
+            node.start()
+        elif kind == "restart":
+            node.stop(sig=signal.SIGTERM)
+            node.start()
+        elif kind in ("pause", "disconnect"):
+            node.proc.send_signal(signal.SIGSTOP)
+            time.sleep(3.0 if kind == "pause" else 8.0)
+            node.proc.send_signal(signal.SIGCONT)
+        else:
+            raise E2EError(f"unknown perturbation {kind!r}")
+
+    def run_perturbations(self) -> None:
+        for node in self.nodes:
+            for kind in node.manifest.perturb:
+                self.perturb(node, kind)
+
+    # -- invariants (reference test/e2e/tests/block_test.go) --------------
+
+    def check_block_identity(self) -> int:
+        """Every node reports the same block hash + app hash for every
+        height all of them have; returns heights compared."""
+        live = [n for n in self.nodes if n.running()]
+        if len(live) < 2:
+            raise E2EError("not enough live nodes to compare")
+        tip = min(n.height() for n in live)
+        base = max(int(n.rpc("status")["sync_info"]
+                       .get("earliest_block_height", 1)) for n in live)
+        compared = 0
+        for h in range(base, tip + 1):
+            seen = {}
+            for n in live:
+                meta = n.rpc("block", height=h)
+                key = (meta["block_id"]["hash"],
+                       meta["block"]["header"]["app_hash"])
+                seen[n.name] = key
+            if len(set(seen.values())) != 1:
+                raise E2EError(f"block identity diverged at {h}: {seen}")
+            compared += 1
+        if compared == 0:
+            raise E2EError("no common heights to compare")
+        return compared
+
+    def check_txs_committed(self, txs: list[bytes]) -> int:
+        """Injected txs are queryable via /tx on some node."""
+        from ..types.block import tx_hash
+        live = [n for n in self.nodes if n.running()]
+        found = 0
+        for tx in txs:
+            h = tx_hash(tx).hex().upper()
+            for n in live:
+                try:
+                    n.rpc("tx", hash=h)
+                    found += 1
+                    break
+                except (OSError, E2EError):
+                    continue
+        return found

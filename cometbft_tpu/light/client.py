@@ -259,23 +259,76 @@ class Client:
                 continue
             if other.hash() != target.hash():
                 evidence = self._examine_divergence(w, trace, other, now)
+                if evidence is None:
+                    # the witness could not back its header with a
+                    # verifiable chain: it is faulty, not the primary —
+                    # drop it and keep going (detector.go:121).  Running
+                    # out of witnesses fails CLOSED like the reference's
+                    # ErrNoWitnesses: without cross-checking, a forking
+                    # primary would go undetected.
+                    self.witnesses.remove(w)
+                    if not self.witnesses:
+                        raise LightClientError(
+                            "no witnesses remain after dropping faulty "
+                            "ones; cannot cross-verify the primary")
+                    continue
                 raise ErrLightClientAttack(evidence)
 
     def _examine_divergence(self, witness: Provider,
                             trace: list[LightBlock],
                             conflicting: LightBlock, now: Timestamp):
-        """Build LightClientAttackEvidence against whichever side
-        produced an invalid-but-verifiable header (detector.go
-        examineConflictingHeaderAgainstTrace, simplified: the witness's
-        block diverging from a verified trace is the evidence)."""
-        from ..types.evidence import LightClientAttackEvidence
+        """detector.go examineConflictingHeaderAgainstTrace: walk the
+        verified primary trace to the latest block the witness agrees
+        with (the common block), verify the witness's own chain from
+        there to the conflicting height, and if it verifies, this is a
+        provable attack: build evidence for BOTH sides, report each to
+        the opposing provider, and return the evidence against the
+        primary (the caller raises)."""
+        from ..types.evidence import (LightClientAttackEvidence,
+                                      get_byzantine_validators)
+
+        # find the latest common (agreed) block along the trace
         common = trace[0]
-        return LightClientAttackEvidence(
-            conflicting_block=conflicting,
+        for tb in trace[:-1]:
+            try:
+                wb = witness.light_block(tb.height)
+            except ProviderError:
+                break
+            if wb.hash() != tb.hash():
+                break
+            common = tb
+        # verify the witness's chain from the common root to the
+        # conflicting header; failure = faulty witness, not an attack
+        try:
+            self._verify_skipping(witness, common, conflicting, now)
+        except (LightClientError, ProviderError):
+            return None
+
+        target = trace[-1]
+        ev_against_primary = LightClientAttackEvidence(
+            conflicting_block=target,
             common_height=common.height,
-            byzantine_validators=[],
+            byzantine_validators=get_byzantine_validators(
+                common.validator_set, conflicting.signed_header, target),
             total_voting_power=common.validator_set.total_voting_power(),
             timestamp=common.signed_header.header.time)
+        ev_against_witness = LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=common.height,
+            byzantine_validators=get_byzantine_validators(
+                common.validator_set, target.signed_header, conflicting),
+            total_voting_power=common.validator_set.total_voting_power(),
+            timestamp=common.signed_header.header.time)
+        # each side learns about the other's misbehavior
+        # (detector.go sends primary's evidence to witnesses and
+        # vice versa); reporting failures don't mask the attack
+        for provider, ev_item in ((witness, ev_against_primary),
+                                  (self.primary, ev_against_witness)):
+            try:
+                provider.report_evidence(ev_item)
+            except Exception:
+                pass
+        return ev_against_primary
 
     # -- provider plumbing -------------------------------------------------
 

@@ -45,6 +45,11 @@ class Provider(Protocol):
 
     def chain_id(self) -> str: ...
 
+    def report_evidence(self, ev) -> None:
+        """Submit misbehavior evidence back to this provider's node
+        (provider.Provider ReportEvidence)."""
+        ...
+
 
 class MemoryProvider:
     """In-memory provider for tests and local verification."""
@@ -53,9 +58,13 @@ class MemoryProvider:
                  blocks: dict[int, LightBlock] | None = None):
         self._chain_id = chain_id
         self._blocks: dict[int, LightBlock] = dict(blocks or {})
+        self.reported_evidence: list = []
 
     def add(self, lb: LightBlock) -> None:
         self._blocks[lb.height] = lb
+
+    def report_evidence(self, ev) -> None:
+        self.reported_evidence.append(ev)
 
     def chain_id(self) -> str:
         return self._chain_id
@@ -132,3 +141,16 @@ class HttpProvider:
         except ValueError as e:
             raise ErrBadLightBlock(str(e)) from e
         return lb
+
+    def report_evidence(self, ev) -> None:
+        """POST the evidence to the node's /broadcast_evidence
+        (light/provider/http ReportEvidence)."""
+        import base64
+
+        from ..types.evidence import evidence_to_proto_wrapped
+
+        from urllib.parse import quote
+
+        wrapped = base64.b64encode(
+            evidence_to_proto_wrapped(ev)).decode()
+        self._rpc("broadcast_evidence", {"evidence": quote(wrapped)})

@@ -6,12 +6,11 @@ can point at an untrusted full node through this proxy.
 
 from __future__ import annotations
 
-import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qsl, urlparse
+from http.server import ThreadingHTTPServer
 
 from ..rpc import serialize as ser
+from ..rpc.server import _call_target, _err, make_json_handler
 
 
 class LightProxy:
@@ -20,8 +19,18 @@ class LightProxy:
     def __init__(self, client, addr: str):
         self._client = client
         host, _, port = addr.replace("tcp://", "").rpartition(":")
+
+        def dispatch(method, params, req_id):
+            fn_name = _ROUTES.get(method)
+            if fn_name is None:
+                return _err(req_id, -32601,
+                            f"method {method} not found (light proxy "
+                            "serves verified routes only)")
+            return _call_target(getattr(self, fn_name), params, req_id)
+
         self._httpd = ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), _make_handler(self))
+            (host or "127.0.0.1", int(port)),
+            make_json_handler(dispatch, sorted(_ROUTES)))
         self._httpd.daemon_threads = True
         self.bound_addr = "%s:%d" % self._httpd.server_address
         self._thread: threading.Thread | None = None
@@ -92,55 +101,3 @@ class LightProxy:
 
 _ROUTES = {"header": "header", "commit": "commit",
            "validators": "validators", "status": "status"}
-
-
-def _make_handler(proxy: LightProxy):
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, *a) -> None:  # noqa: N802
-            pass
-
-        def _reply(self, payload: dict) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def _call(self, method, params, req_id) -> dict:
-            fn_name = _ROUTES.get(method)
-            if fn_name is None:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": -32601,
-                                  "message": f"method {method} not found "
-                                  "(light proxy serves verified routes "
-                                  "only)"}}
-            try:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "result": getattr(proxy, fn_name)(**params)}
-            except Exception as e:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": -32603, "message": str(e)}}
-
-        def do_GET(self) -> None:  # noqa: N802
-            parsed = urlparse(self.path)
-            method = parsed.path.strip("/")
-            params = dict(parse_qsl(parsed.query))
-            self._reply(self._call(method, params, -1))
-
-        def do_POST(self) -> None:  # noqa: N802
-            length = int(self.headers.get("Content-Length", "0"))
-            try:
-                req = json.loads(self.rfile.read(length) or b"{}")
-            except json.JSONDecodeError:
-                self._reply({"jsonrpc": "2.0", "id": None,
-                             "error": {"code": -32700,
-                                       "message": "parse error"}})
-                return
-            self._reply(self._call(req.get("method", ""),
-                                   req.get("params") or {},
-                                   req.get("id")))
-
-    return Handler

@@ -2,7 +2,8 @@
 
 Accepts POST / with a JSON-RPC envelope and GET /<method>?arg=...
 URI-style calls, like the reference's http_json_handler + uri handler.
-"""
+The handler factory is shared with the light proxy (which serves a
+different route table and no websocket)."""
 
 from __future__ import annotations
 
@@ -20,8 +21,16 @@ class RPCServer:
     def __init__(self, env: Environment, addr: str):
         host, _, port = addr.rpartition(":")
         self._env = env
+
+        def dispatch(method: str, params: dict, req_id) -> dict:
+            attr = ROUTES.get(method)
+            if attr is None:
+                return _err(req_id, -32601, f"method {method} not found")
+            return _call_target(getattr(env, attr), params, req_id)
+
         self._httpd = ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), _make_handler(env))
+            (host or "127.0.0.1", int(port)),
+            make_json_handler(dispatch, sorted(ROUTES), env=env))
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
         self.bound_addr = "%s:%d" % self._httpd.server_address
@@ -33,8 +42,16 @@ class RPCServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
+
+
+def _err(req_id, code: int, message: str, data: str = "") -> dict:
+    e = {"code": code, "message": message}
+    if data:
+        e["data"] = data
+    return {"jsonrpc": "2.0", "id": req_id, "error": e}
 
 
 def _coerce_params(params: dict) -> dict:
@@ -49,7 +66,24 @@ def _coerce_params(params: dict) -> dict:
     return out
 
 
-def _make_handler(env: Environment):
+def _call_target(fn, params: dict, req_id) -> dict:
+    """Invoke one handler with JSON-RPC error mapping."""
+    try:
+        return {"jsonrpc": "2.0", "id": req_id,
+                "result": fn(**_coerce_params(params))}
+    except RPCError as e:
+        return _err(req_id, e.code, e.message, e.data)
+    except TypeError as e:
+        return _err(req_id, -32602, f"invalid params: {e}")
+    except Exception as e:
+        return _err(req_id, -32603, str(e))
+
+
+def make_json_handler(dispatch, route_names, env=None):
+    """HTTP handler over a `dispatch(method, params, id) -> response`
+    function.  `env` (when given) enables the /websocket upgrade for
+    event subscriptions; the light proxy passes env=None."""
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -57,7 +91,7 @@ def _make_handler(env: Environment):
             pass  # quiet
 
         # -- helpers -------------------------------------------------------
-        def _reply(self, status: int, payload: dict) -> None:
+        def _reply(self, status: int, payload) -> None:
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
@@ -66,26 +100,7 @@ def _make_handler(env: Environment):
             self.wfile.write(body)
 
         def _call(self, method: str, params: dict, req_id) -> dict:
-            attr = ROUTES.get(method)
-            if attr is None:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": -32601,
-                                  "message": f"method {method} not found"}}
-            try:
-                result = getattr(env, attr)(**_coerce_params(params))
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "result": result}
-            except RPCError as e:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": e.code, "message": e.message,
-                                  "data": e.data}}
-            except TypeError as e:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": -32602,
-                                  "message": f"invalid params: {e}"}}
-            except Exception as e:
-                return {"jsonrpc": "2.0", "id": req_id,
-                        "error": {"code": -32603, "message": str(e)}}
+            return dispatch(method, params, req_id)
 
         # -- JSON-RPC over POST -------------------------------------------
         def do_POST(self) -> None:  # noqa: N802
@@ -96,9 +111,7 @@ def _make_handler(env: Environment):
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
             except json.JSONDecodeError:
-                self._reply(400, {
-                    "jsonrpc": "2.0", "id": None,
-                    "error": {"code": -32700, "message": "parse error"}})
+                self._reply(400, _err(None, -32700, "parse error"))
                 return
             if isinstance(req, list):  # batch
                 resp = [self._call(r.get("method", ""),
@@ -107,16 +120,7 @@ def _make_handler(env: Environment):
             else:
                 resp = self._call(req.get("method", ""),
                                   req.get("params") or {}, req.get("id"))
-            self._reply(200, resp) if isinstance(resp, dict) else \
-                self._reply_list(resp)
-
-        def _reply_list(self, payload: list) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(200, resp)
 
         # -- WebSocket upgrade (reference ws_handler.go) -------------------
         def _do_websocket(self) -> None:
@@ -140,16 +144,15 @@ def _make_handler(env: Environment):
         # -- URI-style GET -------------------------------------------------
         def do_GET(self) -> None:  # noqa: N802
             parsed = urlparse(self.path)
-            if parsed.path.strip("/") == "websocket" and \
+            method = parsed.path.strip("/")
+            if env is not None and method == "websocket" and \
                     "upgrade" in self.headers.get("Connection", "").lower():
                 self._do_websocket()
                 return
-            method = parsed.path.strip("/")
             if method == "":
                 # route listing (reference serves an HTML index)
                 self._reply(200, {"jsonrpc": "2.0", "id": -1,
-                                  "result": {"routes":
-                                             sorted(ROUTES.keys())}})
+                                  "result": {"routes": list(route_names)}})
                 return
             params = dict(parse_qsl(parsed.query))
             self._reply(200, self._call(method, params, -1))

@@ -188,6 +188,56 @@ class LightClientAttackEvidence:
         return LightClientAttackEvidence(cb, ch, byz, tvp, ts)
 
 
+def get_byzantine_validators(common_valset, trusted_signed_header,
+                             conflicting_block) -> list:
+    """Which validators provably misbehaved
+    (types/evidence.go LightClientAttackEvidence.GetByzantineValidators).
+
+    - Lunatic attack (conflicting header's valset differs from the
+      trusted one): every common-set validator that signed the
+      conflicting commit is byzantine.
+    - Equivocation (same valset, same round): validators that signed
+      BOTH commits for different blocks.
+    - Amnesia (same valset, different rounds): not attributable."""
+    from .block import BLOCK_ID_FLAG_COMMIT
+
+    conf_header = conflicting_block.signed_header.header
+    conf_commit = conflicting_block.signed_header.commit
+    trusted_header = trusted_signed_header.header
+    trusted_commit = trusted_signed_header.commit
+
+    # lunatic = ANY deterministically-derived header field forged
+    # (types/evidence.go ConflictingHeaderIsInvalid checks all of these)
+    lunatic = any(
+        getattr(conf_header, f) != getattr(trusted_header, f)
+        for f in ("validators_hash", "next_validators_hash",
+                  "consensus_hash", "app_hash", "last_results_hash"))
+
+    byzantine = []
+    if lunatic:
+        for sig in conf_commit.signatures:
+            if sig.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                continue
+            _, val = common_valset.get_by_address(sig.validator_address)
+            if val is not None:
+                byzantine.append(val)
+        return byzantine
+    if trusted_commit.round == conf_commit.round:
+        trusted_signers = {
+            s.validator_address for s in trusted_commit.signatures
+            if s.block_id_flag == BLOCK_ID_FLAG_COMMIT}
+        for sig in conf_commit.signatures:
+            if sig.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                continue
+            if sig.validator_address in trusted_signers:
+                _, val = conflicting_block.validator_set.get_by_address(
+                    sig.validator_address)
+                if val is not None:
+                    byzantine.append(val)
+        return byzantine
+    return []
+
+
 def evidence_to_proto_wrapped(ev) -> bytes:
     """Evidence oneof wrapper (evidence.proto:14-19)."""
     if isinstance(ev, DuplicateVoteEvidence):

@@ -1,0 +1,60 @@
+"""E2E runner over real node processes (reference test/e2e/runner/ +
+tests/).  Slow tier: a 3-validator + late full-node testnet with load,
+kill/pause perturbations, and block-identity + tx invariants.
+"""
+
+import shutil
+
+import pytest
+
+from cometbft_tpu.e2e import Manifest, Testnet
+
+MANIFEST = """
+load_tx_rate = 20
+run_blocks = 6
+
+[node.validator0]
+[node.validator1]
+[node.validator2]
+perturb = ["kill"]
+
+[node.full0]
+mode = "full"
+start_at = 3
+"""
+
+
+@pytest.mark.slow
+def test_e2e_testnet_with_perturbations(tmp_path):
+    manifest = Manifest.parse(MANIFEST)
+    net = Testnet(manifest, str(tmp_path / "net"), chain_id="e2e-run")
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(3, timeout=180)
+        txs = net.load(10)
+        assert len(txs) >= 5, "most load txs should submit"
+        # full0 starts once height 3 is seen; everyone reaches 6
+        net.wait_for_height(manifest.run_blocks, timeout=180,
+                            nodes=net.nodes)
+        # perturb: SIGKILL validator2, restart, then re-converge
+        net.run_perturbations()
+        tip = max(n.height() for n in net.nodes if n.running())
+        net.wait_for_height(tip + 2, timeout=180, nodes=net.nodes)
+        compared = net.check_block_identity()
+        assert compared >= manifest.run_blocks
+        assert net.check_txs_committed(txs) == len(txs)
+    finally:
+        net.stop()
+
+
+def test_manifest_parsing():
+    m = Manifest.parse(MANIFEST)
+    assert [n.name for n in m.nodes] == [
+        "validator0", "validator1", "validator2", "full0"]
+    assert m.nodes[3].mode == "full" and m.nodes[3].start_at == 3
+    assert m.nodes[2].perturb == ["kill"]
+    with pytest.raises(ValueError):
+        Manifest.parse("[node.x]\nmode = 'weird'")
+    with pytest.raises(ValueError):
+        Manifest.parse("")

@@ -6,6 +6,7 @@ from cometbft_tpu.light import verifier
 from cometbft_tpu.light.client import (
     Client, ErrLightClientAttack, SEQUENTIAL, SKIPPING, TrustOptions,
 )
+from cometbft_tpu.light.verifier import LightClientError
 from cometbft_tpu.light.provider import ErrLightBlockNotFound, MemoryProvider
 from cometbft_tpu.light.store import FileStore, MemoryStore
 from cometbft_tpu.light.types import LightBlock
@@ -180,8 +181,44 @@ def test_client_detects_witness_divergence(chain):
     for lb in fork2.blocks:
         w.add(lb)
     c = _client(chain, witnesses=[w])
-    with pytest.raises(ErrLightClientAttack):
+    with pytest.raises(ErrLightClientAttack) as exc:
         c.verify_light_block_at_height(12)
+    # detector.go parity: the evidence names the byzantine validators
+    # (lunatic fork: every common-set signer of the conflicting commit)
+    # and BOTH sides were sent the other's evidence
+    ev = exc.value.evidence
+    assert len(ev.byzantine_validators) == 4
+    assert ev.common_height >= 1
+    assert len(w.reported_evidence) == 1, \
+        "witness must receive evidence against the primary"
+    assert len(c.primary.reported_evidence) == 1, \
+        "primary must receive evidence against the witness"
+
+
+def test_faulty_witness_dropped_not_attack(chain):
+    """A witness that diverges but cannot back its header with a
+    verifiable chain is dropped (detector.go:121); verification
+    succeeds while other witnesses remain, and fails CLOSED when the
+    last witness is gone (reference ErrNoWitnesses)."""
+    garbage = ChainBuilder(privs=gen_privkeys(4, salt=77))  # unrelated keys
+    garbage.build(12)
+    faulty = MemoryProvider(CHAIN_ID)
+    for lb in garbage.blocks:
+        faulty.add(lb)
+    honest = _provider(chain)
+
+    c = _client(chain, witnesses=[faulty, honest])
+    lb = c.verify_light_block_at_height(12)
+    assert lb.height == 12
+    assert c.witnesses == [honest], "faulty witness must be dropped"
+
+    # last witness faulty -> no cross-checking possible -> fail closed
+    faulty2 = MemoryProvider(CHAIN_ID)
+    for lb in garbage.blocks:
+        faulty2.add(lb)
+    c2 = _client(chain, witnesses=[faulty2])
+    with pytest.raises(LightClientError):
+        c2.verify_light_block_at_height(12)
 
 
 def test_client_primary_failover(chain):
