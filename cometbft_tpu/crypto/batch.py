@@ -70,32 +70,98 @@ class TpuEd25519BatchVerifier:
         return len(self._pks)
 
     def verify(self) -> tuple[bool, list[bool]]:
-        import numpy as np
-        from ..ops import ed25519 as dev
-
         n = len(self._pks)
         if n == 0:
             return False, []
-        # parse + hash ONCE; both packings below build from this
+        # parse + hash ONCE; both device packings build from this
         parsed = ed.parse_and_hash(self._pks, self._msgs, self._sigs)
-        # Fast path: one shared RLC equation for the whole batch; on
-        # failure (or structural rejects) fall back to the per-signature
-        # kernel for verdict localization — the reference's
-        # verifyCommitBatch -> verifyCommitSingle pattern
-        # (/root/reference/types/validation.go:115).
-        if n >= 2:
-            packed = ed.pack_rlc(self._pks, self._msgs, self._sigs,
-                                 parsed=parsed)
-            if packed is not None and bool(
-                    np.asarray(dev.rlc_verify_device(*packed))):
-                return True, [True] * n
-        bucket = dev.bucket_size(n)
-        a, r, s, h, valid = ed.pack_batch(self._pks, self._msgs,
-                                          self._sigs, bucket, parsed=parsed)
-        verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
-        verdict = verdict & valid
-        out = verdict[:n].tolist()
-        return all(out) and bool(out), out
+        return _device_verify(self._pks, parsed)
+
+
+def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
+    """Shared device dispatch for any Edwards-domain batch: RLC fast
+    path first, per-signature kernel for verdict localization on
+    failure — the reference's verifyCommitBatch -> verifyCommitSingle
+    pattern (/root/reference/types/validation.go:115)."""
+    import numpy as np
+
+    from ..ops import ed25519 as dev
+
+    n = len(pubkeys)
+    if n >= 2:
+        packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n, parsed=parsed)
+        if packed is not None and bool(
+                np.asarray(dev.rlc_verify_device(*packed))):
+            return True, [True] * n
+    bucket = dev.bucket_size(n)
+    a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
+                                      bucket, parsed=parsed)
+    verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
+    verdict = verdict & valid
+    out = verdict[:n].tolist()
+    return all(out) and bool(out), out
+
+
+class CpuSr25519BatchVerifier:
+    """Host-side loop (parity oracle for the sr25519 device path)."""
+
+    def __init__(self):
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def add(self, pubkey, msg: bytes, sig: bytes) -> None:
+        pk = pubkey.bytes() if hasattr(pubkey, "bytes") else bytes(pubkey)
+        self._items.append((pk, msg, sig))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from . import sr25519 as sr
+        verdicts = []
+        for pk, m, s in self._items:
+            try:
+                verdicts.append(sr.PubKey(pk).verify_signature(m, s))
+            except ValueError:
+                verdicts.append(False)
+        return all(verdicts) and bool(verdicts), verdicts
+
+
+class TpuSr25519BatchVerifier:
+    """sr25519 batches on the ed25519 device kernels: ristretto points
+    re-encoded in Edwards form, Merlin challenges in place of the
+    SHA-512 challenge (see crypto/sr25519.to_edwards_inputs; the
+    reference's analog is sr25519.BatchVerifier in batch.go)."""
+
+    def __init__(self):
+        self._items: list[tuple[bytes, bytes, bytes]] = []
+
+    def add(self, pubkey, msg: bytes, sig: bytes) -> None:
+        pk = pubkey.bytes() if hasattr(pubkey, "bytes") else bytes(pubkey)
+        self._items.append((pk, msg, sig))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from . import sr25519 as sr
+
+        n = len(self._items)
+        if n == 0:
+            return False, []
+        # host: ristretto decode + transcript challenges; parsed feeds
+        # the SAME packers as ed25519 (ed_pub stands in for pubkeys[i],
+        # k for the hash h)
+        ed_pubs, parsed = [], []
+        for pk, m, s in self._items:
+            t = sr.to_edwards_inputs(pk, m, s)
+            if t is None:
+                ed_pubs.append(b"\x00" * 32)
+                parsed.append(None)
+            else:
+                a_ed, r_ed, s_int, k = t
+                ed_pubs.append(a_ed)
+                parsed.append((r_ed, s_int, k))
+        return _device_verify(ed_pubs, parsed)
 
 
 # device threshold: below this many signatures the host loop wins (the
@@ -103,7 +169,14 @@ class TpuEd25519BatchVerifier:
 # ours is higher because the device round-trip has fixed cost).
 DEVICE_THRESHOLD = int(os.environ.get("COMETBFT_TPU_BATCH_THRESHOLD", "8"))
 
-_SUPPORTED = {"ed25519"}
+# ed25519 & sr25519 support batching, like the reference
+# (crypto/batch/batch.go:12-35)
+_SUPPORTED = {"ed25519", "sr25519"}
+
+_CPU_BY_TYPE = {"ed25519": CpuEd25519BatchVerifier,
+                "sr25519": CpuSr25519BatchVerifier}
+_TPU_BY_TYPE = {"ed25519": TpuEd25519BatchVerifier,
+                "sr25519": TpuSr25519BatchVerifier}
 
 
 def supports_batch_verifier(key_type: str) -> bool:
@@ -113,16 +186,16 @@ def supports_batch_verifier(key_type: str) -> bool:
 def create_batch_verifier(key_type: str = "ed25519", n_hint: int = 0,
                           provider: str | None = None) -> BatchVerifier:
     provider = provider or os.environ.get("COMETBFT_TPU_PROVIDER", "auto")
-    if key_type != "ed25519":
+    if key_type not in _SUPPORTED:
         raise ValueError(f"no batch verifier for key type {key_type}")
     if provider == "cpu":
-        return CpuEd25519BatchVerifier()
+        return _CPU_BY_TYPE[key_type]()
     if provider == "tpu":
-        return TpuEd25519BatchVerifier()
+        return _TPU_BY_TYPE[key_type]()
     # auto: pick by expected batch size
     if n_hint and n_hint < DEVICE_THRESHOLD:
-        return CpuEd25519BatchVerifier()
-    return TpuEd25519BatchVerifier()
+        return _CPU_BY_TYPE[key_type]()
+    return _TPU_BY_TYPE[key_type]()
 
 
 class MixedBatchVerifier:

@@ -86,11 +86,12 @@ class TestnetNode:
 
     def start(self) -> None:
         env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-        log = open(self.log_path, "ab")
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "cometbft_tpu.cmd.main",
-             "--home", self.home, "start"],
-            env=env, stdout=log, stderr=log)
+        # the child duplicates the fd; close the parent's copy
+        with open(self.log_path, "ab") as log:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu.cmd.main",
+                 "--home", self.home, "start"],
+                env=env, stdout=log, stderr=log)
 
     def stop(self, sig=signal.SIGTERM, timeout: float = 20.0) -> None:
         if self.proc is None:
@@ -211,6 +212,10 @@ class Testnet:
     def load(self, n_txs: int) -> list[bytes]:
         txs = []
         live = [n for n in self.nodes if n.running()]
+        if not live:
+            raise E2EError(
+                "no live nodes to load against: "
+                + str([(n.name, n.running()) for n in self.nodes]))
         for i in range(n_txs):
             tx = b"e2e-%d=val-%d" % (i, i)
             node = live[i % len(live)]
@@ -233,6 +238,9 @@ class Testnet:
             node.stop(sig=signal.SIGTERM)
             node.start()
         elif kind in ("pause", "disconnect"):
+            if not node.running():
+                raise E2EError(
+                    f"cannot {kind} {node.name}: process not running")
             node.proc.send_signal(signal.SIGSTOP)
             time.sleep(3.0 if kind == "pause" else 8.0)
             node.proc.send_signal(signal.SIGCONT)

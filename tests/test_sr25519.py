@@ -1,0 +1,169 @@
+"""sr25519 (schnorrkel): keccak/STROBE/merlin stack, ristretto255 RFC
+vectors, sign/verify, and batches on the ed25519 device kernels
+(reference crypto/sr25519/).
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as edref
+from cometbft_tpu.crypto import ristretto as rst
+from cometbft_tpu.crypto import sr25519 as sr
+from cometbft_tpu.crypto import batch as cb
+from cometbft_tpu.crypto.strobe import Transcript, keccak_f1600
+
+rng = random.Random(4)
+
+
+class TestTranscriptStack:
+    def test_keccak_f1600_matches_sha3(self):
+        """Our permutation drives SHA3-256(b'') to hashlib's answer."""
+        state = bytearray(200)
+        state[0] ^= 0x06
+        state[135] ^= 0x80
+        lanes = [int.from_bytes(state[8 * i:8 * i + 8], "little")
+                 for i in range(25)]
+        keccak_f1600(lanes)
+        out = b"".join(l.to_bytes(8, "little") for l in lanes)[:32]
+        assert out == hashlib.sha3_256(b"").digest()
+
+    def test_merlin_equivalence_vector(self):
+        """merlin's transcript equivalence test (transcript.rs)."""
+        t = Transcript(b"test protocol")
+        t.append_message(b"some label", b"some data")
+        c = t.challenge_bytes(b"challenge", 32)
+        assert c.hex() == ("d5a21972d0d5fe320c0d263fac7fffb8"
+                           "145aa640af6e9bca177c03c7efcf0615")
+
+    def test_transcript_clone_independent(self):
+        t = Transcript(b"p")
+        t2 = t.clone()
+        t.append_message(b"a", b"x")
+        t2.append_message(b"a", b"y")
+        assert t.challenge_bytes(b"c", 16) != t2.challenge_bytes(b"c", 16)
+
+
+class TestRistretto:
+    # RFC 9496 §A.1 small multiples of the generator
+    SMALL = [
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    ]
+
+    def test_small_multiples(self):
+        assert rst.encode(rst.IDENTITY).hex() == self.SMALL[0]
+        assert rst.encode(rst.BASEPOINT).hex() == self.SMALL[1]
+        assert rst.encode(
+            edref.point_mul(2, rst.BASEPOINT)).hex() == self.SMALL[2]
+
+    def test_roundtrip_and_canonical(self):
+        for k in (3, 7, 99, 2**200 + 5, edref.L - 1):
+            p = edref.point_mul(k, rst.BASEPOINT)
+            enc = rst.encode(p)
+            p2 = rst.decode(enc)
+            assert p2 is not None and rst.eq(p, p2)
+            assert rst.encode(p2) == enc
+
+    def test_decode_rejects_bad(self):
+        assert rst.decode((rst.P + 2).to_bytes(32, "little")) is None
+        # odd ("negative") encodings are non-canonical
+        assert rst.decode((3).to_bytes(32, "little")) is None
+        assert rst.decode(b"\xff" * 32) is None
+
+
+def _batch(n, msg_len=60):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = sr.PrivKey.generate(rng.randbytes(32))
+        m = rng.randbytes(msg_len)
+        pks.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(priv.sign(m))
+    return pks, msgs, sigs
+
+
+class TestSr25519:
+    def test_sign_verify_roundtrip(self):
+        priv = sr.PrivKey.generate(b"\x01" * 32)
+        pub = priv.pub_key()
+        sig = priv.sign(b"hello")
+        assert len(sig) == 64 and sig[63] & 0x80
+        assert pub.verify_signature(b"hello", sig)
+        assert not pub.verify_signature(b"hullo", sig)
+        bad = bytearray(sig)
+        bad[3] ^= 1
+        assert not pub.verify_signature(b"hello", bytes(bad))
+        # another key rejects
+        other = sr.PrivKey.generate(b"\x02" * 32).pub_key()
+        assert not other.verify_signature(b"hello", sig)
+
+    def test_deterministic_and_distinct(self):
+        priv = sr.PrivKey.generate(b"\x03" * 32)
+        assert priv.sign(b"m") == priv.sign(b"m")
+        assert priv.sign(b"m") != priv.sign(b"n")
+
+    def test_marker_and_scalar_range_enforced(self):
+        priv = sr.PrivKey.generate(b"\x04" * 32)
+        pub = priv.pub_key()
+        sig = priv.sign(b"x")
+        no_marker = sig[:63] + bytes([sig[63] & 0x7F])
+        assert not pub.verify_signature(b"x", no_marker)
+        big_s = sig[:32] + (sr.L + 1).to_bytes(32, "little")
+        big_s = big_s[:63] + bytes([big_s[63] | 0x80])
+        assert not pub.verify_signature(b"x", big_s)
+
+    def test_batch_cpu_and_device_agree(self):
+        pks, msgs, sigs = _batch(6)
+        sigs[2] = sigs[2][:8] + bytes([sigs[2][8] ^ 1]) + sigs[2][9:]
+        expected = [True, True, False, True, True, True]
+
+        cpu = cb.create_batch_verifier("sr25519", provider="cpu")
+        tpu = cb.create_batch_verifier("sr25519", provider="tpu")
+        for pk, m, s in zip(pks, msgs, sigs):
+            cpu.add(pk, m, s)
+            tpu.add(pk, m, s)
+        assert cpu.verify()[1] == expected
+        ok, verdicts = tpu.verify()
+        assert verdicts == expected and not ok
+
+    def test_batch_all_good_rlc_path(self):
+        pks, msgs, sigs = _batch(5)
+        tpu = cb.create_batch_verifier("sr25519", provider="tpu")
+        for pk, m, s in zip(pks, msgs, sigs):
+            tpu.add(pk, m, s)
+        ok, verdicts = tpu.verify()
+        assert ok and verdicts == [True] * 5
+
+    def test_mixed_keytype_batch_on_device(self):
+        """ed25519 + sr25519 + secp256k1 in ONE MixedBatchVerifier —
+        the BASELINE 'mixed batches' target with two device-backed
+        key types."""
+        from cometbft_tpu.crypto import ed25519 as edk
+        from cometbft_tpu.crypto import secp256k1 as sk
+
+        mixed = cb.MixedBatchVerifier(provider="tpu")
+        expected = []
+        for i in range(4):
+            p = edk.PrivKey.generate(bytes([i + 1]) * 32)
+            m = b"ed-%d" % i
+            mixed.add(p.pub_key(), m, p.sign(m))
+            expected.append(True)
+        for i in range(4):
+            p = sr.PrivKey.generate(bytes([i + 33]) * 32)
+            m = b"sr-%d" % i
+            sig = p.sign(m)
+            if i == 2:
+                sig = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]
+            mixed.add(p.pub_key(), m, sig)
+            expected.append(i != 2)
+        p = sk.PrivKey.generate(b"\x09" * 32)
+        m = b"secp-0"
+        mixed.add(p.pub_key(), m, p.sign(m))
+        expected.append(True)
+
+        ok, verdicts = mixed.verify()
+        assert verdicts == expected
+        assert not ok
