@@ -46,6 +46,10 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
     pprof_laddr: str = ""
+    # privileged listener for the data-companion pruning service
+    # (reference: rpc/grpc/server privileged services, node.go:819-861;
+    # served here as JSON-RPC since the image carries no gRPC stack)
+    privileged_laddr: str = ""
 
 
 @dataclass

@@ -164,6 +164,9 @@ class Strobe128:
         return self._squeeze(n)
 
     def key(self, data: bytes, more: bool = False) -> None:
+        """Rekey (KEY op).  Unused by our transcript consumers (the
+        deterministic sr25519 witness uses clone+append instead of
+        merlin's TranscriptRng), kept for STROBE-op completeness."""
         self._begin_op(FLAG_A | FLAG_C, more)
         self._overwrite(data)
 

@@ -1,0 +1,135 @@
+"""Profiling/introspection HTTP server — the Python analog of the
+reference's pprof listener (node/node.go:889-902, config RPC
+pprof_laddr).
+
+Endpoints (GET):
+  /debug/pprof/           - index
+  /debug/pprof/goroutine  - live thread stack dump (goroutine analog)
+  /debug/pprof/heap       - gc + allocation counters, top object types
+  /debug/pprof/profile?seconds=N - statistical CPU profile (cProfile)
+  /debug/pprof/cmdline    - process command line
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+_ENDPOINTS = ("goroutine", "heap", "profile", "cmdline")
+
+
+def _dump_threads() -> str:
+    out = []
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        out.append(f"goroutine: {t.name} (ident={t.ident} "
+                   f"daemon={t.daemon} alive={t.is_alive()})")
+        frame = frames.get(t.ident)
+        if frame is not None:
+            out.extend("  " + ln.rstrip()
+                       for ln in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def _dump_heap() -> str:
+    from collections import Counter
+    counts = Counter(type(o).__name__ for o in gc.get_objects())
+    lines = [f"gc: counts={gc.get_count()} thresholds={gc.get_threshold()}",
+             f"tracked objects: {len(gc.get_objects())}", "", "top types:"]
+    for name, n in counts.most_common(30):
+        lines.append(f"  {n:>9}  {name}")
+    return "\n".join(lines)
+
+
+def _cpu_profile(seconds: float) -> str:
+    """Statistical whole-process profile: sample every thread's stack
+    via sys._current_frames() (a per-thread cProfile would only see the
+    handler thread sleeping)."""
+    import time
+    from collections import Counter
+
+    interval = 0.005
+    samples: Counter[tuple] = Counter()
+    own = threading.get_ident()
+    deadline = time.monotonic() + min(seconds, 30.0)
+    n = 0
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == own:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 12:
+                code = f.f_code
+                stack.append(f"{code.co_filename}:{f.f_lineno} "
+                             f"({code.co_name})")
+                f = f.f_back
+            if stack:
+                samples[tuple(stack[:3])] += 1
+        n += 1
+        time.sleep(interval)
+    lines = [f"samples: {n} over {seconds:g}s at {interval*1e3:g} ms", ""]
+    for stack, count in samples.most_common(40):
+        lines.append(f"{count:>6}  {stack[0]}")
+        for fr in stack[1:]:
+            lines.append(f"        <- {fr}")
+    return "\n".join(lines)
+
+
+class PprofServer:
+    def __init__(self, addr: str):
+        host, _, port = addr.replace("tcp://", "").rpartition(":")
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _text(self, body: str, status: int = 200) -> None:
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                params = dict(parse_qsl(parsed.query))
+                name = parsed.path.rstrip("/").rsplit("/", 1)[-1]
+                if parsed.path.rstrip("/").endswith("/debug/pprof") or \
+                        name == "pprof":
+                    self._text("profiles:\n" + "\n".join(
+                        f"  /debug/pprof/{e}" for e in _ENDPOINTS))
+                elif name == "goroutine":
+                    self._text(_dump_threads())
+                elif name == "heap":
+                    self._text(_dump_heap())
+                elif name == "profile":
+                    self._text(_cpu_profile(
+                        float(params.get("seconds", "5"))))
+                elif name == "cmdline":
+                    self._text("\x00".join(sys.argv))
+                else:
+                    self._text("unknown profile", 404)
+
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1",
+                                           int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.bound_addr = "%s:%d" % self._httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pprof-http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()

@@ -182,9 +182,11 @@ class Node(BaseService):
 
         # background pruner (node.go:1033 createPruner)
         from ..state.pruner import Pruner
-        self.pruner = Pruner(self.state_store, self.block_store,
-                             tx_indexer=self.tx_indexer,
-                             block_indexer=self.block_indexer)
+        self.pruner = Pruner(
+            self.state_store, self.block_store,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            data_companion_enabled=bool(config.rpc.privileged_laddr))
 
         # block executor
         self.block_exec = BlockExecutor(
@@ -296,6 +298,8 @@ class Node(BaseService):
             self.switch.add_reactor("PEX", self.pex_reactor)
 
         self.rpc_server = None
+        self.privileged_rpc_server = None
+        self.pprof_server = None
 
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
@@ -389,6 +393,10 @@ class Node(BaseService):
     def on_stop(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.privileged_rpc_server is not None:
+            self.privileged_rpc_server.stop()
+        if self.pprof_server is not None:
+            self.pprof_server.stop()
         self.switch.stop()
         self.wal.close()
         self.app_conns.stop()
@@ -417,10 +425,23 @@ class Node(BaseService):
             node_info=self.node_info,
             config=self.config,
             tx_indexer=self.tx_indexer,
-            block_indexer=self.block_indexer)
+            block_indexer=self.block_indexer,
+            pruner=self.pruner)
         addr = self.config.rpc.laddr.replace("tcp://", "")
         self.rpc_server = RPCServer(env, addr)
         self.rpc_server.start()
+        # privileged data-companion listener (pruning service)
+        if self.config.rpc.privileged_laddr:
+            from ..rpc.core import PRIVILEGED_ROUTES
+            self.privileged_rpc_server = RPCServer(
+                env, self.config.rpc.privileged_laddr.replace("tcp://", ""),
+                routes=PRIVILEGED_ROUTES, with_websocket=False)
+            self.privileged_rpc_server.start()
+        # pprof profiling listener (node.go:889-902)
+        if self.config.rpc.pprof_laddr:
+            from ..libs.pprof import PprofServer
+            self.pprof_server = PprofServer(self.config.rpc.pprof_laddr)
+            self.pprof_server.start()
 
     @property
     def rpc_addr(self) -> str | None:

@@ -39,6 +39,7 @@ class Environment:
     config: object = None
     tx_indexer: object = None
     block_indexer: object = None
+    pruner: object = None
     _subscribers: dict = field(default_factory=dict)
 
     # -- height helpers ----------------------------------------------------
@@ -498,6 +499,51 @@ class Environment:
         return {"hash": ser.hex_upper(ev_obj.hash())}
 
 
+    # -- privileged pruning service (data companion) -----------------------
+    # reference rpc/grpc/server/services/pruningservice; JSON-RPC here
+    def _require_pruner(self):
+        if self.pruner is None:
+            raise RPCError(-32603, "pruning service unavailable")
+        return self.pruner
+
+    def set_block_retain_height(self, height=None) -> dict:
+        p = self._require_pruner()
+        h = int(height or 0)
+        if h <= 0 or h > self._latest_height() + 1:
+            raise RPCError(
+                -32602, f"height must be in [1, chain height], got {h}")
+        if not p.set_companion_block_retain_height(h):
+            raise RPCError(
+                -32603, "cannot lower the companion retain height "
+                f"(currently {p.companion_block_retain_height()})")
+        return {}
+
+    def get_block_retain_height(self) -> dict:
+        p = self._require_pruner()
+        return {
+            "app_retain_height": str(p.application_block_retain_height()),
+            "pruning_service_retain_height":
+                str(p.companion_block_retain_height()),
+        }
+
+    def set_block_results_retain_height(self, height=None) -> dict:
+        p = self._require_pruner()
+        h = int(height or 0)
+        if h <= 0 or h > self._latest_height() + 1:
+            raise RPCError(
+                -32602, f"height must be in [1, chain height], got {h}")
+        if not p.set_abci_res_retain_height(h):
+            raise RPCError(
+                -32603, "cannot lower the block-results retain height "
+                f"(currently {p.abci_res_retain_height()})")
+        return {}
+
+    def get_block_results_retain_height(self) -> dict:
+        p = self._require_pruner()
+        return {"pruning_service_retain_height":
+                str(p.abci_res_retain_height())}
+
+
 # routes.go: method name -> handler attribute
 ROUTES = {
     "health": "health",
@@ -525,4 +571,12 @@ ROUTES = {
     "tx": "tx",
     "tx_search": "tx_search",
     "block_search": "block_search",
+}
+
+# privileged routes: served only on the separate privileged listener
+PRIVILEGED_ROUTES = {
+    "set_block_retain_height": "set_block_retain_height",
+    "get_block_retain_height": "get_block_retain_height",
+    "set_block_results_retain_height": "set_block_results_retain_height",
+    "get_block_results_retain_height": "get_block_results_retain_height",
 }

@@ -18,19 +18,22 @@ MAX_BODY_BYTES = 1_000_000
 
 
 class RPCServer:
-    def __init__(self, env: Environment, addr: str):
+    def __init__(self, env: Environment, addr: str, routes=None,
+                 with_websocket: bool = True):
+        routes = ROUTES if routes is None else routes
         host, _, port = addr.rpartition(":")
         self._env = env
 
         def dispatch(method: str, params: dict, req_id) -> dict:
-            attr = ROUTES.get(method)
+            attr = routes.get(method)
             if attr is None:
                 return _err(req_id, -32601, f"method {method} not found")
             return _call_target(getattr(env, attr), params, req_id)
 
         self._httpd = ThreadingHTTPServer(
             (host or "127.0.0.1", int(port)),
-            make_json_handler(dispatch, sorted(ROUTES), env=env))
+            make_json_handler(dispatch, sorted(routes),
+                              env=env if with_websocket else None))
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
         self.bound_addr = "%s:%d" % self._httpd.server_address

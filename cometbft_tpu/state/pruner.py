@@ -48,31 +48,38 @@ class Pruner(BaseService):
     def _set(self, key: bytes, h: int) -> None:
         self.state_store._db.set(key, struct.pack(">Q", h))
 
-    def set_application_block_retain_height(self, height: int) -> None:
+    def set_application_block_retain_height(self, height: int) -> bool:
         """pruner.go SetApplicationBlockRetainHeight: monotone, wakes
-        the loop."""
+        the loop.  Returns False when the height cannot be lowered
+        (pruner.go ErrPrunerCannotLowerRetainHeight)."""
         if height <= self._get(_K_APP_RETAIN):
-            return
+            return False
         self._set(_K_APP_RETAIN, height)
         self._wake.set()
+        return True
 
-    def set_companion_block_retain_height(self, height: int) -> None:
+    def set_companion_block_retain_height(self, height: int) -> bool:
         if height <= self._get(_K_COMPANION_RETAIN):
-            return
+            return False
         self._set(_K_COMPANION_RETAIN, height)
         self._wake.set()
+        return True
 
-    def set_abci_res_retain_height(self, height: int) -> None:
+    def set_abci_res_retain_height(self, height: int) -> bool:
         if height <= self._get(_K_ABCI_RES_RETAIN):
-            return
+            return False
         self._set(_K_ABCI_RES_RETAIN, height)
         self._wake.set()
+        return True
 
     def application_block_retain_height(self) -> int:
         return self._get(_K_APP_RETAIN)
 
     def companion_block_retain_height(self) -> int:
         return self._get(_K_COMPANION_RETAIN)
+
+    def abci_res_retain_height(self) -> int:
+        return self._get(_K_ABCI_RES_RETAIN)
 
     def target_retain_height(self) -> int:
         """Lower bound of the enabled retain heights

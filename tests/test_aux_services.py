@@ -1,0 +1,81 @@
+"""Aux observability/admin services: pprof listener + privileged
+pruning service (reference node/node.go:889 pprof, rpc/grpc/server
+privileged pruning service).
+"""
+
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.config import test_config as _tcfg
+from cometbft_tpu.node import Node, init_files
+
+from tests.test_consensus import wait_for_height
+from tests.test_node_rpc import rpc_get
+
+
+@pytest.fixture(scope="class")
+def aux_node(tmp_path_factory):
+    home = str(tmp_path_factory.mktemp("aux-home"))
+    cfg = _tcfg(home)
+    cfg.rpc.pprof_laddr = "127.0.0.1:0"
+    cfg.rpc.privileged_laddr = "127.0.0.1:0"
+    init_files(cfg, chain_id="aux-chain")
+    n = Node(cfg)
+    n.start()
+    assert wait_for_height(n.consensus_state, 6, timeout=60)
+    yield n
+    n.stop()
+
+
+class TestPprof:
+    def test_goroutine_dump(self, aux_node):
+        addr = aux_node.pprof_server.bound_addr
+        with urllib.request.urlopen(
+                f"http://{addr}/debug/pprof/goroutine", timeout=10) as r:
+            text = r.read().decode()
+        assert "cs-receive" in text        # the consensus event loop
+        assert "goroutine:" in text
+
+    def test_heap_and_index(self, aux_node):
+        addr = aux_node.pprof_server.bound_addr
+        with urllib.request.urlopen(
+                f"http://{addr}/debug/pprof/heap", timeout=10) as r:
+            assert "top types:" in r.read().decode()
+        with urllib.request.urlopen(
+                f"http://{addr}/debug/pprof/", timeout=10) as r:
+            assert "/debug/pprof/profile" in r.read().decode()
+
+
+class TestPrivilegedPruning:
+    def test_companion_retain_height_gates_pruning(self, aux_node):
+        n = aux_node
+        priv = n.privileged_rpc_server.bound_addr
+        pub = n.rpc_addr
+
+        # privileged routes are NOT on the public listener
+        got = rpc_get(pub, "get_block_retain_height")
+        assert got["error"]["code"] == -32601
+
+        # companion sets a retain height; app has not released anything
+        got = rpc_get(priv, "set_block_retain_height", height=4)
+        assert got["result"] == {}
+        got = rpc_get(priv, "get_block_retain_height")["result"]
+        assert got["pruning_service_retain_height"] == "4"
+        assert got["app_retain_height"] == "0"
+
+        # min-wins: app unset (0) blocks all pruning
+        base, pruned = n.pruner.prune_once()
+        assert pruned == 0 and n.block_store.base() == 1
+
+        # app releases too -> prune to min(app, companion)
+        n.pruner.set_application_block_retain_height(3)
+        base, pruned = n.pruner.prune_once()
+        assert base == 3 and n.block_store.base() == 3
+
+        # block-results retain height via the service
+        rpc_get(priv, "set_block_results_retain_height", height=2)
+        got = rpc_get(priv, "get_block_results_retain_height")["result"]
+        assert got["pruning_service_retain_height"] == "2"
+        n.pruner.prune_once()
+        assert n.state_store.load_finalize_block_response(1) is None

@@ -157,3 +157,62 @@ class TestEventBus:
         msg = sub.next(timeout=1)
         assert msg.events["tx.height"] == ["7"]
         assert len(msg.events["tx.hash"][0]) == 64
+
+
+class TestTmJson:
+    """Amino-compatible JSON registry (reference libs/json)."""
+
+    def test_key_roundtrip_all_types(self):
+        from cometbft_tpu.crypto import ed25519, secp256k1, sr25519
+        from cometbft_tpu.libs import tmjson
+
+        for mod, tag in ((ed25519, "tendermint/PubKeyEd25519"),
+                         (secp256k1, "tendermint/PubKeySecp256k1"),
+                         (sr25519, "tendermint/PubKeySr25519")):
+            priv = mod.PrivKey.generate(b"\x21" * 32)
+            text = tmjson.marshal(priv.pub_key())
+            import json as _json
+            assert _json.loads(text)["type"] == tag
+            back = tmjson.unmarshal(text)
+            assert back.bytes() == priv.pub_key().bytes()
+            assert type(back) is mod.PubKey
+            # private keys round-trip too
+            back_priv = tmjson.unmarshal(tmjson.marshal(priv))
+            assert back_priv.bytes() == priv.bytes()
+
+    def test_nested_structures_and_bytes(self):
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.libs import tmjson
+
+        pub = ed25519.PrivKey.generate(b"\x22" * 32).pub_key()
+        obj = {"vals": [pub, pub], "raw": b"\x01\x02", "n": 7}
+        back = tmjson.unmarshal(tmjson.marshal(obj))
+        assert back["n"] == 7
+        assert back["vals"][0].bytes() == pub.bytes()
+
+    def test_unknown_type_tag_left_as_dict(self):
+        from cometbft_tpu.libs import tmjson
+        obj = tmjson.unmarshal('{"type": "unknown/X", "value": "eA=="}')
+        assert obj == {"type": "unknown/X", "value": "eA=="}
+
+    def test_evidence_roundtrip(self):
+        from cometbft_tpu.libs import tmjson
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.timestamp import Timestamp
+        from cometbft_tpu.types.vote import PREVOTE_TYPE, Vote
+
+        def vote(h):
+            return Vote(type=PREVOTE_TYPE, height=5, round=0,
+                        block_id=BlockID(h, PartSetHeader(1, b"\x07" * 32)),
+                        timestamp=Timestamp.zero(),
+                        validator_address=b"\x03" * 20, validator_index=1,
+                        signature=b"\x09" * 64)
+
+        ev = DuplicateVoteEvidence(
+            vote_a=vote(b"\x01" * 32), vote_b=vote(b"\x02" * 32),
+            total_voting_power=30, validator_power=10,
+            timestamp=Timestamp.zero())
+        back = tmjson.unmarshal(tmjson.marshal(ev))
+        assert isinstance(back, DuplicateVoteEvidence)
+        assert back.vote_a.block_id.hash == b"\x01" * 32
