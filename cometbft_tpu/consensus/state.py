@@ -1098,9 +1098,14 @@ class ConsensusState(BaseService):
             return
         try:
             vote = self._sign_vote(msg_type, hash_, header, block)
-        except Exception:
-            if self.replay_mode:
-                raise
+        except Exception as e:
+            # NEVER fatal (reference state.go signAddVote logs and
+            # returns).  During WAL catchup the FilePV rightly refuses
+            # to re-sign steps it already signed — the pre-crash vote's
+            # effect is replayed from the WAL's own VoteMessage.
+            _log.log(logging.DEBUG if self.replay_mode else logging.ERROR,
+                     "failed signing vote at %d/%d: %s",
+                     self.height, self.round, e)
             return
         if vote is not None:
             self.send_internal_message(msgs.VoteMessage(vote))

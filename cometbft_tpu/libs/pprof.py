@@ -46,7 +46,7 @@ def _dump_heap() -> str:
     return "\n".join(lines)
 
 
-def _cpu_profile(seconds: float) -> str:
+def _cpu_profile(seconds: float) -> str:  # noqa: C901
     """Statistical whole-process profile: sample every thread's stack
     via sys._current_frames() (a per-thread cProfile would only see the
     handler thread sleeping)."""
@@ -54,9 +54,10 @@ def _cpu_profile(seconds: float) -> str:
     from collections import Counter
 
     interval = 0.005
+    seconds = min(seconds, 30.0)         # hard cap, reported honestly
     samples: Counter[tuple] = Counter()
     own = threading.get_ident()
-    deadline = time.monotonic() + min(seconds, 30.0)
+    deadline = time.monotonic() + seconds
     n = 0
     while time.monotonic() < deadline:
         for ident, frame in sys._current_frames().items():
@@ -110,8 +111,12 @@ class PprofServer:
                 elif name == "heap":
                     self._text(_dump_heap())
                 elif name == "profile":
-                    self._text(_cpu_profile(
-                        float(params.get("seconds", "5"))))
+                    try:
+                        secs = float(params.get("seconds", "5"))
+                    except ValueError:
+                        self._text("seconds must be a number", 400)
+                        return
+                    self._text(_cpu_profile(secs))
                 elif name == "cmdline":
                     self._text("\x00".join(sys.argv))
                 else:

@@ -324,8 +324,7 @@ class Node(BaseService):
         if self.metrics_server is not None:
             self.metrics_server.start()
         self.switch.start()
-        if self.config.rpc.laddr:
-            self._start_rpc()
+        self._start_rpc()
         peers = [a.strip()
                  for a in self.config.p2p.persistent_peers.split(",")
                  if a.strip()]
@@ -410,6 +409,8 @@ class Node(BaseService):
         self.event_bus.stop()
 
     def _start_rpc(self) -> None:
+        """Public, privileged, and pprof listeners start independently
+        (node.go:819-902: each has its own gate)."""
         from ..rpc.server import RPCServer
         from ..rpc.core import Environment
         env = Environment(
@@ -427,9 +428,10 @@ class Node(BaseService):
             tx_indexer=self.tx_indexer,
             block_indexer=self.block_indexer,
             pruner=self.pruner)
-        addr = self.config.rpc.laddr.replace("tcp://", "")
-        self.rpc_server = RPCServer(env, addr)
-        self.rpc_server.start()
+        if self.config.rpc.laddr:
+            addr = self.config.rpc.laddr.replace("tcp://", "")
+            self.rpc_server = RPCServer(env, addr)
+            self.rpc_server.start()
         # privileged data-companion listener (pruning service)
         if self.config.rpc.privileged_laddr:
             from ..rpc.core import PRIVILEGED_ROUTES

@@ -148,12 +148,11 @@ class FilePVKey:
     def save(self) -> None:
         if not self.file_path:
             return
+        from ..libs import tmjson
         payload = json.dumps({
             "address": self.address.hex().upper(),
-            "pub_key": {"type": "tendermint/PubKeyEd25519",
-                        "value": _b64(self.pub_key.bytes())},
-            "priv_key": {"type": "tendermint/PrivKeyEd25519",
-                         "value": _b64(self.priv_key.bytes())},
+            "pub_key": tmjson.to_obj(self.pub_key),
+            "priv_key": tmjson.to_obj(self.priv_key),
         }, indent=2).encode()
         _write_file_atomic(self.file_path, payload)
 

@@ -1,0 +1,286 @@
+"""General-purpose RPC clients (reference rpc/client/: http, local).
+
+HTTPClient speaks JSON-RPC over HTTP POST with typed convenience
+methods for every route, plus WebSocket event subscriptions
+(rpc/client/http WSEvents).  LocalClient calls an Environment
+in-process (rpc/client/local) — the backing for tools and tests that
+run inside the node.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import threading
+import urllib.request
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(f"RPC error {code}: {message}")
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class HTTPClient:
+    """rpc/client/http Client."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        if "://" not in base_url:
+            base_url = "http://" + base_url
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+        self._ids = itertools.count(1)
+
+    # -- transport ---------------------------------------------------------
+
+    def call(self, method: str, **params):
+        payload = json.dumps({
+            "jsonrpc": "2.0", "id": next(self._ids),
+            "method": method, "params": params}).encode()
+        req = urllib.request.Request(
+            self._base + "/", data=payload,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            body = json.loads(resp.read())
+        if body.get("error"):
+            e = body["error"]
+            raise RPCClientError(e.get("code", -1),
+                                 e.get("message", ""), e.get("data", ""))
+        return body["result"]
+
+    # -- info --------------------------------------------------------------
+
+    def status(self):
+        return self.call("status")
+
+    def health(self):
+        return self.call("health")
+
+    def net_info(self):
+        return self.call("net_info")
+
+    def genesis(self):
+        return self.call("genesis")
+
+    def genesis_chunked(self, chunk: int = 0):
+        return self.call("genesis_chunked", chunk=chunk)
+
+    # -- blocks ------------------------------------------------------------
+
+    def block(self, height: int | None = None):
+        return self.call("block", **({} if height is None
+                                     else {"height": height}))
+
+    def block_by_hash(self, block_hash: bytes):
+        return self.call(
+            "block_by_hash",
+            hash=base64.b64encode(block_hash).decode())
+
+    def block_results(self, height: int | None = None):
+        return self.call("block_results", **({} if height is None
+                                             else {"height": height}))
+
+    def header(self, height: int | None = None):
+        return self.call("header", **({} if height is None
+                                      else {"height": height}))
+
+    def header_by_hash(self, block_hash: bytes):
+        return self.call("header_by_hash", hash=block_hash.hex())
+
+    def commit(self, height: int | None = None):
+        return self.call("commit", **({} if height is None
+                                      else {"height": height}))
+
+    def blockchain(self, min_height: int, max_height: int):
+        return self.call("blockchain", minHeight=min_height,
+                         maxHeight=max_height)
+
+    def validators(self, height: int | None = None, page: int = 1,
+                   per_page: int = 30):
+        params = {"page": page, "per_page": per_page}
+        if height is not None:
+            params["height"] = height
+        return self.call("validators", **params)
+
+    def consensus_params(self, height: int | None = None):
+        return self.call("consensus_params",
+                         **({} if height is None else {"height": height}))
+
+    # -- txs ---------------------------------------------------------------
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync",
+                         tx=base64.b64encode(tx).decode())
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self.call("broadcast_tx_async",
+                         tx=base64.b64encode(tx).decode())
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self.call("broadcast_tx_commit",
+                         tx=base64.b64encode(tx).decode())
+
+    def check_tx(self, tx: bytes):
+        return self.call("check_tx", tx=base64.b64encode(tx).decode())
+
+    def tx(self, tx_hash: bytes, prove: bool = False):
+        return self.call("tx", hash=tx_hash.hex(), prove=prove)
+
+    def tx_search(self, query: str, prove: bool = False, page: int = 1,
+                  per_page: int = 30, order_by: str = "asc"):
+        return self.call("tx_search", query=query, prove=prove,
+                         page=page, per_page=per_page, order_by=order_by)
+
+    def block_search(self, query: str, page: int = 1, per_page: int = 30,
+                     order_by: str = "asc"):
+        return self.call("block_search", query=query, page=page,
+                         per_page=per_page, order_by=order_by)
+
+    def unconfirmed_txs(self, limit: int | None = None):
+        return self.call("unconfirmed_txs",
+                         **({} if limit is None else {"limit": limit}))
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def abci_query(self, path: str, data: bytes, height: int = 0,
+                   prove: bool = False):
+        return self.call("abci_query", path=path, data=data.hex(),
+                         height=height, prove=prove)
+
+    def broadcast_evidence(self, ev) -> dict:
+        from ..types.evidence import evidence_to_proto_wrapped
+        return self.call(
+            "broadcast_evidence",
+            evidence=base64.b64encode(
+                evidence_to_proto_wrapped(ev)).decode())
+
+    # -- subscriptions (rpc/client/http WSEvents) --------------------------
+
+    def subscribe(self, query: str, callback, capacity: int = 64):
+        """Open a WebSocket, subscribe, and invoke callback(result) per
+        event from a background thread.  Returns an unsubscribe fn."""
+        import os
+        import socket
+        import struct
+        from hashlib import sha1
+
+        host = self._base.split("://", 1)[1]
+        hostname, _, port = host.rpartition(":")
+        sock = socket.create_connection((hostname, int(port)),
+                                        timeout=self._timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        sock.sendall((f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                      "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                      f"Sec-WebSocket-Key: {key}\r\n"
+                      "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise RPCClientError(-1, "websocket handshake failed")
+            resp += chunk
+        if b"101" not in resp.split(b"\r\n", 1)[0]:
+            raise RPCClientError(-1, "websocket upgrade refused")
+
+        def send_json(obj):
+            p = json.dumps(obj).encode()
+            mask = os.urandom(4)
+            if len(p) < 126:
+                head = bytes([0x81, 0x80 | len(p)])
+            elif len(p) < (1 << 16):
+                head = bytes([0x81, 0x80 | 126]) + struct.pack(
+                    ">H", len(p))
+            else:
+                head = bytes([0x81, 0x80 | 127]) + struct.pack(
+                    ">Q", len(p))
+            sock.sendall(head + mask + bytes(
+                b ^ mask[i % 4] for i, b in enumerate(p)))
+
+        buf = bytearray()
+
+        def read_exact(n):
+            while len(buf) < n:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    raise ConnectionError("ws closed")
+                buf.extend(chunk)
+            out = bytes(buf[:n])
+            del buf[:n]
+            return out
+
+        def recv_json():
+            while True:
+                head = read_exact(2)
+                n = head[1] & 0x7F
+                if n == 126:
+                    n = struct.unpack(">H", read_exact(2))[0]
+                elif n == 127:
+                    n = struct.unpack(">Q", read_exact(8))[0]
+                payload = read_exact(n)
+                if head[0] & 0x0F == 0x1:
+                    return json.loads(payload)
+
+        sub_id = next(self._ids)
+        send_json({"jsonrpc": "2.0", "id": sub_id, "method": "subscribe",
+                   "params": {"query": query}})
+        ack = recv_json()
+        if ack.get("error"):
+            sock.close()
+            e = ack["error"]
+            raise RPCClientError(e.get("code", -1), e.get("message", ""))
+
+        stop = threading.Event()
+
+        def pump():
+            try:
+                while not stop.is_set():
+                    msg = recv_json()
+                    if msg.get("id") == sub_id and "result" in msg and \
+                            msg["result"]:
+                        callback(msg["result"])
+            except (ConnectionError, OSError):
+                pass
+
+        t = threading.Thread(target=pump, name="rpc-ws-events",
+                             daemon=True)
+        t.start()
+
+        def unsubscribe():
+            stop.set()
+            try:
+                send_json({"jsonrpc": "2.0", "id": next(self._ids),
+                           "method": "unsubscribe",
+                           "params": {"query": query}})
+            except OSError:
+                pass
+            sock.close()
+
+        return unsubscribe
+
+
+class LocalClient:
+    """rpc/client/local: calls into an Environment in-process."""
+
+    def __init__(self, env):
+        from .core import ROUTES
+        self._env = env
+        self._routes = ROUTES
+
+    def call(self, method: str, **params):
+        from .core import RPCError
+        attr = self._routes.get(method)
+        if attr is None:
+            raise RPCClientError(-32601, f"method {method} not found")
+        try:
+            return getattr(self._env, attr)(**params)
+        except RPCError as e:
+            raise RPCClientError(e.code, e.message, e.data) from e
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)

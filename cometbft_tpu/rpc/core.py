@@ -474,6 +474,42 @@ class Environment:
                                "block": ser.block_json(block)})
         return {"blocks": blocks, "total_count": str(len(heights))}
 
+    def check_tx(self, tx=None) -> dict:
+        """rpc/core/mempool.go CheckTx: run the app's CheckTx WITHOUT
+        adding to the mempool."""
+        raw = self._decode_tx_param(tx)
+        res = self.app_conns.mempool.check_tx(
+            at.CheckTxRequest(tx=raw, type=at.CHECK_TX_TYPE_CHECK))
+        return {"code": res.code, "data": ser.b64(res.data)
+                if res.data else None, "log": res.log,
+                "codespace": res.codespace,
+                "gas_wanted": str(res.gas_wanted),
+                "gas_used": str(res.gas_used)}
+
+    def genesis_chunked(self, chunk=None) -> dict:
+        """rpc/core/env.go InitGenesisChunks: the genesis doc JSON
+        itself (no result envelope) in 16MB chunks, computed once."""
+        chunks = getattr(self, "_gen_chunks", None)
+        if chunks is None:
+            data = self.genesis.to_json().encode()
+            size = 16 * 1024 * 1024
+            chunks = [data[i:i + size]
+                      for i in range(0, len(data), size)] or [b""]
+            self._gen_chunks = chunks
+        idx = int(chunk or 0)
+        if not 0 <= idx < len(chunks):
+            raise RPCError(
+                -32603, f"chunk {idx} out of range [0, {len(chunks)})")
+        return {"chunk": str(idx), "total": str(len(chunks)),
+                "data": ser.b64(chunks[idx])}
+
+    def header_by_hash(self, hash=None) -> dict:  # noqa: A002
+        raw = self._decode_hash_param(hash)
+        meta = self.block_store.load_block_meta_by_hash(raw)
+        if meta is None:
+            return {"header": None}
+        return {"header": ser.header_json(meta.header)}
+
     def unconfirmed_txs(self, limit=None) -> dict:
         txs = self.mempool.reap_max_txs(int(limit) if limit else 30)
         return {
@@ -571,6 +607,9 @@ ROUTES = {
     "tx": "tx",
     "tx_search": "tx_search",
     "block_search": "block_search",
+    "check_tx": "check_tx",
+    "genesis_chunked": "genesis_chunked",
+    "header_by_hash": "header_by_hash",
 }
 
 # privileged routes: served only on the separate privileged listener

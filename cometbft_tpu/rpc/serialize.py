@@ -12,8 +12,9 @@ from ..types.block import BlockIDFlag
 
 _FLAG_NAMES = {1: "BLOCK_ID_FLAG_ABSENT", 2: "BLOCK_ID_FLAG_COMMIT",
                3: "BLOCK_ID_FLAG_NIL"}
-_KEY_TYPE_NAMES = {"ed25519": "tendermint/PubKeyEd25519",
-                   "secp256k1": "tendermint/PubKeySecp256k1"}
+def _key_type_name(pubkey) -> str:
+    from ..libs import tmjson
+    return tmjson.name_of(pubkey) or "tendermint/PubKeyEd25519"
 
 
 def b64(b: bytes) -> str:
@@ -100,8 +101,7 @@ def validator_json(v) -> dict:
     return {
         "address": hex_upper(v.address),
         "pub_key": {
-            "type": _KEY_TYPE_NAMES.get(v.pub_key.type(),
-                                        v.pub_key.type()),
+            "type": _key_type_name(v.pub_key),
             "value": b64(v.pub_key.bytes()),
         },
         "voting_power": str(v.voting_power),

@@ -52,22 +52,31 @@ class Pruner(BaseService):
         """pruner.go SetApplicationBlockRetainHeight: monotone, wakes
         the loop.  Returns False when the height cannot be lowered
         (pruner.go ErrPrunerCannotLowerRetainHeight)."""
-        if height <= self._get(_K_APP_RETAIN):
+        current = self._get(_K_APP_RETAIN)
+        if height < current:
             return False
+        if height == current:
+            return True          # idempotent re-set (pruner.go semantics)
         self._set(_K_APP_RETAIN, height)
         self._wake.set()
         return True
 
     def set_companion_block_retain_height(self, height: int) -> bool:
-        if height <= self._get(_K_COMPANION_RETAIN):
+        current = self._get(_K_COMPANION_RETAIN)
+        if height < current:
             return False
+        if height == current:
+            return True
         self._set(_K_COMPANION_RETAIN, height)
         self._wake.set()
         return True
 
     def set_abci_res_retain_height(self, height: int) -> bool:
-        if height <= self._get(_K_ABCI_RES_RETAIN):
+        current = self._get(_K_ABCI_RES_RETAIN)
+        if height < current:
             return False
+        if height == current:
+            return True
         self._set(_K_ABCI_RES_RETAIN, height)
         self._wake.set()
         return True

@@ -20,25 +20,23 @@ from .validator_set import Validator
 
 MAX_CHAIN_ID_LEN = 50  # types/genesis.go MaxChainIDLen
 
-_AMINO_BY_TYPE = {
-    "ed25519": "tendermint/PubKeyEd25519",
-    "secp256k1": "tendermint/PubKeySecp256k1",
-    "bls12381": "cometbft/PubKeyBls12_381",
-}
-_TYPE_BY_AMINO = {v: k for k, v in _AMINO_BY_TYPE.items()}
-
-
 def pubkey_to_json(pubkey) -> dict:
-    return {"type": _AMINO_BY_TYPE[pubkey.type()],
-            "value": base64.b64encode(pubkey.bytes()).decode()}
+    """Amino envelope via the libs/tmjson registry (single source of
+    the type-tag truth)."""
+    from ..libs import tmjson
+    obj = tmjson.to_obj(pubkey)
+    if not isinstance(obj, dict) or "type" not in obj:
+        raise ValueError(
+            f"pubkey type {type(pubkey).__name__} not registered")
+    return obj
 
 
 def pubkey_from_json(obj: dict):
-    from ..crypto.encoding import make_pubkey
-    key_type = _TYPE_BY_AMINO.get(obj["type"])
-    if key_type is None:
-        raise ValueError(f"unknown pubkey json type {obj['type']!r}")
-    return make_pubkey(key_type, base64.b64decode(obj["value"]))
+    from ..libs import tmjson
+    out = tmjson.from_obj(obj)
+    if isinstance(out, dict):
+        raise ValueError(f"unknown pubkey json type {obj.get('type')!r}")
+    return out
 
 
 @dataclass

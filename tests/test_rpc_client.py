@@ -1,0 +1,105 @@
+"""General-purpose RPC clients (reference rpc/client/http,
+rpc/client/local) + the remaining reference routes (check_tx,
+genesis_chunked, header_by_hash).
+"""
+
+import base64
+import json
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.rpc.client import HTTPClient, LocalClient, RPCClientError
+from cometbft_tpu.types.block import tx_hash
+
+from tests.test_node_rpc import node  # noqa: F401
+from tests.test_consensus import wait_for_height
+
+
+class TestHTTPClient:
+    def test_info_and_blocks(self, node):  # noqa: F811
+        c = HTTPClient(node.rpc_addr)
+        st = c.status()
+        h = int(st["sync_info"]["latest_block_height"])
+        assert h >= 2
+        assert c.health() == {}
+        blk = c.block(2)
+        assert int(blk["block"]["header"]["height"]) == 2
+        # by-hash forms
+        bh = bytes.fromhex(blk["block_id"]["hash"])
+        assert int(c.block_by_hash(bh)["block"]["header"]["height"]) == 2
+        assert c.header_by_hash(bh)["header"]["height"] == "2"
+        assert int(c.commit(2)["signed_header"]["header"]["height"]) == 2
+        vals = c.validators(2)
+        assert int(vals["total"]) == 1
+        chain = c.blockchain(1, 3)
+        assert len(chain["block_metas"]) == 3
+
+    def test_genesis_chunked_reassembles(self, node):  # noqa: F811
+        c = HTTPClient(node.rpc_addr)
+        first = c.genesis_chunked(0)
+        total = int(first["total"])
+        data = b"".join(
+            base64.b64decode(c.genesis_chunked(i)["data"])
+            for i in range(total))
+        # chunks reassemble to the genesis DOC itself (reference
+        # InitGenesisChunks chunked cmtjson.Marshal(genDoc))
+        doc = json.loads(data)
+        assert doc["chain_id"] == c.genesis()["genesis"]["chain_id"]
+
+    def test_tx_lifecycle(self, node):  # noqa: F811
+        c = HTTPClient(node.rpc_addr, timeout=30)
+        tx = b"client-k=client-v"
+        # check_tx does NOT add to the mempool
+        res = c.check_tx(tx)
+        assert res["code"] == 0
+        res = c.broadcast_tx_commit(tx)
+        height = int(res["height"])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                got = c.tx(tx_hash(tx))
+                break
+            except RPCClientError:
+                time.sleep(0.1)
+        else:
+            pytest.fail("tx never indexed")
+        assert int(got["height"]) == height
+        found = c.tx_search(f"tx.height = {height}")
+        assert int(found["total_count"]) >= 1
+
+    def test_subscription(self, node):  # noqa: F811
+        c = HTTPClient(node.rpc_addr)
+        got = []
+        done = threading.Event()
+
+        def on_event(result):
+            got.append(result)
+            done.set()
+
+        unsub = c.subscribe("tm.event = 'Tx'", on_event)
+        try:
+            c.broadcast_tx_sync(b"sub-k=sub-v")
+            assert done.wait(timeout=15), "no event arrived"
+            assert got[0]["data"]["type"] == "tendermint/event/Tx"
+        finally:
+            unsub()
+
+    def test_error_mapping(self, node):  # noqa: F811
+        c = HTTPClient(node.rpc_addr)
+        with pytest.raises(RPCClientError) as e:
+            c.call("nonexistent_method")
+        assert e.value.code == -32601
+
+
+class TestLocalClient:
+    def test_local_calls_env(self, node):  # noqa: F811
+        env = node.rpc_server._env
+        c = LocalClient(env)
+        st = c.status()
+        assert int(st["sync_info"]["latest_block_height"]) >= 1
+        with pytest.raises(RPCClientError):
+            c.call("nope")
+        with pytest.raises(RPCClientError):
+            c.block(height=10**9)
