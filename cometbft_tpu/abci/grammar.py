@@ -1,0 +1,117 @@
+"""ABCI++ call-sequence grammar checker
+(reference test/e2e/pkg/grammar/checker.go + abci_grammar.md, itself
+derived from spec/abci/abci++_comet_expected_behavior.md).
+
+Verifies that the sequence of ABCI calls an application observed is a
+legal interleaving:
+
+    start            = clean-start / recovery
+    clean-start      = ( init-chain / state-sync ) consensus-exec
+    state-sync       = *attempt success      (attempt = offer *chunk,
+                                              success = offer 1*chunk)
+    recovery         = [init-chain] consensus-exec
+    consensus-height = *consensus-round finalize-block commit
+    round            = *got-vote [prepare [process] / process] [extend]
+    extend           = *got-vote extend-vote *got-vote
+
+Info is ignored (RPC can trigger it anywhere), like the reference.
+The reference generates a GLL parser with gogll; the grammar is
+regular, so this implementation compiles it to one anchored regex over
+a token alphabet and reports the first offending call on mismatch.
+"""
+
+from __future__ import annotations
+
+import re
+
+# one letter per terminal
+TOKENS = {
+    "init_chain": "i",
+    "offer_snapshot": "o",
+    "apply_snapshot_chunk": "a",
+    "prepare_proposal": "p",
+    "process_proposal": "P",
+    "extend_vote": "e",
+    "verify_vote_extension": "v",
+    "finalize_block": "f",
+    "commit": "c",
+}
+_IGNORED = {"info", "query", "check_tx", "echo", "flush"}
+
+# round = *got-vote [prepare [process] / process] [extend]; must not be
+# empty (an empty round matches nothing, which the repetition handles)
+_ROUND = r"(?:v*(?:pP?|P)?(?:v*ev*)?)"
+_HEIGHT = rf"(?:{_ROUND}*fc)"
+# a run may stop mid-height (node killed): allow a trailing partial —
+# rounds then at most a finalize (a commit would complete the height)
+_PARTIAL = rf"(?:{_ROUND}*f?)"
+_CONSENSUS = rf"{_HEIGHT}*{_PARTIAL}"
+_STATESYNC = r"(?:oa*)*oa+"
+
+_CLEAN_START = re.compile(rf"(?:i|{_STATESYNC}){_CONSENSUS}$")
+_RECOVERY = re.compile(rf"i?{_CONSENSUS}$")
+
+
+class GrammarError(Exception):
+    def __init__(self, message: str, index: int, call: str):
+        super().__init__(f"{message} (call #{index}: {call})")
+        self.index = index
+        self.call = call
+
+
+def tokenize(calls: list[str]) -> str:
+    out = []
+    for idx, name in enumerate(calls):
+        name = name.lower()
+        if name in _IGNORED:
+            continue
+        tok = TOKENS.get(name)
+        if tok is None:
+            raise GrammarError("unknown ABCI call", idx, name)
+        out.append(tok)
+    return "".join(out)
+
+
+def verify(calls: list[str], clean_start: bool) -> None:
+    """Raise GrammarError (with the first offending call) if the call
+    sequence violates the ABCI++ grammar (checker.go Verify)."""
+    import regex as _regex   # partial matching = true prefix viability
+
+    tokens = tokenize(calls)
+    pattern = _CLEAN_START if clean_start else _RECOVERY
+    if pattern.match(tokens):
+        return
+    # first index whose prefix can no longer be extended to a match
+    # (regex partial=True asks exactly "is this a viable prefix?")
+    viable = _regex.compile(pattern.pattern)
+    meaningful = [(idx, name) for idx, name in enumerate(calls)
+                  if name.lower() not in _IGNORED]
+    for n in range(1, len(tokens) + 1):
+        if not viable.fullmatch(tokens[:n], partial=True):
+            idx, name = meaningful[n - 1]
+            raise GrammarError("illegal ABCI call sequence", idx, name)
+    idx, name = meaningful[-1] if meaningful else (0, "<empty>")
+    raise GrammarError("incomplete ABCI call sequence", idx, name)
+
+
+class RecordingApp:
+    """Wraps an Application and records the call sequence for grammar
+    verification (the reference e2e app writes the same log)."""
+
+    def __init__(self, app):
+        self._app = app
+        self.calls: list[str] = []
+
+    def __getattr__(self, name):
+        fn = getattr(self._app, name)
+        if not callable(fn) or name.startswith("_"):
+            return fn
+
+        def wrapper(*args, **kwargs):
+            self.calls.append(name)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    def verify(self, clean_start: bool) -> None:
+        verify(self.calls, clean_start)

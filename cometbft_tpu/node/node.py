@@ -119,6 +119,7 @@ class Node(BaseService):
         # tx/block event indexers (node.go createAndStartIndexerService)
         self.tx_indexer = None
         self.block_indexer = None
+        self.event_sink = None
         self.indexer_service = None
         if config.tx_index.indexer == "kv":
             from ..state.indexer import (BlockIndexer, IndexerService,
@@ -129,6 +130,16 @@ class Node(BaseService):
                 open_db(backend, os.path.join(db_dir, "block_index.db")))
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus)
+        elif config.tx_index.indexer == "psql":
+            # relational sink (reference psql sink; SQLite here) —
+            # external consumers query the schema, /tx_search is off
+            from ..state.indexer import IndexerService
+            from ..state.sink import SQLEventSink
+            self.event_sink = SQLEventSink(
+                os.path.join(db_dir, "event_sink.db"),
+                self.genesis.chain_id)
+            self.indexer_service = IndexerService(
+                None, None, self.event_bus, event_sink=self.event_sink)
 
         # privval: remote signer when priv_validator_laddr is set
         # (node.go:347-353 createAndStartPrivValidatorSocketClient),
@@ -402,6 +413,8 @@ class Node(BaseService):
         self.pruner.stop()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if self.event_sink is not None:
+            self.event_sink.close()
         if self.signer_endpoint is not None:
             self.signer_endpoint.close()
         if self.metrics_server is not None:

@@ -186,11 +186,13 @@ class IndexerService(BaseService):
     """Subscribes to the event bus and feeds both indexers
     (state/txindex/indexer_service.go)."""
 
-    def __init__(self, tx_indexer: TxIndexer, block_indexer: BlockIndexer,
-                 event_bus):
+    def __init__(self, tx_indexer: TxIndexer | None,
+                 block_indexer: BlockIndexer | None, event_bus,
+                 event_sink=None):
         super().__init__("IndexerService")
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
+        self.event_sink = event_sink
         self.event_bus = event_bus
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -218,14 +220,26 @@ class IndexerService(BaseService):
 
     def _index_tx_msg(self, msg) -> None:
         data = msg.data
-        self.tx_indexer.index(data.height, data.index, data.tx,
-                              data.result, msg.events)
+        if self.tx_indexer is not None:
+            self.tx_indexer.index(data.height, data.index, data.tx,
+                                  data.result, msg.events)
+        if self.event_sink is not None:
+            self.event_sink.index_tx_events(
+                data.height, data.index, data.tx, data.result,
+                getattr(data.result, "events", None))
+
+    def _index_block_msg(self, msg) -> None:
+        if self.block_indexer is not None:
+            self.block_indexer.index(msg.data.height, msg.events)
+        if self.event_sink is not None:
+            self.event_sink.index_block_events(msg.data.height,
+                                               msg.data.events)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             busy = False
             while (msg := self._sub_blk.next(timeout=0)) is not None:
-                self.block_indexer.index(msg.data.height, msg.events)
+                self._index_block_msg(msg)
                 busy = True
             while (msg := self._sub_tx.next(timeout=0)) is not None:
                 self._index_tx_msg(msg)

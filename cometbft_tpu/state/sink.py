@@ -1,0 +1,164 @@
+"""Relational event sink (reference state/indexer/sink/psql/psql.go +
+schema.sql).
+
+The reference ships an optional Postgres sink for external indexing
+pipelines; this build serves the same schema on SQLite (the embedded
+SQL engine in the image — the documented substitution), so downstream
+consumers query the identical blocks / tx_results / events /
+attributes tables and the event_attributes view.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import time
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     INTEGER NOT NULL,
+  chain_id   TEXT NOT NULL,
+  created_at TEXT NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE INDEX IF NOT EXISTS idx_blocks_height_chain
+  ON blocks(height, chain_id);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   INTEGER NOT NULL REFERENCES blocks(rowid),
+  "index"    INTEGER NOT NULL,
+  created_at TEXT NOT NULL,
+  tx_hash    TEXT NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, "index")
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id INTEGER NOT NULL REFERENCES blocks(rowid),
+  tx_id    INTEGER NULL REFERENCES tx_results(rowid),
+  type     TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      INTEGER NOT NULL REFERENCES events(rowid),
+  key           TEXT NOT NULL,
+  composite_key TEXT NOT NULL,
+  value         TEXT NULL,
+  UNIQUE (event_id, key)
+);
+CREATE VIEW IF NOT EXISTS event_attributes AS
+  SELECT events.rowid AS event_id, events.block_id, events.tx_id,
+         events.type, attributes.key, attributes.composite_key,
+         attributes.value
+  FROM events LEFT JOIN attributes ON events.rowid = attributes.event_id;
+"""
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class SQLEventSink:
+    """psql.go EventSink on SQLite."""
+
+    def __init__(self, path: str, chain_id: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._mtx = threading.Lock()
+        self.chain_id = chain_id
+        with self._mtx:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def _block_rowid(self, cur, height: int) -> int:
+        row = cur.execute(
+            "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
+            (height, self.chain_id)).fetchone()
+        if row is not None:
+            return row[0]
+        cur.execute(
+            "INSERT INTO blocks (height, chain_id, created_at) "
+            "VALUES (?, ?, ?)", (height, self.chain_id, _utcnow()))
+        return cur.lastrowid
+
+    def _clear_events(self, cur, block_rowid: int, tx_rowid) -> None:
+        """Re-indexing replaces, never duplicates, the event rows."""
+        rows = cur.execute(
+            "SELECT rowid FROM events WHERE block_id = ? AND "
+            "tx_id IS ?", (block_rowid, tx_rowid)).fetchall()
+        for (event_id,) in rows:
+            cur.execute("DELETE FROM attributes WHERE event_id = ?",
+                        (event_id,))
+        cur.execute("DELETE FROM events WHERE block_id = ? AND "
+                    "tx_id IS ?", (block_rowid, tx_rowid))
+
+    def _insert_events(self, cur, block_rowid: int, tx_rowid,
+                       events) -> None:
+        self._clear_events(cur, block_rowid, tx_rowid)
+        for ev in events or []:
+            if not getattr(ev, "type", ""):
+                continue
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) "
+                "VALUES (?, ?, ?)", (block_rowid, tx_rowid, ev.type))
+            event_id = cur.lastrowid
+            for attr in ev.attributes:
+                if not attr.key:
+                    continue
+                cur.execute(
+                    "INSERT OR REPLACE INTO attributes "
+                    "(event_id, key, composite_key, value) "
+                    "VALUES (?, ?, ?, ?)",
+                    (event_id, attr.key, f"{ev.type}.{attr.key}",
+                     attr.value))
+
+    # -- EventSink interface (psql.go IndexBlockEvents/IndexTxEvents) ------
+
+    def index_block_events(self, height: int, events) -> None:
+        from ..abci.types import Event, EventAttribute
+
+        pseudo = Event(type="block", attributes=[
+            EventAttribute(key="height", value=str(height), index=True)])
+        with self._mtx:
+            cur = self._conn.cursor()
+            rowid = self._block_rowid(cur, height)
+            self._insert_events(cur, rowid, None,
+                                [pseudo] + list(events or []))
+            self._conn.commit()
+
+    def index_tx_events(self, height: int, index: int, tx: bytes,
+                        result, events) -> None:
+        from ..rpc.serialize import hex_upper
+        from ..types.block import tx_hash
+
+        from ..abci.types import Event, EventAttribute
+
+        h = hex_upper(tx_hash(tx))
+        pseudo = Event(type="tx", attributes=[
+            EventAttribute(key="hash", value=h, index=True),
+            EventAttribute(key="height", value=str(height), index=True)])
+        result_bytes = result.to_proto() if result is not None else b""
+        with self._mtx:
+            cur = self._conn.cursor()
+            block_rowid = self._block_rowid(cur, height)
+            cur.execute(
+                'INSERT INTO tx_results (block_id, "index", created_at, '
+                "tx_hash, tx_result) VALUES (?, ?, ?, ?, ?) "
+                'ON CONFLICT (block_id, "index") DO UPDATE SET '
+                "tx_result = excluded.tx_result",
+                (block_rowid, index, _utcnow(), h, result_bytes))
+            row = cur.execute(
+                'SELECT rowid FROM tx_results WHERE block_id = ? AND '
+                '"index" = ?', (block_rowid, index)).fetchone()
+            self._insert_events(cur, block_rowid, row[0],
+                                [pseudo] + list(events or []))
+            self._conn.commit()
+
+    # -- queries (for tools/tests; psql consumers use SQL directly) --------
+
+    def query(self, sql: str, params=()) -> list[tuple]:
+        with self._mtx:
+            return self._conn.execute(sql, params).fetchall()
+
+    def close(self) -> None:
+        with self._mtx:
+            self._conn.close()

@@ -1,0 +1,139 @@
+"""Load generation + latency reporting
+(reference test/loadtime/: cmd/load, payload/, report/report.go).
+
+The generator submits txs whose payload embeds the send time; the
+reporter walks committed blocks, matches payloads, and derives per-tx
+latency (block time - send time) plus block-interval statistics — the
+reference's report.GenerateFromBlockStore over our stores or RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+import uuid
+from dataclasses import dataclass, field
+
+_PREFIX = b"loadtime:"
+
+
+def make_payload(seq: int, run_id: str, size: int = 0,
+                 now_ns: int | None = None) -> bytes:
+    """payload/payload.go: id + sequence + send time (+ padding).
+
+    Shaped as `loadtime:{...}=<pad>` so kv-style apps (which require a
+    key=value form, like the reference's kvstore) admit it.  `size` is
+    a MINIMUM total length: the natural payload (~74 bytes) is never
+    truncated."""
+    body = {
+        "run": run_id,
+        "seq": seq,
+        "time_ns": time.time_ns() if now_ns is None else now_ns,
+    }
+    raw = _PREFIX + json.dumps(body).encode() + b"="
+    if size > len(raw):
+        raw += b"." * (size - len(raw))
+    else:
+        raw += b"1"
+    return raw
+
+
+def parse_payload(tx: bytes) -> dict | None:
+    if not tx.startswith(_PREFIX):
+        return None
+    try:
+        end = tx.find(b"}", len(_PREFIX))
+        return json.loads(tx[len(_PREFIX):end + 1])
+    except (ValueError, json.JSONDecodeError):
+        return None
+
+
+class LoadGenerator:
+    """cmd/load: submit rate-limited payloads over an RPC client."""
+
+    def __init__(self, client, rate: float = 20.0, size: int = 64):
+        self.client = client
+        self.rate = rate
+        self.size = size
+        self.run_id = uuid.uuid4().hex[:12]
+        self.sent = 0
+
+    def run(self, n_txs: int) -> int:
+        for i in range(n_txs):
+            tx = make_payload(i, self.run_id, self.size)
+            try:
+                self.client.broadcast_tx_sync(tx)
+                self.sent += 1
+            except Exception:
+                pass
+            time.sleep(1.0 / self.rate)
+        return self.sent
+
+
+@dataclass
+class Report:
+    """report.go Report: latency quantiles + block stats."""
+    run_id: str = ""
+    n_txs: int = 0
+    latencies_s: list = field(default_factory=list)
+    block_intervals_s: list = field(default_factory=list)
+    first_height: int = 0
+    last_height: int = 0
+
+    def summary(self) -> dict:
+        lat = sorted(self.latencies_s)
+
+        def q(p):
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {
+            "run_id": self.run_id,
+            "txs": self.n_txs,
+            "heights": [self.first_height, self.last_height],
+            "latency_s": {
+                "min": round(min(lat), 4) if lat else 0,
+                "p50": round(q(0.50), 4),
+                "p90": round(q(0.90), 4),
+                "p99": round(q(0.99), 4),
+                "max": round(max(lat), 4) if lat else 0,
+                "avg": round(statistics.fmean(lat), 4) if lat else 0,
+            },
+            "block_interval_s": {
+                "avg": round(statistics.fmean(self.block_intervals_s), 4)
+                if self.block_intervals_s else 0,
+                "stddev": round(statistics.pstdev(self.block_intervals_s), 4)
+                if len(self.block_intervals_s) > 1 else 0,
+            },
+        }
+
+
+def report_from_block_store(block_store, run_id: str | None = None,
+                            from_height: int = 1) -> Report:
+    """report.go GenerateFromBlockStore."""
+    rep = Report(run_id=run_id or "")
+    prev_time_ns = None
+    rep.first_height = max(from_height, block_store.base())
+    rep.last_height = block_store.height()
+    for h in range(rep.first_height, rep.last_height + 1):
+        block = block_store.load_block(h)
+        if block is None:
+            continue
+        t = block.header.time
+        t_ns = t.seconds * 1_000_000_000 + t.nanos
+        if prev_time_ns is not None:
+            rep.block_intervals_s.append((t_ns - prev_time_ns) / 1e9)
+        prev_time_ns = t_ns
+        for tx in block.data.txs:
+            body = parse_payload(bytes(tx))
+            if body is None:
+                continue
+            if run_id is not None and body.get("run") != run_id:
+                continue
+            rep.n_txs += 1
+            rep.latencies_s.append((t_ns - body["time_ns"]) / 1e9)
+            if not rep.run_id:
+                rep.run_id = body.get("run", "")
+    return rep

@@ -1,0 +1,163 @@
+"""Test-strategy parity tools: ABCI grammar checker (reference
+test/e2e/pkg/grammar/checker_test.go), loadtime reporter
+(test/loadtime/report), SQL event sink (state/indexer/sink/psql).
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.grammar import GrammarError, RecordingApp, verify
+from cometbft_tpu.state.sink import SQLEventSink
+from cometbft_tpu.tools import loadtime
+
+from tests.test_consensus import wait_for_height
+
+
+class TestGrammar:
+    def test_clean_start_legal(self):
+        verify(["init_chain", "finalize_block", "commit",
+                "prepare_proposal", "process_proposal",
+                "finalize_block", "commit"], clean_start=True)
+
+    def test_statesync_clean_start(self):
+        # failed attempt (offer only), then success with chunks
+        verify(["offer_snapshot", "offer_snapshot",
+                "apply_snapshot_chunk", "apply_snapshot_chunk",
+                "finalize_block", "commit"], clean_start=True)
+
+    def test_vote_extensions_round(self):
+        verify(["init_chain",
+                "prepare_proposal", "process_proposal", "extend_vote",
+                "verify_vote_extension", "verify_vote_extension",
+                "finalize_block", "commit"], clean_start=True)
+
+    def test_recovery_without_init_chain(self):
+        verify(["process_proposal", "finalize_block", "commit"],
+               clean_start=False)
+
+    def test_partial_trailing_height_allowed(self):
+        verify(["init_chain", "finalize_block", "commit",
+                "prepare_proposal"], clean_start=True)
+
+    def test_info_ignored(self):
+        verify(["info", "init_chain", "info", "finalize_block",
+                "commit"], clean_start=True)
+
+    def test_illegal_sequences(self):
+        # commit before finalize_block
+        with pytest.raises(GrammarError):
+            verify(["init_chain", "commit"], clean_start=True)
+        # consensus before init_chain on clean start
+        with pytest.raises(GrammarError):
+            verify(["finalize_block", "commit", "init_chain"],
+                   clean_start=True)
+        # double init_chain
+        with pytest.raises(GrammarError):
+            verify(["init_chain", "init_chain", "finalize_block",
+                    "commit"], clean_start=True)
+        # snapshot chunks without an offer
+        with pytest.raises(GrammarError):
+            verify(["apply_snapshot_chunk", "finalize_block", "commit"],
+                   clean_start=True)
+
+    def test_recording_app_against_live_node(self, tmp_path):
+        from cometbft_tpu.apps.kvstore import KVStoreApplication
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import Node, init_files
+
+        cfg = _tcfg(str(tmp_path))
+        cfg.base.abci = "local"     # use OUR wrapped app instance
+        init_files(cfg, chain_id="grammar-chain")
+        app = RecordingApp(KVStoreApplication())
+        n = Node(cfg, app=app)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 4, timeout=60)
+        finally:
+            n.stop()
+        app.verify(clean_start=True)
+        assert "finalize_block" in app.calls
+
+
+class TestLoadtime:
+    def test_payload_roundtrip(self):
+        tx = loadtime.make_payload(7, "runx", size=128)
+        assert len(tx) == 128
+        body = loadtime.parse_payload(tx)
+        assert body["seq"] == 7 and body["run"] == "runx"
+        assert loadtime.parse_payload(b"not-a-payload") is None
+
+    def test_report_from_block_store(self, tmp_path):
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import Node, init_files
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        cfg = _tcfg(str(tmp_path))
+        init_files(cfg, chain_id="load-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 2, timeout=60)
+            client = HTTPClient(n.rpc_addr, timeout=30)
+            gen = loadtime.LoadGenerator(client, rate=50, size=64)
+            sent = gen.run(10)
+            assert sent == 10
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                rep = loadtime.report_from_block_store(
+                    n.block_store, run_id=gen.run_id)
+                if rep.n_txs == 10:
+                    break
+                time.sleep(0.3)
+            assert rep.n_txs == 10
+            s = rep.summary()
+            # BFT time = median of the PREVIOUS commit's vote times, so
+            # on a fast test chain latencies sit within ~1 block of
+            # zero; on production intervals they are strictly positive
+            assert -1 < s["latency_s"]["p50"] < 30
+            assert s["latency_s"]["max"] < 30
+            assert s["latency_s"]["max"] >= s["latency_s"]["min"]
+            assert len(rep.block_intervals_s) >= 1
+            assert s["block_interval_s"]["avg"] > 0
+        finally:
+            n.stop()
+
+
+class TestSQLEventSink:
+    def test_sink_schema_and_node_wiring(self, tmp_path):
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import Node, init_files
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        cfg = _tcfg(str(tmp_path))
+        cfg.tx_index.indexer = "psql"
+        init_files(cfg, chain_id="sink-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 2, timeout=60)
+            client = HTTPClient(n.rpc_addr, timeout=30)
+            client.broadcast_tx_commit(b"sink-k=sink-v")
+            deadline = time.monotonic() + 15
+            rows = []
+            while time.monotonic() < deadline:
+                rows = n.event_sink.query(
+                    "SELECT tx_hash, block_id FROM tx_results")
+                if rows:
+                    break
+                time.sleep(0.2)
+            assert rows, "tx never reached the sink"
+            # blocks table has the chain + heights
+            blocks = n.event_sink.query(
+                "SELECT height, chain_id FROM blocks ORDER BY height")
+            assert blocks and blocks[0][1] == "sink-chain"
+            # the joined view exposes composite keys
+            attrs = n.event_sink.query(
+                "SELECT composite_key, value FROM event_attributes "
+                "WHERE composite_key LIKE 'app.%'")
+            assert attrs
+            # with psql indexing, kv-backed /tx_search is disabled
+            assert n.tx_indexer is None
+        finally:
+            n.stop()
