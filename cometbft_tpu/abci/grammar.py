@@ -36,7 +36,11 @@ TOKENS = {
     "finalize_block": "f",
     "commit": "c",
 }
-_IGNORED = {"info", "query", "check_tx", "echo", "flush"}
+_IGNORED = {"info", "query", "check_tx", "echo", "flush",
+            # snapshot-SERVING calls (a node feeding a syncing peer) are
+            # not part of the consensus grammar (reference
+            # test/e2e/pkg/grammar/checker.go filters non-grammar requests)
+            "list_snapshots", "load_snapshot_chunk"}
 
 # round = *got-vote [prepare [process] / process] [extend]; must not be
 # empty (an empty round matches nothing, which the repetition handles)

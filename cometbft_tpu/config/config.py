@@ -46,10 +46,14 @@ class RPCConfig:
     max_body_bytes: int = 1000000
     max_header_bytes: int = 1 << 20
     pprof_laddr: str = ""
-    # privileged listener for the data-companion pruning service
-    # (reference: rpc/grpc/server privileged services, node.go:819-861;
-    # served here as JSON-RPC since the image carries no gRPC stack)
+    # privileged JSON-RPC listener for the data-companion pruning service
+    # (reference: rpc/grpc/server privileged services, node.go:819-861)
     privileged_laddr: str = ""
+    # native gRPC listeners (reference [grpc] config section): public
+    # Version/Block/BlockResults services and the privileged pruning
+    # service (rpc/grpc_services.py)
+    grpc_services_laddr: str = ""
+    grpc_privileged_laddr: str = ""
 
 
 @dataclass

@@ -197,7 +197,8 @@ class Node(BaseService):
             self.state_store, self.block_store,
             tx_indexer=self.tx_indexer,
             block_indexer=self.block_indexer,
-            data_companion_enabled=bool(config.rpc.privileged_laddr))
+            data_companion_enabled=bool(config.rpc.privileged_laddr
+                                        or config.rpc.grpc_privileged_laddr))
 
         # block executor
         self.block_exec = BlockExecutor(
@@ -311,6 +312,8 @@ class Node(BaseService):
         self.rpc_server = None
         self.privileged_rpc_server = None
         self.pprof_server = None
+        self.grpc_server = None
+        self.grpc_privileged_server = None
 
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
@@ -407,6 +410,10 @@ class Node(BaseService):
             self.privileged_rpc_server.stop()
         if self.pprof_server is not None:
             self.pprof_server.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
+        if self.grpc_privileged_server is not None:
+            self.grpc_privileged_server.stop()
         self.switch.stop()
         self.wal.close()
         self.app_conns.stop()
@@ -457,6 +464,17 @@ class Node(BaseService):
             from ..libs.pprof import PprofServer
             self.pprof_server = PprofServer(self.config.rpc.pprof_laddr)
             self.pprof_server.start()
+        # native gRPC services (node.go:819-861)
+        if self.config.rpc.grpc_services_laddr:
+            from ..rpc.grpc_services import NodeGRPCServer
+            self.grpc_server = NodeGRPCServer(
+                env, self.config.rpc.grpc_services_laddr)
+            self.grpc_server.start()
+        if self.config.rpc.grpc_privileged_laddr:
+            from ..rpc.grpc_services import PrivilegedGRPCServer
+            self.grpc_privileged_server = PrivilegedGRPCServer(
+                env, self.config.rpc.grpc_privileged_laddr)
+            self.grpc_privileged_server.start()
 
     @property
     def rpc_addr(self) -> str | None:

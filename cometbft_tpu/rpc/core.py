@@ -579,6 +579,36 @@ class Environment:
         return {"pruning_service_retain_height":
                 str(p.abci_res_retain_height())}
 
+    def set_tx_indexer_retain_height(self, height=None) -> dict:
+        p = self._require_pruner()
+        h = int(height or 0)
+        if h <= 0:
+            raise RPCError(-32602, f"height must be positive, got {h}")
+        if not p.set_tx_indexer_retain_height(h):
+            raise RPCError(
+                -32603, "cannot lower the tx-indexer retain height "
+                f"(currently {p.tx_indexer_retain_height()})")
+        return {}
+
+    def get_tx_indexer_retain_height(self) -> dict:
+        p = self._require_pruner()
+        return {"height": str(p.tx_indexer_retain_height())}
+
+    def set_block_indexer_retain_height(self, height=None) -> dict:
+        p = self._require_pruner()
+        h = int(height or 0)
+        if h <= 0:
+            raise RPCError(-32602, f"height must be positive, got {h}")
+        if not p.set_block_indexer_retain_height(h):
+            raise RPCError(
+                -32603, "cannot lower the block-indexer retain height "
+                f"(currently {p.block_indexer_retain_height()})")
+        return {}
+
+    def get_block_indexer_retain_height(self) -> dict:
+        p = self._require_pruner()
+        return {"height": str(p.block_indexer_retain_height())}
+
 
 # routes.go: method name -> handler attribute
 ROUTES = {
@@ -618,4 +648,8 @@ PRIVILEGED_ROUTES = {
     "get_block_retain_height": "get_block_retain_height",
     "set_block_results_retain_height": "set_block_results_retain_height",
     "get_block_results_retain_height": "get_block_results_retain_height",
+    "set_tx_indexer_retain_height": "set_tx_indexer_retain_height",
+    "get_tx_indexer_retain_height": "get_tx_indexer_retain_height",
+    "set_block_indexer_retain_height": "set_block_indexer_retain_height",
+    "get_block_indexer_retain_height": "get_block_indexer_retain_height",
 }

@@ -1,0 +1,514 @@
+"""Node gRPC services (reference rpc/grpc/server/, node/node.go:819-861):
+
+  cometbft.services.version.v1.VersionService/GetVersion
+  cometbft.services.block.v1.BlockService/GetByHeight
+  cometbft.services.block.v1.BlockService/GetLatestHeight   (server stream)
+  cometbft.services.block_results.v1.BlockResultsService/GetBlockResults
+  cometbft.services.pruning.v1.PruningService/*             (privileged)
+
+grpcio is in the image but the protoc python plugin is not, so handlers
+register generically with hand-written wire codecs (libs/protowire) —
+same technique as abci/grpc.py; the bytes match the reference's
+generated stubs (proto/cometbft/services/**).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from dataclasses import dataclass, field
+
+from ..libs import protowire as pw
+from .. import version as ver
+
+VERSION_SVC = "cometbft.services.version.v1.VersionService"
+BLOCK_SVC = "cometbft.services.block.v1.BlockService"
+BLOCK_RESULTS_SVC = "cometbft.services.block_results.v1.BlockResultsService"
+PRUNING_SVC = "cometbft.services.pruning.v1.PruningService"
+
+
+# -- wire messages ----------------------------------------------------------
+
+@dataclass
+class Int64Message:
+    """Any single-int64-field-1 message (heights)."""
+    height: int = 0
+
+    def to_proto(self) -> bytes:
+        return pw.Writer().int_field(1, self.height).bytes()
+
+    @staticmethod
+    def from_proto(p: bytes) -> "Int64Message":
+        r = pw.Reader(p)
+        m = Int64Message()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_int()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class Empty:
+    def to_proto(self) -> bytes:
+        return b""
+
+    @staticmethod
+    def from_proto(p: bytes) -> "Empty":
+        return Empty()
+
+
+@dataclass
+class GetByHeightResponse:
+    block_id_proto: bytes = b""
+    block_proto: bytes = b""
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().message_field(1, self.block_id_proto)
+                .message_field(2, self.block_proto).bytes())
+
+    @staticmethod
+    def from_proto(p: bytes) -> "GetByHeightResponse":
+        r = pw.Reader(p)
+        m = GetByHeightResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.BYTES:
+                m.block_id_proto = r.read_bytes()
+            elif f == 2 and w == pw.BYTES:
+                m.block_proto = r.read_bytes()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class GetBlockResultsResponse:
+    height: int = 0
+    tx_results: list = field(default_factory=list)        # proto bytes
+    finalize_block_events: list = field(default_factory=list)
+    validator_updates: list = field(default_factory=list)
+    consensus_param_updates: bytes | None = None
+    app_hash: bytes = b""
+
+    def to_proto(self) -> bytes:
+        w = pw.Writer().int_field(1, self.height)
+        for t in self.tx_results:
+            w.message_field(2, t)
+        for e in self.finalize_block_events:
+            w.message_field(3, e)
+        for v in self.validator_updates:
+            w.message_field(4, v)
+        if self.consensus_param_updates is not None:
+            w.message_field(5, self.consensus_param_updates)
+        w.bytes_field(6, self.app_hash)
+        return w.bytes()
+
+    @staticmethod
+    def from_proto(p: bytes) -> "GetBlockResultsResponse":
+        r = pw.Reader(p)
+        m = GetBlockResultsResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_int()
+            elif f == 2 and w == pw.BYTES:
+                m.tx_results.append(r.read_bytes())
+            elif f == 3 and w == pw.BYTES:
+                m.finalize_block_events.append(r.read_bytes())
+            elif f == 4 and w == pw.BYTES:
+                m.validator_updates.append(r.read_bytes())
+            elif f == 5 and w == pw.BYTES:
+                m.consensus_param_updates = r.read_bytes()
+            elif f == 6 and w == pw.BYTES:
+                m.app_hash = r.read_bytes()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class GetVersionResponse:
+    node: str = ""
+    abci: str = ""
+    p2p: int = 0
+    block: int = 0
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().string_field(1, self.node)
+                .string_field(2, self.abci)
+                .uvarint_field(3, self.p2p)
+                .uvarint_field(4, self.block).bytes())
+
+    @staticmethod
+    def from_proto(p: bytes) -> "GetVersionResponse":
+        r = pw.Reader(p)
+        m = GetVersionResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.BYTES:
+                m.node = r.read_string()
+            elif f == 2 and w == pw.BYTES:
+                m.abci = r.read_string()
+            elif f == 3 and w == pw.VARINT:
+                m.p2p = r.read_uvarint()
+            elif f == 4 and w == pw.VARINT:
+                m.block = r.read_uvarint()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class UInt64Message:
+    """Any single-uint64-field-1 message (retain heights)."""
+    height: int = 0
+
+    def to_proto(self) -> bytes:
+        return pw.Writer().uvarint_field(1, self.height).bytes()
+
+    @staticmethod
+    def from_proto(p: bytes) -> "UInt64Message":
+        r = pw.Reader(p)
+        m = UInt64Message()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.height = r.read_uvarint()
+            else:
+                r.skip(w)
+        return m
+
+
+@dataclass
+class GetBlockRetainHeightResponse:
+    app_retain_height: int = 0
+    pruning_service_retain_height: int = 0
+
+    def to_proto(self) -> bytes:
+        return (pw.Writer().uvarint_field(1, self.app_retain_height)
+                .uvarint_field(2, self.pruning_service_retain_height)
+                .bytes())
+
+    @staticmethod
+    def from_proto(p: bytes) -> "GetBlockRetainHeightResponse":
+        r = pw.Reader(p)
+        m = GetBlockRetainHeightResponse()
+        while not r.at_end():
+            f, w = r.read_tag()
+            if f == 1 and w == pw.VARINT:
+                m.app_retain_height = r.read_uvarint()
+            elif f == 2 and w == pw.VARINT:
+                m.pruning_service_retain_height = r.read_uvarint()
+            else:
+                r.skip(w)
+        return m
+
+
+# -- server -----------------------------------------------------------------
+
+class _Handler:
+    """One grpc.GenericRpcHandler over a {path: (kind, fn, deser, ser)}
+    table; kind is 'unary' or 'stream'."""
+
+    def __init__(self, table):
+        self._table = table
+
+    def service(self, hcd):
+        import grpc
+
+        entry = self._table.get(hcd.method)
+        if entry is None:
+            return None
+        kind, fn, deser, ser = entry
+        if kind == "stream":
+            return grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=deser, response_serializer=ser)
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=deser, response_serializer=ser)
+
+
+def _ser(m) -> bytes:
+    return m.to_proto()
+
+
+class NodeGRPCServer:
+    """Public node services over one listener (reference
+    rpc/grpc/server/server.go Serve)."""
+
+    def __init__(self, env, addr: str, max_workers: int = 8):
+        import grpc
+
+        self.env = env
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        table = {
+            f"/{VERSION_SVC}/GetVersion":
+                ("unary", self._get_version, Empty.from_proto, _ser),
+            f"/{BLOCK_SVC}/GetByHeight":
+                ("unary", self._get_by_height, Int64Message.from_proto, _ser),
+            f"/{BLOCK_SVC}/GetLatestHeight":
+                ("stream", self._get_latest_height, Empty.from_proto, _ser),
+            f"/{BLOCK_RESULTS_SVC}/GetBlockResults":
+                ("unary", self._get_block_results, Int64Message.from_proto,
+                 _ser),
+        }
+        self._server.add_generic_rpc_handlers((_Handler(table),))
+        host_port = addr[len("tcp://"):] if addr.startswith("tcp://") else addr
+        self.port = self._server.add_insecure_port(host_port)
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _get_version(self, req, ctx):
+        return GetVersionResponse(
+            node=ver.CMT_SEM_VER, abci=ver.ABCI_SEM_VER,
+            p2p=ver.P2P_PROTOCOL, block=ver.BLOCK_PROTOCOL)
+
+    def _get_by_height(self, req, ctx):
+        import grpc
+
+        bs = self.env.block_store
+        height = req.height or bs.height()
+        block = bs.load_block(height)
+        if block is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND,
+                      f"no block at height {height}")
+        meta = bs.load_block_meta(height)
+        bid = meta.block_id if meta is not None else None
+        return GetByHeightResponse(
+            block_id_proto=bid.to_proto() if bid is not None else b"",
+            block_proto=block.to_proto())
+
+    def _get_latest_height(self, req, ctx):
+        """Long-lived stream of committed heights (reference
+        rpc/grpc/server/services/blockservice GetLatestHeight)."""
+        from ..types import events as ev
+
+        bus = self.env.event_bus
+        subscriber = "grpc-latest-height-%d" % id(ctx)
+        query = ev.query_for_event(ev.EVENT_NEW_BLOCK)
+        sub = bus.subscribe(subscriber, query) if bus is not None else None
+        try:
+            yield Int64Message(self.env.block_store.height())
+            while sub is not None and ctx.is_active() and \
+                    not sub.canceled.is_set():
+                msg = sub.next(timeout=0.25)
+                if msg is None:
+                    continue
+                yield Int64Message(msg.data.block.header.height)
+        finally:
+            if sub is not None and bus is not None:
+                bus.unsubscribe(subscriber, query)
+
+    def _get_block_results(self, req, ctx):
+        import grpc
+
+        from ..abci import types as at
+
+        env = self.env
+        height = req.height or env.block_store.height()
+        if height < env.block_store.base() or \
+                height > env.block_store.height():
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      f"height {height} is not available")
+        raw = env.state_store.load_finalize_block_response(height)
+        if raw is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND,
+                      f"no results for height {height}")
+        resp = at.FinalizeBlockResponse.from_proto(raw)
+        return GetBlockResultsResponse(
+            height=height,
+            tx_results=[t.to_proto() for t in resp.tx_results],
+            finalize_block_events=[e.to_proto() for e in resp.events],
+            validator_updates=[v.to_proto() for v in resp.validator_updates],
+            consensus_param_updates=resp.consensus_param_updates,
+            app_hash=resp.app_hash)
+
+
+class PrivilegedGRPCServer:
+    """Data-companion pruning service on its OWN listener (reference
+    node/node.go:846-861 separates the privileged listener)."""
+
+    def __init__(self, env, addr: str, max_workers: int = 4):
+        import grpc
+
+        self.env = env
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        u = Int64Message  # noqa: F841
+        t = {}
+        for name, fn in [
+            ("SetBlockRetainHeight", self._set_block_retain),
+            ("GetBlockRetainHeight", self._get_block_retain),
+            ("SetBlockResultsRetainHeight", self._set_results_retain),
+            ("GetBlockResultsRetainHeight", self._get_results_retain),
+            ("SetTxIndexerRetainHeight", self._set_tx_indexer_retain),
+            ("GetTxIndexerRetainHeight", self._get_tx_indexer_retain),
+            ("SetBlockIndexerRetainHeight", self._set_block_indexer_retain),
+            ("GetBlockIndexerRetainHeight", self._get_block_indexer_retain),
+        ]:
+            deser = (UInt64Message.from_proto if name.startswith("Set")
+                     else Empty.from_proto)
+            t[f"/{PRUNING_SVC}/{name}"] = ("unary", fn, deser, _ser)
+        self._server.add_generic_rpc_handlers((_Handler(t),))
+        host_port = addr[len("tcp://"):] if addr.startswith("tcp://") else addr
+        self.port = self._server.add_insecure_port(host_port)
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    def _pruner(self, ctx):
+        import grpc
+
+        p = self.env.pruner
+        if p is None:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "pruning service unavailable")
+        return p
+
+    def _set_block_retain(self, req, ctx):
+        import grpc
+
+        p = self._pruner(ctx)
+        h = req.height
+        if h <= 0 or h > self.env.block_store.height() + 1:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      f"height must be in [1, chain height], got {h}")
+        if not p.set_companion_block_retain_height(h):
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "cannot lower the companion retain height")
+        return Empty()
+
+    def _get_block_retain(self, req, ctx):
+        p = self._pruner(ctx)
+        return GetBlockRetainHeightResponse(
+            app_retain_height=p.application_block_retain_height(),
+            pruning_service_retain_height=p.companion_block_retain_height())
+
+    def _set_results_retain(self, req, ctx):
+        import grpc
+
+        p = self._pruner(ctx)
+        h = req.height
+        if h <= 0 or h > self.env.block_store.height() + 1:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      f"height must be in [1, chain height], got {h}")
+        if not p.set_abci_res_retain_height(h):
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "cannot lower the block-results retain height")
+        return Empty()
+
+    def _get_results_retain(self, req, ctx):
+        p = self._pruner(ctx)
+        return UInt64Message(p.abci_res_retain_height())
+
+    def _set_tx_indexer_retain(self, req, ctx):
+        import grpc
+
+        p = self._pruner(ctx)
+        if not p.set_tx_indexer_retain_height(req.height):
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "cannot lower the tx-indexer retain height")
+        return Empty()
+
+    def _get_tx_indexer_retain(self, req, ctx):
+        p = self._pruner(ctx)
+        return UInt64Message(p.tx_indexer_retain_height())
+
+    def _set_block_indexer_retain(self, req, ctx):
+        import grpc
+
+        p = self._pruner(ctx)
+        if not p.set_block_indexer_retain_height(req.height):
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                      "cannot lower the block-indexer retain height")
+        return Empty()
+
+    def _get_block_indexer_retain(self, req, ctx):
+        p = self._pruner(ctx)
+        return UInt64Message(p.block_indexer_retain_height())
+
+
+# -- typed client (tests, tooling) ------------------------------------------
+
+class GRPCNodeClient:
+    """Minimal typed client over the public + privileged services
+    (reference rpc/grpc/client)."""
+
+    def __init__(self, addr: str, timeout: float = 5.0):
+        import grpc
+
+        host_port = addr[len("tcp://"):] if addr.startswith("tcp://") else addr
+        self._channel = grpc.insecure_channel(host_port)
+        self.timeout = timeout
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _unary(self, path, resp_cls):
+        return self._channel.unary_unary(
+            path, request_serializer=_ser,
+            response_deserializer=resp_cls.from_proto)
+
+    def get_version(self) -> GetVersionResponse:
+        return self._unary(f"/{VERSION_SVC}/GetVersion",
+                           GetVersionResponse)(Empty(), timeout=self.timeout)
+
+    def get_block_by_height(self, height: int = 0) -> GetByHeightResponse:
+        return self._unary(f"/{BLOCK_SVC}/GetByHeight", GetByHeightResponse)(
+            Int64Message(height), timeout=self.timeout)
+
+    def get_latest_height_stream(self):
+        call = self._channel.unary_stream(
+            f"/{BLOCK_SVC}/GetLatestHeight", request_serializer=_ser,
+            response_deserializer=Int64Message.from_proto)
+        return call(Empty())
+
+    def get_block_results(self, height: int = 0) -> GetBlockResultsResponse:
+        return self._unary(f"/{BLOCK_RESULTS_SVC}/GetBlockResults",
+                           GetBlockResultsResponse)(
+            Int64Message(height), timeout=self.timeout)
+
+    # privileged
+    def set_block_retain_height(self, h: int) -> None:
+        self._unary(f"/{PRUNING_SVC}/SetBlockRetainHeight", Empty)(
+            UInt64Message(h), timeout=self.timeout)
+
+    def get_block_retain_height(self) -> GetBlockRetainHeightResponse:
+        return self._unary(f"/{PRUNING_SVC}/GetBlockRetainHeight",
+                           GetBlockRetainHeightResponse)(
+            Empty(), timeout=self.timeout)
+
+    def set_block_results_retain_height(self, h: int) -> None:
+        self._unary(f"/{PRUNING_SVC}/SetBlockResultsRetainHeight", Empty)(
+            UInt64Message(h), timeout=self.timeout)
+
+    def get_block_results_retain_height(self) -> UInt64Message:
+        return self._unary(f"/{PRUNING_SVC}/GetBlockResultsRetainHeight",
+                           UInt64Message)(Empty(), timeout=self.timeout)
+
+    def set_tx_indexer_retain_height(self, h: int) -> None:
+        self._unary(f"/{PRUNING_SVC}/SetTxIndexerRetainHeight", Empty)(
+            UInt64Message(h), timeout=self.timeout)
+
+    def get_tx_indexer_retain_height(self) -> UInt64Message:
+        return self._unary(f"/{PRUNING_SVC}/GetTxIndexerRetainHeight",
+                           UInt64Message)(Empty(), timeout=self.timeout)
+
+    def set_block_indexer_retain_height(self, h: int) -> None:
+        self._unary(f"/{PRUNING_SVC}/SetBlockIndexerRetainHeight", Empty)(
+            UInt64Message(h), timeout=self.timeout)
+
+    def get_block_indexer_retain_height(self) -> UInt64Message:
+        return self._unary(f"/{PRUNING_SVC}/GetBlockIndexerRetainHeight",
+                           UInt64Message)(Empty(), timeout=self.timeout)

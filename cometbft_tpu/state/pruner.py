@@ -20,6 +20,8 @@ from ..libs.service import BaseService
 _K_APP_RETAIN = b"prune/app_retain_height"
 _K_COMPANION_RETAIN = b"prune/companion_retain_height"
 _K_ABCI_RES_RETAIN = b"prune/abci_res_retain_height"
+_K_TX_IDX_RETAIN = b"prune/tx_indexer_retain_height"
+_K_BLOCK_IDX_RETAIN = b"prune/block_indexer_retain_height"
 
 DEFAULT_PRUNING_INTERVAL = 10.0   # pruner.go defaultPruningInterval
 
@@ -90,6 +92,32 @@ class Pruner(BaseService):
     def abci_res_retain_height(self) -> int:
         return self._get(_K_ABCI_RES_RETAIN)
 
+    def set_tx_indexer_retain_height(self, height: int) -> bool:
+        current = self._get(_K_TX_IDX_RETAIN)
+        if height < current:
+            return False
+        if height == current:
+            return True
+        self._set(_K_TX_IDX_RETAIN, height)
+        self._wake.set()
+        return True
+
+    def tx_indexer_retain_height(self) -> int:
+        return self._get(_K_TX_IDX_RETAIN)
+
+    def set_block_indexer_retain_height(self, height: int) -> bool:
+        current = self._get(_K_BLOCK_IDX_RETAIN)
+        if height < current:
+            return False
+        if height == current:
+            return True
+        self._set(_K_BLOCK_IDX_RETAIN, height)
+        self._wake.set()
+        return True
+
+    def block_indexer_retain_height(self) -> int:
+        return self._get(_K_BLOCK_IDX_RETAIN)
+
     def target_retain_height(self) -> int:
         """Lower bound of the enabled retain heights
         (pruner.go findMinBlockRetainHeight).  An unset (0) height means
@@ -139,4 +167,12 @@ class Pruner(BaseService):
         abci_target = self._get(_K_ABCI_RES_RETAIN)
         if abci_target:
             self.state_store.prune_abci_responses(abci_target)
+        # companion-set indexer retain heights (reference pruner.go
+        # pruneTxIndexerToRetainHeight / pruneBlockIndexerToRetainHeight)
+        tx_target = self._get(_K_TX_IDX_RETAIN)
+        if tx_target and self.tx_indexer is not None:
+            self.tx_indexer.prune(tx_target)
+        blk_target = self._get(_K_BLOCK_IDX_RETAIN)
+        if blk_target and self.block_indexer is not None:
+            self.block_indexer.prune(blk_target)
         return self.block_store.base(), pruned

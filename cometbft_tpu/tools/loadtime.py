@@ -132,8 +132,11 @@ def report_from_block_store(block_store, run_id: str | None = None,
                 continue
             if run_id is not None and body.get("run") != run_id:
                 continue
+            sent_ns = body.get("time_ns")
+            if not isinstance(sent_ns, int):
+                continue          # malformed payload: skip, don't abort
             rep.n_txs += 1
-            rep.latencies_s.append((t_ns - body["time_ns"]) / 1e9)
+            rep.latencies_s.append((t_ns - sent_ns) / 1e9)
             if not rep.run_id:
                 rep.run_id = body.get("run", "")
     return rep

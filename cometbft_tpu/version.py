@@ -1,0 +1,6 @@
+"""Version constants (reference version/version.go)."""
+
+CMT_SEM_VER = "0.1.0-tpu"       # node software version
+ABCI_SEM_VER = "2.1.0"          # ABCI protocol version (reference ABCISemVer)
+P2P_PROTOCOL = 9                # reference P2PProtocol
+BLOCK_PROTOCOL = 11             # reference BlockProtocol

@@ -1,0 +1,156 @@
+"""Phase-level profile of the v3 RLC kernel on the real TPU.
+
+Isolates: decompress, ext-table build, the two scan stages (and their
+pieces: quad_double on partials, table select, tree reduce), plus raw
+fe.mul throughput — all as marginal costs inside a lax.scan so the
+~65 ms axon readback latency cancels.
+
+Usage: python scripts/profile_rlc.py [N]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/cometbft_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+import jax.numpy as jnp
+import numpy as np
+
+from cometbft_tpu.ops import ed25519 as dev
+from cometbft_tpu.ops import fe
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+NPART = min(dev.NPART, N)
+rng = np.random.default_rng(0)
+
+
+def timed(f, *args):
+    out = jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(f(*args))
+    return time.perf_counter() - t0
+
+
+def marginal(name, body, x0, R=64, denom=None):
+    def prog(x, r):
+        def step(c, _):
+            return body(c), ()
+        c, _ = jax.lax.scan(step, x, None, length=r)
+        return jax.tree.map(lambda v: jnp.sum(v.astype(jnp.float32)), c)
+
+    f0 = jax.jit(lambda x: prog(x, 2))
+    fR = jax.jit(lambda x: prog(x, R + 2))
+    t0 = min(timed(f0, x0) for _ in range(3))
+    tR = min(timed(fR, x0) for _ in range(3))
+    per = (tR - t0) / R
+    d = denom or N
+    print(f"{name:44s} {per*1e6:9.1f} us/op  {per/d*1e9:8.2f} ns/elem",
+          flush=True)
+    return per
+
+
+# field element batches, limbs-first (20, N)
+def fe_rand(n=N):
+    return jnp.asarray(
+        rng.integers(0, 1 << 12, (fe.NLIMBS, n), dtype=np.int32))
+
+
+def pt_rand(n=N):
+    return jnp.stack([fe_rand(n) for _ in range(4)], axis=0)
+
+
+print(f"device: {jax.devices()[0]}  N={N}  NPART={NPART}", flush=True)
+
+a = fe_rand()
+marginal("fe.mul (20x20 schoolbook + carries)", lambda x: fe.mul(x, x), a)
+marginal("fe.add", lambda x: fe.add(x, x), a)
+marginal("fe.sqr", lambda x: fe.sqr(x), a)
+
+p = pt_rand()
+marginal("point_double width N", lambda q: dev.point_double(q), p)
+marginal("add_cached width N", lambda q: dev.add_cached(q, q), p)
+
+pp = pt_rand(NPART)
+marginal("quad_double width NPART (per window)",
+         lambda q: dev.point_double(
+             dev.point_double(dev.point_double(
+                 dev.point_double(q, False), False), False)), pp, denom=1)
+
+# decompress: feed uint32 words
+words = jnp.asarray(rng.integers(0, 1 << 31, (8, N), dtype=np.uint32))
+
+
+def dec_body(w):
+    pt, ok = dev.decompress(w)
+    # recycle: fold point back into 8 words worth of data
+    return (w + pt[0][:8].astype(jnp.uint32) + ok.astype(jnp.uint32))
+
+
+marginal("decompress (per point)", dec_body, words, R=16)
+
+# ext table build (15 cached adds + stack)
+def tab_body(q):
+    t = dev._ext_table(q)
+    return t[1] + t[15] * jnp.int32(3)
+
+
+marginal("_ext_table build (per point)", tab_body, p, R=8)
+
+# select from a table
+tab = jnp.stack([pt_rand() for _ in range(16)], axis=0)
+nib = jnp.asarray(rng.integers(0, 16, (N,), dtype=np.uint32))
+
+
+def sel_body(x):
+    s = dev._select(tab, (x[0, 0].astype(jnp.uint32)) & jnp.uint32(15))
+    return x + s
+
+
+marginal("_select 16-way (per sig)", sel_body, p, R=32)
+
+# tree reduce N -> NPART
+def tree_body(q):
+    r = dev._tree_reduce(q, NPART)
+    return q + jnp.pad(r, [(0, 0), (0, 0), (0, N - NPART)])
+
+
+marginal("_tree_reduce N->NPART (per window)", tree_body, p, R=16, denom=1)
+
+# full window step_lo analog
+tab2 = jnp.stack([pt_rand() for _ in range(16)], axis=0)
+accp = pt_rand(NPART)
+
+
+def window_body(acc):
+    accd = dev.point_double(dev.point_double(dev.point_double(
+        dev.point_double(acc, False), False), False))
+    nib_a = (acc[0, 0, :1].astype(jnp.uint32) & jnp.uint32(15))
+    both = jnp.concatenate(
+        [dev._select(tab, jnp.broadcast_to(nib_a, (N,))),
+         dev._select(tab2, jnp.broadcast_to(nib_a, (N,)))], axis=-1)
+    contrib = dev._tree_reduce(both, NPART)
+    return dev.point_add(accd, contrib)
+
+
+marginal("full step_lo window (per window)", window_body, accp, R=16,
+         denom=1)
+
+# whole kernel for scale
+from cometbft_tpu.crypto import ed25519 as ed  # noqa: E402
+from cometbft_tpu.crypto import ed25519_ref as ref  # noqa: E402
+
+keys = [ref.keygen(bytes([i + 1, 2] + [5] * 30)) for i in range(8)]
+pks, msgs, sigs = [], [], []
+for i in range(N - 1):
+    seed, pub = keys[i % 8]
+    msg = i.to_bytes(8, "little") * 4
+    pks.append(pub)
+    msgs.append(msg)
+    sigs.append(ed.PrivKey(seed + pub).sign(msg))
+packed = [jax.device_put(x) for x in ed.pack_rlc(pks, msgs, sigs)]
+f = jax.jit(dev.rlc_verify_kernel)
+print("rlc full:", timed(f, *packed) * 1e3, "ms", flush=True)

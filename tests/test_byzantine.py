@@ -108,7 +108,7 @@ class TestByzantineEquivocation:
                 ev_item.vote_b.block_id.hash
             # the honest majority keeps committing after the evidence
             target = max(n.block_store.height() for n in nodes[1:]) + 2
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + 120
             while time.monotonic() < deadline:
                 if any(n.block_store.height() >= target
                        for n in nodes[1:]):

@@ -1,0 +1,140 @@
+"""gRPC surfaces: ABCI-over-gRPC client/server (reference
+abci/server/grpc_server.go, abci/client/grpc_client.go) and the node
+services — Version, Block, BlockResults, streaming GetLatestHeight, and
+the privileged pruning service (reference rpc/grpc/server/,
+node/node.go:819-861)."""
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.grpc import GRPCClient, GRPCServer
+from cometbft_tpu.apps.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as _tcfg
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.rpc.grpc_services import GRPCNodeClient
+
+from tests.test_consensus import wait_for_height
+
+
+class TestABCIGrpc:
+    @pytest.fixture()
+    def pair(self):
+        app = KVStoreApplication()
+        server = GRPCServer("127.0.0.1:0", app)
+        server.start()
+        client = GRPCClient(f"127.0.0.1:{server.port}")
+        client.start()
+        yield app, client
+        client.stop()
+        server.stop()
+
+    def test_echo_info(self, pair):
+        _, client = pair
+        assert client.echo("ping").message == "ping"
+        info = client.info()
+        assert info.last_block_height == 0
+
+    def test_kvstore_tx_flow(self, pair):
+        _, client = pair
+        client.init_chain(at.InitChainRequest(chain_id="grpc-chain"))
+        res = client.check_tx(at.CheckTxRequest(
+            tx=b"k=v", type=at.CHECK_TX_TYPE_CHECK))
+        assert res.code == at.CODE_TYPE_OK
+        fin = client.finalize_block(at.FinalizeBlockRequest(
+            height=1, txs=[b"k=v"]))
+        assert fin.tx_results[0].code == at.CODE_TYPE_OK
+        client.commit()
+        q = client.query(at.QueryRequest(data=b"k"))
+        assert q.value == b"v"
+
+    def test_async_surface(self, pair):
+        _, client = pair
+        rr = client.check_tx_async(at.CheckTxRequest(
+            tx=b"a=b", type=at.CHECK_TX_TYPE_CHECK))
+        assert rr.wait(timeout=5).code == at.CODE_TYPE_OK
+
+
+@pytest.fixture(scope="class")
+def grpc_node(tmp_path_factory):
+    home = str(tmp_path_factory.mktemp("grpc-node-home"))
+    cfg = _tcfg(home)
+    cfg.rpc.grpc_services_laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.grpc_privileged_laddr = "tcp://127.0.0.1:0"
+    init_files(cfg, chain_id="grpc-chain")
+    n = Node(cfg)
+    n.start()
+    assert wait_for_height(n.consensus_state, 3, timeout=60)
+    yield n
+    n.stop()
+
+
+class TestNodeGrpcServices:
+    def test_version(self, grpc_node):
+        c = GRPCNodeClient(f"127.0.0.1:{grpc_node.grpc_server.port}")
+        v = c.get_version()
+        assert v.node and v.abci
+        assert v.p2p > 0 and v.block > 0
+        c.close()
+
+    def test_get_block_by_height(self, grpc_node):
+        from cometbft_tpu.types.block import Block
+
+        c = GRPCNodeClient(f"127.0.0.1:{grpc_node.grpc_server.port}")
+        r = c.get_block_by_height(2)
+        block = Block.from_proto(r.block_proto)
+        assert block.header.height == 2
+        # latest
+        r2 = c.get_block_by_height()
+        assert Block.from_proto(r2.block_proto).header.height >= 2
+        c.close()
+
+    def test_get_block_results(self, grpc_node):
+        c = GRPCNodeClient(f"127.0.0.1:{grpc_node.grpc_server.port}")
+        r = c.get_block_results(2)
+        assert r.height == 2
+        assert r.app_hash
+        c.close()
+
+    def test_get_latest_height_stream(self, grpc_node):
+        c = GRPCNodeClient(f"127.0.0.1:{grpc_node.grpc_server.port}")
+        stream = c.get_latest_height_stream()
+        first = next(stream)
+        assert first.height >= 1
+        # a new block must arrive on the long-lived stream
+        nxt = next(stream)
+        assert nxt.height >= first.height
+        stream.cancel()
+        c.close()
+
+    def test_pruning_service(self, grpc_node):
+        import grpc as grpclib
+
+        c = GRPCNodeClient(
+            f"127.0.0.1:{grpc_node.grpc_privileged_server.port}")
+        h = grpc_node.block_store.height()
+        c.set_block_retain_height(2)
+        got = c.get_block_retain_height()
+        assert got.pruning_service_retain_height == 2
+        c.set_block_results_retain_height(2)
+        assert c.get_block_results_retain_height().height == 2
+        c.set_tx_indexer_retain_height(2)
+        assert c.get_tx_indexer_retain_height().height == 2
+        c.set_block_indexer_retain_height(2)
+        assert c.get_block_indexer_retain_height().height == 2
+        # cannot lower
+        with pytest.raises(grpclib.RpcError):
+            c.set_block_retain_height(1)
+        # out-of-range height rejected
+        with pytest.raises(grpclib.RpcError):
+            c.set_block_retain_height(h + 1000)
+        c.close()
+
+    def test_pruner_honors_companion_height(self, grpc_node):
+        # companion gate: pruning enabled because privileged listener set
+        p = grpc_node.pruner
+        assert p is not None
+        assert p.companion_block_retain_height() >= 2
+        # app hasn't released anything -> target stays at app height (0)
+        assert p.target_retain_height() == 0
