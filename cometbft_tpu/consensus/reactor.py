@@ -249,6 +249,45 @@ class ConsensusReactor(Reactor):
             stop.set()
         self.cs.stop()
 
+    # -- vote pre-verification (SURVEY §7 streaming accumulator) -----------
+    def _preverify_vote(self, vote) -> None:
+        """Submit the vote's signature to the streaming verifier off the
+        state thread; VoteSet.add_vote consumes the verdict iff the
+        (pubkey, sign_bytes, sig) triple matches what it would verify
+        itself (reference analog: the per-vote verify at
+        types/vote_set.go:219 — here it is pipelined with gossip)."""
+        from ..crypto.votestream import Preverified, default_verifier
+
+        try:
+            cs = self.cs
+            # non-blocking: if the state thread holds the lock (e.g. mid
+            # finalize), skip — pre-verification is an optimization and
+            # VoteSet verifies inline anyway
+            if not cs._mtx.acquire(blocking=False):
+                return
+            try:
+                chain_id = cs.state.chain_id
+                if vote.height == cs.height:
+                    vals = cs.validators
+                elif vote.height == cs.height - 1:
+                    vals = cs.last_validators
+                else:
+                    return
+                if vals is None or not (
+                        0 <= vote.validator_index < vals.size()):
+                    return
+                pub = vals.validators[vote.validator_index].pub_key
+            finally:
+                cs._mtx.release()
+            if pub.type() != "ed25519" or not vote.signature:
+                return
+            pk = pub.bytes()
+            msg = vote.sign_bytes(chain_id)
+            fut = default_verifier().submit(pk, msg, vote.signature)
+            vote.preverified = Preverified(pk, msg, vote.signature, fut)
+        except Exception:
+            return       # pre-verification is best-effort; VoteSet re-checks
+
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
         """Blocksync -> consensus handoff (reactor.go:116)."""
         if state.last_block_height > 0:
@@ -330,6 +369,7 @@ class ConsensusReactor(Reactor):
                 v = msg.vote
                 ps.set_has_vote(v.height, v.round, v.type,
                                 v.validator_index)
+                self._preverify_vote(v)
                 self.cs.add_peer_message(msg, peer.id)
         elif ch == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, msgs.VoteSetBitsMessage):

@@ -1,0 +1,204 @@
+"""Streaming signature verification: the deadline-flushed accumulator
+between live consensus and the device (SURVEY §7 "latency vs
+throughput"; the per-vote hot path is the reference's
+types/vote_set.go:219-232 -> ed25519.go:181).
+
+Gossiped votes are PRE-verified off the consensus-state thread: the
+reactor submits (pubkey, sign_bytes, sig) as soon as a VoteMessage
+arrives and attaches the resulting future to the vote; VoteSet.add_vote
+consumes the verdict if (and only if) the submitted triple matches what
+it would itself verify.  The verifier batches concurrent submissions:
+
+- a worker collects submissions until the oldest has waited
+  flush_interval or the batch hits max_batch;
+- small flushes take the host fast path (OpenSSL verify with ZIP-215
+  fallback, crypto/ed25519.PubKey.verify_signature) — one vote in
+  steady-state consensus must not pay a device round-trip;
+- flushes >= device_threshold go to the device RLC kernel with
+  per-signature localization (crypto/batch._device_verify) — vote
+  floods (late-joiner catchup, large validator sets) amortize onto the
+  accelerator.
+
+This mirrors MConnection's flush throttle (reference
+p2p/conn/connection.go 10ms flushThrottle): latency-bounded batching at
+the seam where throughput spikes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+from ..libs.service import BaseService
+
+_FLUSH_INTERVAL = float(os.environ.get("COMETBFT_TPU_VOTE_FLUSH_MS", "2")) \
+    / 1000.0
+_DEVICE_THRESHOLD = int(os.environ.get(
+    "COMETBFT_TPU_VOTE_DEVICE_THRESHOLD", "256"))
+_MAX_BATCH = 4096
+
+
+class StreamingVerifier(BaseService):
+    """Deadline-flushed ed25519 verify accumulator."""
+
+    def __init__(self, flush_interval: float = _FLUSH_INTERVAL,
+                 device_threshold: int = _DEVICE_THRESHOLD,
+                 max_batch: int = _MAX_BATCH):
+        super().__init__("StreamingVerifier")
+        self.flush_interval = flush_interval
+        self.device_threshold = device_threshold
+        self.max_batch = max_batch
+        self._pending: list[tuple[bytes, bytes, bytes, Future]] = []
+        self._cv = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+        self.flushes = 0
+        self.device_flushes = 0
+        self.verified = 0
+
+    # -- service -----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._worker, name="vote-verify-stream", daemon=True)
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- API ---------------------------------------------------------------
+
+    def submit(self, pubkey: bytes, msg: bytes, sig: bytes) -> Future:
+        """Queue one signature; the future resolves to a bool verdict.
+        The caller keeps (pubkey, msg, sig) to check the verdict applies
+        to what it meant to verify."""
+        fut: Future = Future()
+        with self._cv:
+            if self._stopping or self._thread is None:
+                fut.set_result(_host_verify(pubkey, msg, sig))
+                return fut
+            self._pending.append((pubkey, msg, sig, fut))
+            self._cv.notify()
+        return fut
+
+    # -- worker ------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping:
+                    self._cv.wait(timeout=0.1)
+                if self._stopping:
+                    batch, self._pending = self._pending, []
+                else:
+                    # deadline accumulation: let the batch grow until the
+                    # OLDEST submission has waited flush_interval
+                    deadline = time.monotonic() + self.flush_interval
+                    while (len(self._pending) < self.max_batch
+                           and not self._stopping):
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        self._cv.wait(timeout=left)
+                    batch, self._pending = self._pending, []
+            if batch:
+                self._flush(batch)
+            if self._stopping:
+                with self._cv:
+                    leftover, self._pending = self._pending, []
+                if leftover:
+                    self._flush(leftover)
+                return
+
+    def _flush(self, batch) -> None:
+        self.flushes += 1
+        self.verified += len(batch)
+        if len(batch) >= self.device_threshold:
+            try:
+                self._flush_device(batch)
+                return
+            except Exception:      # device trouble: host path still right
+                pass
+        for pk, msg, sig, fut in batch:
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(_host_verify(pk, msg, sig))
+            except Exception as e:  # pragma: no cover
+                fut.set_exception(e)
+
+    def _flush_device(self, batch) -> None:
+        from . import batch as cb
+        from . import ed25519 as ed
+
+        self.device_flushes += 1
+        pks = [b[0] for b in batch]
+        msgs = [b[1] for b in batch]
+        sigs = [b[2] for b in batch]
+        parsed = ed.parse_and_hash(pks, msgs, sigs)
+        _, verdicts = cb._device_verify(pks, parsed)
+        for (_, _, _, fut), ok in zip(batch, verdicts):
+            if fut.set_running_or_notify_cancel():
+                fut.set_result(bool(ok))
+
+
+def _host_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    from .ed25519 import PUBKEY_SIZE, PubKey
+
+    if len(pk) != PUBKEY_SIZE:
+        return False
+    try:
+        return PubKey(pk).verify_signature(msg, sig)
+    except Exception:
+        return False
+
+
+# -- process-wide default instance ------------------------------------------
+
+_default: StreamingVerifier | None = None
+_default_lock = threading.Lock()
+
+
+def default_verifier() -> StreamingVerifier:
+    """Lazily-started shared instance (all reactors in a process feed
+    one accumulator, maximizing batch opportunities)."""
+    global _default
+    with _default_lock:
+        if _default is None or not _default.is_running():
+            _default = StreamingVerifier()
+            _default.start()
+        return _default
+
+
+class Preverified:
+    """Verdict attached to a Vote by the reactor: the consumed-by
+    VoteSet contract is exact-triple equality."""
+
+    __slots__ = ("pubkey", "msg", "sig", "future")
+
+    def __init__(self, pubkey: bytes, msg: bytes, sig: bytes,
+                 future: Future):
+        self.pubkey = pubkey
+        self.msg = msg
+        self.sig = sig
+        self.future = future
+
+    def verdict_for(self, pubkey: bytes, msg: bytes, sig: bytes,
+                    timeout: float = 0.01):
+        """Bool verdict if this preverification covers (pubkey, msg,
+        sig) exactly; None when it does not apply or is not ready in
+        ~a flush interval (the caller's inline verify is microseconds,
+        so waiting longer than a couple of flush windows is a loss)."""
+        if (pubkey, msg, sig) != (self.pubkey, self.msg, self.sig):
+            return None
+        try:
+            return bool(self.future.result(timeout=timeout))
+        except Exception:
+            return None

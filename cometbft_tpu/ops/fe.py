@@ -167,7 +167,7 @@ def mul_word(a: jnp.ndarray, w: int) -> jnp.ndarray:
 
 
 def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
-    return jax.lax.fori_loop(0, n, lambda i, v: sqr(v), x, unroll=8)
+    return jax.lax.fori_loop(0, n, lambda i, v: sqr(v), x, unroll=4)
 
 
 def _pow_22501(z: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -33,6 +33,10 @@ class Vote:
     extension: bytes = b""
     extension_signature: bytes = b""
 
+    # transient verdict attached by the consensus reactor's streaming
+    # pre-verification (crypto/votestream.Preverified); not a wire field
+    preverified = None
+
     def sign_bytes(self, chain_id: str) -> bytes:
         return canonical.vote_sign_bytes(
             chain_id, self.type, self.height, self.round, self.block_id,
@@ -54,6 +58,11 @@ class Vote:
         """vote.go:244-260: also checks the extension signature on
         non-nil precommits."""
         self.verify(chain_id, pubkey)
+        self.verify_extension_signature(chain_id, pubkey)
+
+    def verify_extension_signature(self, chain_id: str, pubkey) -> None:
+        """Just the extension half (used when the main signature verdict
+        came from the streaming pre-verifier)."""
         if self.type == PRECOMMIT_TYPE and not self.block_id.is_nil():
             if not pubkey.verify_signature(
                     self.extension_sign_bytes(chain_id),

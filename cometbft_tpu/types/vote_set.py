@@ -140,9 +140,26 @@ class VoteSet:
             raise ErrVoteNonDeterministicSignature(
                 "same vote signed differently")
 
-        # signature check (the per-vote hot path; vote_set.go:219-232)
+        # signature check (the per-vote hot path; vote_set.go:219-232).
+        # A reactor-attached streaming pre-verification is consumed iff
+        # it covers EXACTLY the (pubkey, sign-bytes, sig) we would check
+        # ourselves (crypto/votestream); otherwise verify inline.
         try:
-            if self.extensions_enabled:
+            verdict = None
+            if vote.preverified is not None:
+                verdict = vote.preverified.verdict_for(
+                    val.pub_key.bytes(), vote.sign_bytes(self.chain_id),
+                    vote.signature)
+                vote.preverified = None    # release buffers + future
+            if verdict is False:
+                raise ValueError("invalid signature")
+            if verdict is True:
+                if val.pub_key.address() != vote.validator_address:
+                    raise ValueError("invalid validator address")
+                if self.extensions_enabled:
+                    vote.verify_extension_signature(
+                        self.chain_id, val.pub_key)
+            elif self.extensions_enabled:
                 vote.verify_vote_and_extension(self.chain_id, val.pub_key)
             else:
                 vote.verify(self.chain_id, val.pub_key)

@@ -24,14 +24,16 @@ from cometbft_tpu.ops import ed25519 as dev
 from cometbft_tpu.ops import fe
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-NPART = min(dev.NPART, N)
+NPART = dev._npart(dev.pad_width(N))
 rng = np.random.default_rng(0)
 
 
 def timed(f, *args):
-    out = jax.block_until_ready(f(*args))
+    # np.asarray readback: on the remote axon platform block_until_ready
+    # can return before execution finishes; only a readback is a fence
+    jax.tree.map(np.asarray, f(*args))
     t0 = time.perf_counter()
-    out = jax.block_until_ready(f(*args))
+    jax.tree.map(np.asarray, f(*args))
     return time.perf_counter() - t0
 
 
@@ -66,13 +68,14 @@ def pt_rand(n=N):
 print(f"device: {jax.devices()[0]}  N={N}  NPART={NPART}", flush=True)
 
 a = fe_rand()
-marginal("fe.mul (20x20 schoolbook + carries)", lambda x: fe.mul(x, x), a)
-marginal("fe.add", lambda x: fe.add(x, x), a)
-marginal("fe.sqr", lambda x: fe.sqr(x), a)
+marginal("fe.mul (20x20 schoolbook + carries)", lambda x: fe.mul(x, x), a,
+         R=512)
+marginal("fe.add", lambda x: fe.add(x, x), a, R=512)
+marginal("fe.sqr", lambda x: fe.sqr(x), a, R=512)
 
 p = pt_rand()
-marginal("point_double width N", lambda q: dev.point_double(q), p)
-marginal("add_cached width N", lambda q: dev.add_cached(q, q), p)
+marginal("point_double width N", lambda q: dev.point_double(q), p, R=128)
+marginal("add_cached width N", lambda q: dev.add_cached(q, q), p, R=128)
 
 pp = pt_rand(NPART)
 marginal("quad_double width NPART (per window)",

@@ -1,0 +1,146 @@
+"""Streaming vote pre-verification (crypto/votestream): the
+deadline-flushed accumulator between gossip and the device, plus its
+consumption contract in VoteSet (reference hot path
+types/vote_set.go:219-232; SURVEY §7 'latency vs throughput')."""
+
+import threading
+import time
+
+from cometbft_tpu.crypto.ed25519 import PrivKey
+from cometbft_tpu.crypto.votestream import (
+    Preverified, StreamingVerifier, default_verifier)
+
+
+def make_sig(i=0, msg=b"streaming-vote"):
+    priv = PrivKey.generate(bytes([i + 1]) * 32)
+    return priv.pub_key().bytes(), msg, priv.sign(msg)
+
+
+class TestStreamingVerifier:
+    def test_good_and_bad(self):
+        sv = StreamingVerifier(flush_interval=0.002)
+        sv.start()
+        try:
+            pk, msg, sig = make_sig()
+            good = sv.submit(pk, msg, sig)
+            bad = sv.submit(pk, b"other msg", sig)
+            short = sv.submit(b"\x01" * 5, msg, sig)
+            assert good.result(timeout=2) is True
+            assert bad.result(timeout=2) is False
+            assert short.result(timeout=2) is False
+        finally:
+            sv.stop()
+
+    def test_concurrent_submissions_batch(self):
+        sv = StreamingVerifier(flush_interval=0.05)
+        sv.start()
+        try:
+            items = [make_sig(i) for i in range(12)]
+            futs = []
+            barrier = threading.Barrier(4)
+
+            def submitter(chunk):
+                barrier.wait()
+                for pk, msg, sig in chunk:
+                    futs.append(sv.submit(pk, msg, sig))
+
+            threads = [threading.Thread(
+                target=submitter, args=(items[i::4],)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.monotonic() + 5
+            while len(futs) < 12 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert all(f.result(timeout=2) for f in futs)
+            # the 50ms window must have coalesced them into few flushes
+            assert sv.flushes <= 4, sv.flushes
+            assert sv.verified == 12
+        finally:
+            sv.stop()
+
+    def test_device_threshold_routes_to_device(self, monkeypatch):
+        sv = StreamingVerifier(flush_interval=0.05, device_threshold=4)
+        calls = []
+
+        def fake_device(batch):
+            calls.append(len(batch))
+            for pk, m, s, fut in batch:
+                fut.set_result(True)
+
+        monkeypatch.setattr(sv, "_flush_device", fake_device)
+        sv.start()
+        try:
+            items = [make_sig(i) for i in range(6)]
+            futs = [sv.submit(*it) for it in items]
+            assert all(f.result(timeout=2) for f in futs)
+            assert calls and calls[0] >= 4
+            assert sv.device_flushes == 0  # counter bumps inside the real one
+        finally:
+            sv.stop()
+
+    def test_submit_after_stop_still_answers(self):
+        sv = StreamingVerifier()
+        sv.start()
+        sv.stop()
+        pk, msg, sig = make_sig()
+        assert sv.submit(pk, msg, sig).result(timeout=1) is True
+
+    def test_default_verifier_restarts(self):
+        v1 = default_verifier()
+        assert v1.is_running()
+        v1.stop()
+        v2 = default_verifier()
+        assert v2.is_running() and v2 is not v1
+
+
+class TestPreverifiedContract:
+    def test_exact_triple_match_only(self):
+        pk, msg, sig = make_sig()
+        sv = StreamingVerifier(flush_interval=0.001)
+        sv.start()
+        try:
+            fut = sv.submit(pk, msg, sig)
+            pv = Preverified(pk, msg, sig, fut)
+            assert pv.verdict_for(pk, msg, sig) is True
+            assert pv.verdict_for(pk, b"different", sig) is None
+            assert pv.verdict_for(b"\x02" * 32, msg, sig) is None
+        finally:
+            sv.stop()
+
+    def test_vote_set_consumes_preverified(self):
+        """A vote carrying a preverified verdict for a DIFFERENT triple
+        must still be verified inline (and pass); one whose matching
+        verdict is False must be rejected."""
+        from concurrent.futures import Future
+
+        import pytest
+
+        from cometbft_tpu.types.vote import PREVOTE_TYPE
+        from cometbft_tpu.types.vote_set import (
+            ErrVoteInvalidSignature, VoteSet)
+        from tests.test_vote_set import (
+            CHAIN, block_id, make_valset, signed_vote)
+
+        vals, privs = make_valset(3)
+        vs = VoteSet(CHAIN, 5, 0, PREVOTE_TYPE, vals)
+        bid = block_id()
+        vote = signed_vote(privs[0], 0, PREVOTE_TYPE, 5, 0, bid)
+        # non-matching marker -> ignored, inline verify accepts
+        f = Future()
+        f.set_result(False)
+        vote.preverified = Preverified(b"\x07" * 32, b"x", b"y", f)
+        assert vs.add_vote(vote)
+
+        vote2 = signed_vote(privs[1], 1, PREVOTE_TYPE, 5, 0, bid)
+        pk = vals.validators[1].pub_key.bytes()
+        msg = vote2.sign_bytes(CHAIN)
+        f2 = Future()
+        f2.set_result(False)      # matching triple, negative verdict
+        vote2.preverified = Preverified(pk, msg, vote2.signature, f2)
+        with pytest.raises(ErrVoteInvalidSignature):
+            vs.add_vote(vote2)
+        # without the marker the same vote is valid
+        vote2.preverified = None
+        assert vs.add_vote(vote2)
