@@ -269,6 +269,14 @@ class ConsensusReactor(Reactor):
                 chain_id = cs.state.chain_id
                 if vote.height == cs.height:
                     vals = cs.validators
+                    # duplicate gossip copies: add_vote rejects them
+                    # before verifying — don't verify them here either
+                    vs = cs.votes._get_vote_set(vote.round, vote.type) \
+                        if cs.votes is not None else None
+                    if vs is not None and 0 <= vote.validator_index < \
+                            len(vs.votes) and \
+                            vs.votes[vote.validator_index] is not None:
+                        return
                 elif vote.height == cs.height - 1:
                     vals = cs.last_validators
                 else:

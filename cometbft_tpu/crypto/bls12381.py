@@ -1,0 +1,208 @@
+"""BLS12-381 min-pk keys over the native C++ library
+(native/bls12381/ — pairing, hash-to-G2, compressed encodings).
+
+The reference gates this scheme behind a build tag with a stub
+exposing Enabled=False (/root/reference/crypto/bls12381/key.go:1-20;
+real impl key_bls12381.go via the CGO blst library — its only native
+code path).  Here the gate is the presence of the compiled shared
+library: `enabled()` is False until `build()` (or `make -C
+native/bls12381`) produces libbls12381.so; the native path is our
+from-scratch C++ (fp.h/fp_tower.h/curve.h/pairing.h).
+
+Wire shapes match the reference: 48-byte compressed G1 pubkeys,
+96-byte compressed G2 signatures, 32-byte scalars, key type
+"bls12_381", address = first 20 bytes of SHA-256(pubkey).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+
+from .hash import sum_sha256
+
+KEY_TYPE = "bls12_381"
+PUBKEY_SIZE = 48
+PRIVKEY_SIZE = 32
+SIGNATURE_SIZE = 96
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "bls12381")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbls12381.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        for name, args in {
+            "bls_keygen": [ctypes.c_char_p, ctypes.c_char_p],
+            "bls_sk_to_pk": [ctypes.c_char_p, ctypes.c_char_p],
+            "bls_sign": [ctypes.c_char_p, ctypes.c_char_p,
+                         ctypes.c_size_t, ctypes.c_char_p],
+            "bls_verify": [ctypes.c_char_p, ctypes.c_char_p,
+                           ctypes.c_size_t, ctypes.c_char_p],
+            "bls_pk_validate": [ctypes.c_char_p],
+            "bls_aggregate_sigs": [ctypes.c_char_p, ctypes.c_size_t,
+                                   ctypes.c_char_p],
+            "bls_aggregate_pks": [ctypes.c_char_p, ctypes.c_size_t,
+                                  ctypes.c_char_p],
+            "bls_selftest": [],
+            "bls_sha256": [ctypes.c_char_p, ctypes.c_size_t,
+                           ctypes.c_char_p],
+            "bls_expand_message_xmd": [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t],
+        }.items():
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = ctypes.c_int
+        if lib.bls_selftest() != 0:
+            raise RuntimeError("bls12381 native self-test failed")
+        _lib = lib
+        return _lib
+
+
+def enabled() -> bool:
+    """Reference key.go Enabled analog: True iff the native library is
+    built and passes its self-test."""
+    try:
+        return _load() is not None
+    except Exception:
+        return False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library (the reference's `-tags bls12381`
+    analog).  Returns enabled()."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return enabled()
+    src = os.path.join(_NATIVE_DIR, "bls.cc")
+    if not os.path.exists(src):
+        return False
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH, src],
+        check=True, capture_output=True, cwd=_NATIVE_DIR)
+    return enabled()
+
+
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "bls12381 is not enabled; run "
+            "cometbft_tpu.crypto.bls12381.build() "
+            "(reference analog: build tag bls12381, key.go:1)")
+    return lib
+
+
+@dataclass(frozen=True)
+class PubKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("bls12_381 pubkey must be 48 bytes")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def address(self) -> bytes:
+        return sum_sha256(self.data)[:20]
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        lib = _require()
+        return bool(lib.bls_verify(self.data, msg, len(msg), sig))
+
+    def validate(self) -> bool:
+        return bool(_require().bls_pk_validate(self.data))
+
+    def __bytes__(self):
+        return self.data
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    data: bytes
+
+    def __post_init__(self):
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("bls12_381 privkey must be 32 bytes")
+
+    @staticmethod
+    def generate(seed: bytes | None = None) -> "PrivKey":
+        import secrets
+
+        lib = _require()
+        seed = seed if seed is not None else secrets.token_bytes(32)
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        out = ctypes.create_string_buffer(PRIVKEY_SIZE)
+        if not lib.bls_keygen(seed, out):
+            raise RuntimeError("bls keygen failed")
+        return PrivKey(out.raw)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def pub_key(self) -> PubKey:
+        lib = _require()
+        out = ctypes.create_string_buffer(PUBKEY_SIZE)
+        if not lib.bls_sk_to_pk(self.data, out):
+            raise RuntimeError("invalid bls secret key")
+        return PubKey(out.raw)
+
+    def sign(self, msg: bytes) -> bytes:
+        lib = _require()
+        out = ctypes.create_string_buffer(SIGNATURE_SIZE)
+        if not lib.bls_sign(self.data, msg, len(msg), out):
+            raise RuntimeError("bls sign failed")
+        return out.raw
+
+
+def aggregate_signatures(sigs: list[bytes]) -> bytes:
+    lib = _require()
+    buf = b"".join(sigs)
+    if len(buf) != SIGNATURE_SIZE * len(sigs):
+        raise ValueError("bad signature lengths")
+    out = ctypes.create_string_buffer(SIGNATURE_SIZE)
+    if not lib.bls_aggregate_sigs(buf, len(sigs), out):
+        raise ValueError("invalid signature in aggregate")
+    return out.raw
+
+
+def aggregate_pubkeys(pks: list[bytes]) -> bytes:
+    lib = _require()
+    buf = b"".join(pks)
+    if len(buf) != PUBKEY_SIZE * len(pks):
+        raise ValueError("bad pubkey lengths")
+    out = ctypes.create_string_buffer(PUBKEY_SIZE)
+    if not lib.bls_aggregate_pks(buf, len(pks), out):
+        raise ValueError("invalid pubkey in aggregate")
+    return out.raw
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, length: int) -> bytes:
+    lib = _require()
+    out = ctypes.create_string_buffer(length)
+    lib.bls_expand_message_xmd(msg, len(msg), dst, len(dst), out, length)
+    return out.raw

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from ..libs import protowire as pw
 
-_FIELD_BY_TYPE = {"ed25519": 1, "secp256k1": 2, "bls12381": 3}
+_FIELD_BY_TYPE = {"ed25519": 1, "secp256k1": 2, "bls12_381": 3}
 _TYPE_BY_FIELD = {v: k for k, v in _FIELD_BY_TYPE.items()}
 
 
@@ -42,4 +42,10 @@ def make_pubkey(key_type: str, data: bytes):
     if key_type == "secp256k1":
         from . import secp256k1
         return secp256k1.PubKey(data)
+    if key_type == "bls12_381":
+        # gated like the reference build tag (bls12381.enabled());
+        # constructing the key only needs the bytes — verification
+        # raises if the native library is absent
+        from . import bls12381
+        return bls12381.PubKey(data)
     raise ValueError(f"unsupported pubkey type {key_type}")

@@ -118,6 +118,10 @@ class StreamingVerifier(BaseService):
                 return
 
     def _flush(self, batch) -> None:
+        # consumers cancel futures they already verified inline
+        batch = [b for b in batch if not b[3].cancelled()]
+        if not batch:
+            return
         self.flushes += 1
         self.verified += len(batch)
         if len(batch) >= self.device_threshold:
@@ -190,15 +194,22 @@ class Preverified:
         self.sig = sig
         self.future = future
 
-    def verdict_for(self, pubkey: bytes, msg: bytes, sig: bytes,
-                    timeout: float = 0.01):
+    def verdict_for(self, pubkey: bytes, msg: bytes, sig: bytes):
         """Bool verdict if this preverification covers (pubkey, msg,
-        sig) exactly; None when it does not apply or is not ready in
-        ~a flush interval (the caller's inline verify is microseconds,
-        so waiting longer than a couple of flush windows is a loss)."""
+        sig) exactly AND already resolved; None otherwise.  Never
+        blocks: the caller's inline verify costs microseconds, so a
+        pending future is CANCELED (dropping it from the worker's
+        batch — no duplicated work) and the caller verifies inline.
+        During floods the state thread lags the verifier and futures
+        are resolved by the time they are consumed — that is the case
+        this path accelerates."""
         if (pubkey, msg, sig) != (self.pubkey, self.msg, self.sig):
             return None
-        try:
-            return bool(self.future.result(timeout=timeout))
-        except Exception:
-            return None
+        fut = self.future
+        if fut.done() and not fut.cancelled():
+            try:
+                return bool(fut.result(timeout=0))
+            except Exception:
+                return None
+        fut.cancel()
+        return None

@@ -1,0 +1,292 @@
+// min-pk BLS signatures over BLS12-381: C API for the cometbft_tpu
+// framework (ctypes binding in cometbft_tpu/crypto/bls12381.py).
+//
+// Scheme shape follows the min-pk ciphersuite the reference's gated
+// blst path implements (/root/reference/crypto/bls12381/key_bls12381.go):
+// pubkeys are 48-byte compressed G1, signatures 96-byte compressed G2
+// (zcash flag convention), sk is a 32-byte big-endian scalar mod r.
+// See hash_to_g2.h for the documented hash-to-curve deviation.
+
+#include "pairing.h"
+#include "hash_to_g2.h"
+
+#include <cstring>
+
+namespace bls {
+
+static const char DST[] =
+    "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_";
+
+// ---------------------------------------------------------------- scalars
+
+// 4-limb scalar arithmetic mod r (non-Montgomery; sizes are tiny)
+static bool scalar_from_be(const std::uint8_t in[32], u64 out[4]) {
+    for (int i = 0; i < 4; i++) {
+        u64 v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | in[(3 - i) * 8 + j];
+        out[i] = v;
+    }
+    // reject >= r
+    for (int i = 3; i >= 0; i--) {
+        if (out[i] < ORDER_R[i]) return true;
+        if (out[i] > ORDER_R[i]) return false;
+    }
+    return false;  // == r
+}
+
+static void scalar_to_be(const u64 in[4], std::uint8_t out[32]) {
+    for (int i = 0; i < 4; i++) {
+        u64 v = in[3 - i];
+        for (int j = 0; j < 8; j++)
+            out[i * 8 + j] = (std::uint8_t)(v >> (56 - 8 * j));
+    }
+}
+
+static bool scalar_is_zero(const u64 s[4]) {
+    return (s[0] | s[1] | s[2] | s[3]) == 0;
+}
+
+// ---------------------------------------------------------------- encoding
+
+// zcash-style compression flags on byte 0: 0x80 compressed, 0x40
+// infinity, 0x20 lexicographically-largest y
+static void g1_compress(const G1 &p, std::uint8_t out[48]) {
+    if (pt_is_inf(p)) {
+        std::memset(out, 0, 48);
+        out[0] = 0xc0;
+        return;
+    }
+    Fp x, y;
+    pt_to_affine<FldFp>(p, x, y);
+    fp_to_bytes(x, out);
+    out[0] |= 0x80;
+    if (fp_is_lexicographically_largest(y)) out[0] |= 0x20;
+}
+
+static bool g1_decompress(const std::uint8_t in[48], G1 &p) {
+    std::uint8_t flags = in[0];
+    if (!(flags & 0x80)) return false;
+    if (flags & 0x40) {
+        // infinity: remaining bits must be zero
+        if (flags & 0x20) return false;
+        std::uint8_t buf[48];
+        std::memcpy(buf, in, 48);
+        buf[0] &= 0x3f;
+        for (int i = 0; i < 48; i++)
+            if (buf[i]) return false;
+        p = pt_infinity<FldFp>();
+        return true;
+    }
+    std::uint8_t buf[48];
+    std::memcpy(buf, in, 48);
+    buf[0] &= 0x1f;
+    Fp x;
+    if (!fp_from_bytes(buf, x)) return false;
+    Fp rhs = fp_add(fp_mul(fp_sqr(x), x), fp_four());
+    Fp y = fp_sqrt_candidate(rhs);
+    if (!fp_eq(fp_sqr(y), rhs)) return false;
+    bool want_large = (flags & 0x20) != 0;
+    if (fp_is_lexicographically_largest(y) != want_large) y = fp_neg(y);
+    p = {x, y, fp_one()};
+    return true;
+}
+
+static void g2_compress(const G2 &p, std::uint8_t out[96]) {
+    if (pt_is_inf(p)) {
+        std::memset(out, 0, 96);
+        out[0] = 0xc0;
+        return;
+    }
+    Fp2 x, y;
+    pt_to_affine<FldFp2>(p, x, y);
+    fp_to_bytes(x.c1, out);       // c1 first (zcash convention)
+    fp_to_bytes(x.c0, out + 48);
+    out[0] |= 0x80;
+    bool largest = fp_is_lexicographically_largest(y.c1) ||
+                   (fp_is_zero_raw(y.c1) &&
+                    fp_is_lexicographically_largest(y.c0));
+    if (largest) out[0] |= 0x20;
+}
+
+static bool g2_decompress(const std::uint8_t in[96], G2 &p) {
+    std::uint8_t flags = in[0];
+    if (!(flags & 0x80)) return false;
+    if (flags & 0x40) {
+        if (flags & 0x20) return false;
+        std::uint8_t buf[96];
+        std::memcpy(buf, in, 96);
+        buf[0] &= 0x3f;
+        for (int i = 0; i < 96; i++)
+            if (buf[i]) return false;
+        p = pt_infinity<FldFp2>();
+        return true;
+    }
+    std::uint8_t buf[48];
+    std::memcpy(buf, in, 48);
+    buf[0] &= 0x1f;
+    Fp2 x;
+    if (!fp_from_bytes(buf, x.c1)) return false;
+    if (!fp_from_bytes(in + 48, x.c0)) return false;
+    Fp2 b{fp_four(), fp_four()};
+    Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), b);
+    Fp2 y;
+    if (!fp2_sqrt(rhs, y)) return false;
+    bool want_large = (flags & 0x20) != 0;
+    bool largest = fp_is_lexicographically_largest(y.c1) ||
+                   (fp_is_zero_raw(y.c1) &&
+                    fp_is_lexicographically_largest(y.c0));
+    if (largest != want_large) y = fp2_neg(y);
+    p = {x, y, fp2_one()};
+    return true;
+}
+
+}  // namespace bls
+
+// ---------------------------------------------------------------- C API
+
+using namespace bls;
+
+extern "C" {
+
+// sk = SHA256(seed || counter) mod r, first nonzero — deterministic
+int bls_keygen(const std::uint8_t seed[32], std::uint8_t out_sk[32]) {
+    for (std::uint8_t ctr = 0; ctr < 255; ctr++) {
+        std::uint8_t buf[33];
+        std::memcpy(buf, seed, 32);
+        buf[32] = ctr;
+        std::uint8_t h[32];
+        sha256(buf, 33, h);
+        u64 s[4];
+        if (scalar_from_be(h, s) && !scalar_is_zero(s)) {
+            scalar_to_be(s, out_sk);
+            return 1;
+        }
+    }
+    return 0;
+}
+
+int bls_sk_to_pk(const std::uint8_t sk[32], std::uint8_t out_pk[48]) {
+    u64 s[4];
+    if (!scalar_from_be(sk, s) || scalar_is_zero(s)) return 0;
+    G1 pk = pt_mul<FldFp>(g1_generator(), s, 4);
+    g1_compress(pk, out_pk);
+    return 1;
+}
+
+int bls_sign(const std::uint8_t sk[32], const std::uint8_t *msg,
+             std::size_t msg_len, std::uint8_t out_sig[96]) {
+    u64 s[4];
+    if (!scalar_from_be(sk, s) || scalar_is_zero(s)) return 0;
+    G2 h = hash_to_g2(msg, msg_len, (const std::uint8_t *)DST,
+                      sizeof(DST) - 1);
+    G2 sig = pt_mul<FldFp2>(h, s, 4);
+    g2_compress(sig, out_sig);
+    return 1;
+}
+
+// 1 = valid, 0 = invalid
+int bls_verify(const std::uint8_t pk[48], const std::uint8_t *msg,
+               std::size_t msg_len, const std::uint8_t sig[96]) {
+    G1 P;
+    G2 S;
+    if (!g1_decompress(pk, P) || pt_is_inf(P)) return 0;
+    if (!g2_decompress(sig, S) || pt_is_inf(S)) return 0;
+    if (!pt_in_subgroup<FldFp>(P) || !pt_in_subgroup<FldFp2>(S)) return 0;
+    G2 H = hash_to_g2(msg, msg_len, (const std::uint8_t *)DST,
+                      sizeof(DST) - 1);
+    Fp px, py;
+    pt_to_affine<FldFp>(P, px, py);
+    Fp2 hx, hy, sx, sy;
+    pt_to_affine<FldFp2>(H, hx, hy);
+    pt_to_affine<FldFp2>(S, sx, sy);
+    Fp gx, gy;
+    pt_to_affine<FldFp>(g1_generator(), gx, gy);
+    // e(PK, H(m)) == e(G1, sig)
+    Fp12 lhs = pairing(px, py, hx, hy);
+    Fp12 rhs = pairing(gx, gy, sx, sy);
+    return fp12_eq(lhs, rhs) ? 1 : 0;
+}
+
+int bls_pk_validate(const std::uint8_t pk[48]) {
+    G1 P;
+    if (!g1_decompress(pk, P) || pt_is_inf(P)) return 0;
+    return pt_in_subgroup<FldFp>(P) ? 1 : 0;
+}
+
+// aggregate n compressed signatures (96 bytes each, concatenated)
+int bls_aggregate_sigs(const std::uint8_t *sigs, std::size_t n,
+                       std::uint8_t out[96]) {
+    G2 acc = pt_infinity<FldFp2>();
+    for (std::size_t i = 0; i < n; i++) {
+        G2 s;
+        if (!g2_decompress(sigs + 96 * i, s)) return 0;
+        acc = pt_add<FldFp2>(acc, s);
+    }
+    g2_compress(acc, out);
+    return 1;
+}
+
+int bls_aggregate_pks(const std::uint8_t *pks, std::size_t n,
+                      std::uint8_t out[48]) {
+    G1 acc = pt_infinity<FldFp>();
+    for (std::size_t i = 0; i < n; i++) {
+        G1 p;
+        if (!g1_decompress(pks + 48 * i, p)) return 0;
+        acc = pt_add<FldFp>(acc, p);
+    }
+    g1_compress(acc, out);
+    return 1;
+}
+
+// expose internals for tests
+int bls_hash_to_g2_compressed(const std::uint8_t *msg, std::size_t msg_len,
+                              const std::uint8_t *dst, std::size_t dst_len,
+                              std::uint8_t out[96]) {
+    G2 h = hash_to_g2(msg, msg_len, dst, dst_len);
+    if (pt_is_inf(h)) return 0;
+    g2_compress(h, out);
+    return 1;
+}
+
+int bls_expand_message_xmd(const std::uint8_t *msg, std::size_t msg_len,
+                           const std::uint8_t *dst, std::size_t dst_len,
+                           std::uint8_t *out, std::size_t out_len) {
+    expand_message_xmd(msg, msg_len, dst, dst_len, out, out_len);
+    return 1;
+}
+
+int bls_sha256(const std::uint8_t *msg, std::size_t len,
+               std::uint8_t out[32]) {
+    sha256(msg, len, out);
+    return 1;
+}
+
+// self-test: generators on curve + in subgroup + pairing bilinearity
+int bls_selftest(void) {
+    G1 g1 = g1_generator();
+    Fp gx, gy;
+    pt_to_affine<FldFp>(g1, gx, gy);
+    if (!g1_on_curve(gx, gy)) return 1;
+    G2 g2 = g2_generator();
+    Fp2 hx, hy;
+    pt_to_affine<FldFp2>(g2, hx, hy);
+    if (!g2_on_curve(hx, hy)) return 2;
+    if (!pt_in_subgroup<FldFp>(g1)) return 3;
+    if (!pt_in_subgroup<FldFp2>(g2)) return 4;
+    // bilinearity: e(aG1, G2) == e(G1, aG2), and != 1
+    u64 a[4] = {12345677, 0, 0, 0};
+    G1 ag1 = pt_mul<FldFp>(g1, a, 4);
+    G2 ag2 = pt_mul<FldFp2>(g2, a, 4);
+    Fp ax, ay;
+    pt_to_affine<FldFp>(ag1, ax, ay);
+    Fp2 bx, by;
+    pt_to_affine<FldFp2>(ag2, bx, by);
+    Fp12 e1 = pairing(ax, ay, hx, hy);
+    Fp12 e2 = pairing(gx, gy, bx, by);
+    if (!fp12_eq(e1, e2)) return 5;
+    Fp12 e0 = pairing(gx, gy, hx, hy);
+    if (fp12_eq(e0, fp12_one())) return 6;
+    return 0;
+}
+
+}  // extern "C"

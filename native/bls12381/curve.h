@@ -1,0 +1,231 @@
+// BLS12-381 curve groups: G1 = E(Fp): y^2 = x^3 + 4,
+// G2 = E'(Fp2): y^2 = x^3 + 4(1+u)  (M-twist), Jacobian coordinates.
+#pragma once
+
+#include "fp_tower.h"
+
+namespace bls {
+
+// scalar field order r (little-endian limbs)
+static const u64 ORDER_R[4] = {
+    0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
+    0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL};
+
+// RFC-9380 effective cofactor for G2 cofactor clearing (507 bits)
+static const u64 G2_COFACTOR[8] = {
+    0xcf1c38e31c7238e5ULL, 0x1616ec6e786f0c70ULL, 0x21537e293a6691aeULL,
+    0xa628f1cb4d9e82efULL, 0xa68a205b2e5a7ddfULL, 0xcd91de4547085abaULL,
+    0x091d50792876a202ULL, 0x05d543a95414e7f1ULL};
+
+// field trait adapters so one Jacobian implementation serves both groups
+struct FldFp {
+    using T = Fp;
+    static T zero() { return fp_zero(); }
+    static T one() { return fp_one(); }
+    static T add(const T &a, const T &b) { return fp_add(a, b); }
+    static T sub(const T &a, const T &b) { return fp_sub(a, b); }
+    static T neg(const T &a) { return fp_neg(a); }
+    static T mul(const T &a, const T &b) { return fp_mul(a, b); }
+    static T sqr(const T &a) { return fp_sqr(a); }
+    static T inv(const T &a) { return fp_inv(a); }
+    static bool is_zero(const T &a) { return fp_is_zero_raw(a); }
+    static bool eq(const T &a, const T &b) { return fp_eq(a, b); }
+};
+
+struct FldFp2 {
+    using T = Fp2;
+    static T zero() { return fp2_zero(); }
+    static T one() { return fp2_one(); }
+    static T add(const T &a, const T &b) { return fp2_add(a, b); }
+    static T sub(const T &a, const T &b) { return fp2_sub(a, b); }
+    static T neg(const T &a) { return fp2_neg(a); }
+    static T mul(const T &a, const T &b) { return fp2_mul(a, b); }
+    static T sqr(const T &a) { return fp2_sqr(a); }
+    static T inv(const T &a) { return fp2_inv(a); }
+    static bool is_zero(const T &a) { return fp2_is_zero(a); }
+    static bool eq(const T &a, const T &b) { return fp2_eq(a, b); }
+};
+
+template <typename F>
+struct Point {
+    typename F::T X, Y, Z;  // Jacobian; Z==0 => infinity
+};
+
+template <typename F>
+inline Point<F> pt_infinity() {
+    return {F::one(), F::one(), F::zero()};
+}
+
+template <typename F>
+inline bool pt_is_inf(const Point<F> &p) { return F::is_zero(p.Z); }
+
+template <typename F>
+inline Point<F> pt_double(const Point<F> &p) {
+    if (pt_is_inf(p)) return p;
+    // dbl-2009-l (a=0): A=X^2, B=Y^2, C=B^2, D=2((X+B)^2-A-C),
+    // E=3A, F=E^2, X3=F-2D, Y3=E(D-X3)-8C, Z3=2YZ
+    auto A = F::sqr(p.X);
+    auto B = F::sqr(p.Y);
+    auto C = F::sqr(B);
+    auto t = F::sqr(F::add(p.X, B));
+    auto D = F::sub(F::sub(t, A), C);
+    D = F::add(D, D);
+    auto E = F::add(F::add(A, A), A);
+    auto Fo = F::sqr(E);
+    auto X3 = F::sub(Fo, F::add(D, D));
+    auto C8 = F::add(C, C);
+    C8 = F::add(C8, C8);
+    C8 = F::add(C8, C8);
+    auto Y3 = F::sub(F::mul(E, F::sub(D, X3)), C8);
+    auto Z3 = F::mul(p.Y, p.Z);
+    Z3 = F::add(Z3, Z3);
+    return {X3, Y3, Z3};
+}
+
+template <typename F>
+inline Point<F> pt_add(const Point<F> &p, const Point<F> &q) {
+    if (pt_is_inf(p)) return q;
+    if (pt_is_inf(q)) return p;
+    // add-2007-bl
+    auto Z1Z1 = F::sqr(p.Z);
+    auto Z2Z2 = F::sqr(q.Z);
+    auto U1 = F::mul(p.X, Z2Z2);
+    auto U2 = F::mul(q.X, Z1Z1);
+    auto S1 = F::mul(F::mul(p.Y, q.Z), Z2Z2);
+    auto S2 = F::mul(F::mul(q.Y, p.Z), Z1Z1);
+    if (F::eq(U1, U2)) {
+        if (F::eq(S1, S2)) return pt_double<F>(p);
+        return pt_infinity<F>();
+    }
+    auto H = F::sub(U2, U1);
+    auto I = F::sqr(F::add(H, H));
+    auto J = F::mul(H, I);
+    auto rr = F::sub(S2, S1);
+    rr = F::add(rr, rr);
+    auto V = F::mul(U1, I);
+    auto X3 = F::sub(F::sub(F::sqr(rr), J), F::add(V, V));
+    auto S1J = F::mul(S1, J);
+    auto Y3 = F::sub(F::mul(rr, F::sub(V, X3)), F::add(S1J, S1J));
+    auto Z3 = F::mul(F::mul(p.Z, q.Z), H);   // 2*Z1*Z2*H
+    Z3 = F::add(Z3, Z3);
+    return {X3, Y3, Z3};
+}
+
+template <typename F>
+inline Point<F> pt_neg(const Point<F> &p) {
+    return {p.X, F::neg(p.Y), p.Z};
+}
+
+// scalar multiplication, scalar as n little-endian u64 limbs
+template <typename F>
+inline Point<F> pt_mul(const Point<F> &p, const u64 *e, int nlimbs) {
+    Point<F> acc = pt_infinity<F>();
+    for (int i = nlimbs - 1; i >= 0; i--) {
+        for (int b = 63; b >= 0; b--) {
+            acc = pt_double<F>(acc);
+            if ((e[i] >> b) & 1) acc = pt_add<F>(acc, p);
+        }
+    }
+    return acc;
+}
+
+template <typename F>
+inline void pt_to_affine(const Point<F> &p, typename F::T &x,
+                         typename F::T &y) {
+    auto zi = F::inv(p.Z);
+    auto zi2 = F::sqr(zi);
+    x = F::mul(p.X, zi2);
+    y = F::mul(p.Y, F::mul(zi2, zi));
+}
+
+template <typename F>
+inline bool pt_eq(const Point<F> &p, const Point<F> &q) {
+    bool pi = pt_is_inf(p), qi = pt_is_inf(q);
+    if (pi || qi) return pi == qi;
+    auto Z1Z1 = F::sqr(p.Z);
+    auto Z2Z2 = F::sqr(q.Z);
+    if (!F::eq(F::mul(p.X, Z2Z2), F::mul(q.X, Z1Z1))) return false;
+    return F::eq(F::mul(p.Y, F::mul(Z2Z2, q.Z)),
+                 F::mul(q.Y, F::mul(Z1Z1, p.Z)));
+}
+
+using G1 = Point<FldFp>;
+using G2 = Point<FldFp2>;
+
+// generators (verified on-curve and of order r at init)
+inline G1 g1_generator() {
+    static const std::uint8_t gx[48] = {
+        0x17, 0xf1, 0xd3, 0xa7, 0x31, 0x97, 0xd7, 0x94, 0x26, 0x95, 0x63,
+        0x8c, 0x4f, 0xa9, 0xac, 0x0f, 0xc3, 0x68, 0x8c, 0x4f, 0x97, 0x74,
+        0xb9, 0x05, 0xa1, 0x4e, 0x3a, 0x3f, 0x17, 0x1b, 0xac, 0x58, 0x6c,
+        0x55, 0xe8, 0x3f, 0xf9, 0x7a, 0x1a, 0xef, 0xfb, 0x3a, 0xf0, 0x0a,
+        0xdb, 0x22, 0xc6, 0xbb};
+    static const std::uint8_t gy[48] = {
+        0x08, 0xb3, 0xf4, 0x81, 0xe3, 0xaa, 0xa0, 0xf1, 0xa0, 0x9e, 0x30,
+        0xed, 0x74, 0x1d, 0x8a, 0xe4, 0xfc, 0xf5, 0xe0, 0x95, 0xd5, 0xd0,
+        0x0a, 0xf6, 0x00, 0xdb, 0x18, 0xcb, 0x2c, 0x04, 0xb3, 0xed, 0xd0,
+        0x3c, 0xc7, 0x44, 0xa2, 0x88, 0x8a, 0xe4, 0x0c, 0xaa, 0x23, 0x29,
+        0x46, 0xc5, 0xe7, 0xe1};
+    G1 g;
+    fp_from_bytes(gx, g.X);
+    fp_from_bytes(gy, g.Y);
+    g.Z = fp_one();
+    return g;
+}
+
+inline void hex48(const char *h, std::uint8_t out[48]) {
+    auto nib = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        return c - 'A' + 10;
+    };
+    for (int i = 0; i < 48; i++)
+        out[i] = (std::uint8_t)((nib(h[2 * i]) << 4) | nib(h[2 * i + 1]));
+}
+
+inline G2 g2_generator() {
+    std::uint8_t b[48];
+    G2 g;
+    hex48("024aa2b2f08f0a91260805272dc51051c6e47ad4fa403b02b4510b647ae3d1"
+          "770bac0326a805bbefd48056c8c121bdb8", b);
+    fp_from_bytes(b, g.X.c0);
+    hex48("13e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f50"
+          "49334cf11213945d57e5ac7d055d042b7e", b);
+    fp_from_bytes(b, g.X.c1);
+    hex48("0ce5d527727d6e118cc9cdc6da2e351aadfd9baa8cbdd3a76d429a695160d1"
+          "2c923ac9cc3baca289e193548608b82801", b);
+    fp_from_bytes(b, g.Y.c0);
+    hex48("0606c4a02ea734cc32acd2b02bc28b99cb3e287e85a763af267492ab572e99"
+          "ab3f370d275cec1da1aaa9075ff05f79be", b);
+    fp_from_bytes(b, g.Y.c1);
+    g.Z = fp2_one();
+    return g;
+}
+
+inline Fp fp_four() {
+    Fp f{};
+    f.l[0] = 4;
+    return fp_to_mont(f);
+}
+
+// curve membership (affine): y^2 == x^3 + 4
+inline bool g1_on_curve(const Fp &x, const Fp &y) {
+    Fp lhs = fp_sqr(y);
+    Fp rhs = fp_add(fp_mul(fp_sqr(x), x), fp_four());
+    return fp_eq(lhs, rhs);
+}
+
+// y^2 == x^3 + 4(1+u)
+inline bool g2_on_curve(const Fp2 &x, const Fp2 &y) {
+    Fp2 b{fp_four(), fp_four()};
+    Fp2 lhs = fp2_sqr(y);
+    Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), b);
+    return fp2_eq(lhs, rhs);
+}
+
+template <typename F>
+inline bool pt_in_subgroup(const Point<F> &p) {
+    return pt_is_inf(pt_mul<F>(p, ORDER_R, 4));
+}
+
+}  // namespace bls

@@ -1,0 +1,256 @@
+// BLS12-381 base field GF(p), p = 0x1a0111ea...aaab (381 bits), as
+// 6 x 64-bit limbs in Montgomery form (R = 2^384).
+//
+// From-scratch implementation for the cometbft_tpu framework's
+// min-pk BLS scheme (reference analog: the CGO blst library behind
+// /root/reference/crypto/bls12381/key_bls12381.go — the reference's
+// only native-code crypto path; here the native path is this C++).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace bls {
+
+using u64 = std::uint64_t;
+using u128 = unsigned __int128;
+
+// p, little-endian limbs
+static const u64 P[6] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL,
+    0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL};
+
+// -p^{-1} mod 2^64
+static const u64 P_INV = 0x89f3fffcfffcfffdULL;
+
+// R = 2^384 mod p
+static const u64 R1[6] = {
+    0x760900000002fffdULL, 0xebf4000bc40c0002ULL, 0x5f48985753c758baULL,
+    0x77ce585370525745ULL, 0x5c071a97a256ec6dULL, 0x15f65ec3fa80e493ULL};
+
+// R^2 mod p (for to_mont via mont_mul(a, R2))
+static const u64 R2[6] = {
+    0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL, 0x8de5476c4c95b6d5ULL,
+    0x67eb88a9939d83c0ULL, 0x9a793e85b519952dULL, 0x11988fe592cae3aaULL};
+
+struct Fp {
+    u64 l[6];
+};
+
+inline bool fp_is_zero_raw(const Fp &a) {
+    u64 x = 0;
+    for (int i = 0; i < 6; i++) x |= a.l[i];
+    return x == 0;
+}
+
+inline int fp_cmp_raw(const u64 a[6], const u64 b[6]) {
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+// a + b mod p
+inline Fp fp_add(const Fp &a, const Fp &b) {
+    Fp r;
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)a.l[i] + b.l[i];
+        r.l[i] = (u64)c;
+        c >>= 64;
+    }
+    // subtract p if >= p (or if carried out)
+    if (c || fp_cmp_raw(r.l, P) >= 0) {
+        u128 borrow = 0;
+        for (int i = 0; i < 6; i++) {
+            u128 d = (u128)r.l[i] - P[i] - borrow;
+            r.l[i] = (u64)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+    return r;
+}
+
+inline Fp fp_sub(const Fp &a, const Fp &b) {
+    Fp r;
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a.l[i] - b.l[i] - borrow;
+        r.l[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+    if (borrow) {
+        u128 c = 0;
+        for (int i = 0; i < 6; i++) {
+            c += (u128)r.l[i] + P[i];
+            r.l[i] = (u64)c;
+            c >>= 64;
+        }
+    }
+    return r;
+}
+
+inline Fp fp_neg(const Fp &a) {
+    Fp zero{};
+    if (fp_is_zero_raw(a)) return zero;
+    Fp r;
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)P[i] - a.l[i] - borrow;
+        r.l[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+    return r;
+}
+
+// Montgomery product: a * b * R^{-1} mod p  (CIOS)
+inline Fp fp_mul(const Fp &a, const Fp &b) {
+    u64 t[8] = {0};
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)t[j] + (u128)a.l[i] * b.l[j];
+            t[j] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[6] = (u64)c;
+        t[7] = (u64)(c >> 64);
+
+        u64 m = t[0] * P_INV;
+        c = (u128)t[0] + (u128)m * P[0];
+        c >>= 64;
+        for (int j = 1; j < 6; j++) {
+            c += (u128)t[j] + (u128)m * P[j];
+            t[j - 1] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[5] = (u64)c;
+        t[6] = t[7] + (u64)(c >> 64);
+        t[7] = 0;
+    }
+    Fp r;
+    std::memcpy(r.l, t, 48);
+    if (t[6] || fp_cmp_raw(r.l, P) >= 0) {
+        u128 borrow = 0;
+        for (int i = 0; i < 6; i++) {
+            u128 d = (u128)r.l[i] - P[i] - borrow;
+            r.l[i] = (u64)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+    return r;
+}
+
+inline Fp fp_sqr(const Fp &a) { return fp_mul(a, a); }
+
+inline Fp fp_to_mont(const Fp &a) {
+    Fp r2;
+    std::memcpy(r2.l, R2, 48);
+    return fp_mul(a, r2);
+}
+
+inline Fp fp_from_mont(const Fp &a) {
+    Fp one{};
+    one.l[0] = 1;
+    return fp_mul(a, one);
+}
+
+inline Fp fp_one() {
+    Fp r;
+    std::memcpy(r.l, R1, 48);
+    return r;
+}
+
+inline Fp fp_zero() { return Fp{}; }
+
+inline bool fp_eq(const Fp &a, const Fp &b) {
+    u64 x = 0;
+    for (int i = 0; i < 6; i++) x |= a.l[i] ^ b.l[i];
+    return x == 0;
+}
+
+// a^e for big-endian bit scan of a 6-limb exponent (variable time —
+// verification-side use only)
+inline Fp fp_pow(const Fp &a, const u64 e[6]) {
+    Fp r = fp_one();
+    bool started = false;
+    for (int i = 5; i >= 0; i--) {
+        for (int b = 63; b >= 0; b--) {
+            if (started) r = fp_sqr(r);
+            if ((e[i] >> b) & 1) {
+                if (started) r = fp_mul(r, a);
+                else { r = a; started = true; }
+            }
+        }
+    }
+    return started ? r : fp_one();
+}
+
+inline Fp fp_inv(const Fp &a) {
+    // a^(p-2)
+    u64 e[6];
+    std::memcpy(e, P, 48);
+    // p - 2 (p is odd, low limb ends in ...aaab)
+    e[0] -= 2;
+    return fp_pow(a, e);
+}
+
+// sqrt for p ≡ 3 (mod 4): a^((p+1)/4); caller must check sqr(result)==a
+inline Fp fp_sqrt_candidate(const Fp &a) {
+    // (p+1)/4
+    u64 e[6];
+    u128 c = 1;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)P[i];
+        e[i] = (u64)c;
+        c >>= 64;
+    }
+    // shift right by 2
+    for (int i = 0; i < 6; i++) {
+        e[i] = (e[i] >> 2) | (i < 5 ? (e[i + 1] << 62) : 0);
+    }
+    return fp_pow(a, e);
+}
+
+// 48-byte big-endian <-> Fp (non-Montgomery raw value)
+inline bool fp_from_bytes(const std::uint8_t in[48], Fp &out) {
+    for (int i = 0; i < 6; i++) {
+        u64 v = 0;
+        for (int j = 0; j < 8; j++)
+            v = (v << 8) | in[(5 - i) * 8 + j];
+        out.l[i] = v;
+    }
+    if (fp_cmp_raw(out.l, P) >= 0) return false;
+    out = fp_to_mont(out);
+    return true;
+}
+
+inline void fp_to_bytes(const Fp &a, std::uint8_t out[48]) {
+    Fp raw = fp_from_mont(a);
+    for (int i = 0; i < 6; i++) {
+        u64 v = raw.l[5 - i];
+        for (int j = 0; j < 8; j++)
+            out[i * 8 + j] = (std::uint8_t)(v >> (56 - 8 * j));
+    }
+}
+
+// sign: lexicographically-largest convention (zcash): y > (p-1)/2
+inline bool fp_is_lexicographically_largest(const Fp &a) {
+    Fp raw = fp_from_mont(a);
+    // compare 2*raw vs p: raw > (p-1)/2  <=>  2*raw > p-1  <=> 2*raw >= p+1
+    u64 d[7] = {0};
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)raw.l[i] * 2;
+        d[i] = (u64)c;
+        c >>= 64;
+    }
+    d[6] = (u64)c;
+    if (d[6]) return true;
+    return fp_cmp_raw(d, P) > 0;
+}
+
+}  // namespace bls

@@ -1,0 +1,222 @@
+// BLS12-381 extension tower:
+//   Fp2  = Fp[u]  / (u^2 + 1)
+//   Fp6  = Fp2[v] / (v^3 - xi),  xi = 1 + u
+//   Fp12 = Fp6[w] / (w^2 - v)
+#pragma once
+
+#include "fp.h"
+
+namespace bls {
+
+// ---------------------------------------------------------------- Fp2
+
+struct Fp2 {
+    Fp c0, c1;  // c0 + c1*u
+};
+
+inline Fp2 fp2_zero() { return {fp_zero(), fp_zero()}; }
+inline Fp2 fp2_one() { return {fp_one(), fp_zero()}; }
+
+inline bool fp2_is_zero(const Fp2 &a) {
+    return fp_is_zero_raw(a.c0) && fp_is_zero_raw(a.c1);
+}
+
+inline bool fp2_eq(const Fp2 &a, const Fp2 &b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+
+inline Fp2 fp2_add(const Fp2 &a, const Fp2 &b) {
+    return {fp_add(a.c0, b.c0), fp_add(a.c1, b.c1)};
+}
+
+inline Fp2 fp2_sub(const Fp2 &a, const Fp2 &b) {
+    return {fp_sub(a.c0, b.c0), fp_sub(a.c1, b.c1)};
+}
+
+inline Fp2 fp2_neg(const Fp2 &a) { return {fp_neg(a.c0), fp_neg(a.c1)}; }
+
+inline Fp2 fp2_conj(const Fp2 &a) { return {a.c0, fp_neg(a.c1)}; }
+
+inline Fp2 fp2_mul(const Fp2 &a, const Fp2 &b) {
+    // (a0+a1u)(b0+b1u) = (a0b0 - a1b1) + (a0b1 + a1b0)u
+    Fp t0 = fp_mul(a.c0, b.c0);
+    Fp t1 = fp_mul(a.c1, b.c1);
+    Fp s0 = fp_add(a.c0, a.c1);
+    Fp s1 = fp_add(b.c0, b.c1);
+    Fp t2 = fp_mul(s0, s1);  // a0b0 + a0b1 + a1b0 + a1b1
+    return {fp_sub(t0, t1), fp_sub(fp_sub(t2, t0), t1)};
+}
+
+inline Fp2 fp2_sqr(const Fp2 &a) {
+    // (a0+a1u)^2 = (a0+a1)(a0-a1) + 2a0a1 u
+    Fp s = fp_add(a.c0, a.c1);
+    Fp d = fp_sub(a.c0, a.c1);
+    Fp m = fp_mul(a.c0, a.c1);
+    return {fp_mul(s, d), fp_add(m, m)};
+}
+
+inline Fp2 fp2_mul_fp(const Fp2 &a, const Fp &b) {
+    return {fp_mul(a.c0, b), fp_mul(a.c1, b)};
+}
+
+// multiply by xi = 1 + u
+inline Fp2 fp2_mul_xi(const Fp2 &a) {
+    return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
+}
+
+inline Fp2 fp2_inv(const Fp2 &a) {
+    // 1/(a0+a1u) = (a0 - a1u) / (a0^2 + a1^2)
+    Fp n = fp_add(fp_sqr(a.c0), fp_sqr(a.c1));
+    Fp ni = fp_inv(n);
+    return {fp_mul(a.c0, ni), fp_neg(fp_mul(a.c1, ni))};
+}
+
+// sqrt in Fp2 for p ≡ 3 (mod 4); returns false if a is not a square
+inline bool fp2_sqrt(const Fp2 &a, Fp2 &out) {
+    if (fp2_is_zero(a)) {
+        out = fp2_zero();
+        return true;
+    }
+    if (fp_is_zero_raw(a.c1)) {
+        // sqrt(a0): either sqrt(a0) in Fp or sqrt(-a0)*u
+        Fp s = fp_sqrt_candidate(a.c0);
+        if (fp_eq(fp_sqr(s), a.c0)) {
+            out = {s, fp_zero()};
+            return true;
+        }
+        Fp na = fp_neg(a.c0);
+        s = fp_sqrt_candidate(na);
+        if (fp_eq(fp_sqr(s), na)) {
+            out = {fp_zero(), s};
+            return true;
+        }
+        return false;
+    }
+    // norm = a0^2 + a1^2 must be a QR in Fp
+    Fp n = fp_add(fp_sqr(a.c0), fp_sqr(a.c1));
+    Fp s = fp_sqrt_candidate(n);
+    if (!fp_eq(fp_sqr(s), n)) return false;
+    // x^2 = (a0 + s)/2 (or (a0 - s)/2)
+    Fp two_inv = fp_inv(fp_add(fp_one(), fp_one()));
+    Fp t = fp_mul(fp_add(a.c0, s), two_inv);
+    Fp x = fp_sqrt_candidate(t);
+    if (!fp_eq(fp_sqr(x), t)) {
+        t = fp_mul(fp_sub(a.c0, s), two_inv);
+        x = fp_sqrt_candidate(t);
+        if (!fp_eq(fp_sqr(x), t)) return false;
+    }
+    // y = a1 / (2x)
+    Fp y = fp_mul(a.c1, fp_inv(fp_add(x, x)));
+    out = {x, y};
+    // final check
+    Fp2 chk = fp2_sqr(out);
+    return fp2_eq(chk, a);
+}
+
+// ---------------------------------------------------------------- Fp6
+
+struct Fp6 {
+    Fp2 c0, c1, c2;  // c0 + c1 v + c2 v^2
+};
+
+inline Fp6 fp6_zero() { return {fp2_zero(), fp2_zero(), fp2_zero()}; }
+inline Fp6 fp6_one() { return {fp2_one(), fp2_zero(), fp2_zero()}; }
+
+inline bool fp6_is_zero(const Fp6 &a) {
+    return fp2_is_zero(a.c0) && fp2_is_zero(a.c1) && fp2_is_zero(a.c2);
+}
+
+inline bool fp6_eq(const Fp6 &a, const Fp6 &b) {
+    return fp2_eq(a.c0, b.c0) && fp2_eq(a.c1, b.c1) && fp2_eq(a.c2, b.c2);
+}
+
+inline Fp6 fp6_add(const Fp6 &a, const Fp6 &b) {
+    return {fp2_add(a.c0, b.c0), fp2_add(a.c1, b.c1), fp2_add(a.c2, b.c2)};
+}
+
+inline Fp6 fp6_sub(const Fp6 &a, const Fp6 &b) {
+    return {fp2_sub(a.c0, b.c0), fp2_sub(a.c1, b.c1), fp2_sub(a.c2, b.c2)};
+}
+
+inline Fp6 fp6_neg(const Fp6 &a) {
+    return {fp2_neg(a.c0), fp2_neg(a.c1), fp2_neg(a.c2)};
+}
+
+inline Fp6 fp6_mul(const Fp6 &a, const Fp6 &b) {
+    // schoolbook with v^3 = xi
+    Fp2 a0b0 = fp2_mul(a.c0, b.c0);
+    Fp2 a1b1 = fp2_mul(a.c1, b.c1);
+    Fp2 a2b2 = fp2_mul(a.c2, b.c2);
+    // c0 = a0b0 + xi(a1b2 + a2b1)
+    Fp2 t = fp2_add(fp2_mul(a.c1, b.c2), fp2_mul(a.c2, b.c1));
+    Fp2 c0 = fp2_add(a0b0, fp2_mul_xi(t));
+    // c1 = a0b1 + a1b0 + xi a2b2
+    Fp2 c1 = fp2_add(fp2_add(fp2_mul(a.c0, b.c1), fp2_mul(a.c1, b.c0)),
+                     fp2_mul_xi(a2b2));
+    // c2 = a0b2 + a1b1 + a2b0
+    Fp2 c2 = fp2_add(fp2_add(fp2_mul(a.c0, b.c2), a1b1),
+                     fp2_mul(a.c2, b.c0));
+    return {c0, c1, c2};
+}
+
+inline Fp6 fp6_sqr(const Fp6 &a) { return fp6_mul(a, a); }
+
+inline Fp6 fp6_mul_v(const Fp6 &a) {
+    // (c0 + c1 v + c2 v^2) * v = xi c2 + c0 v + c1 v^2
+    return {fp2_mul_xi(a.c2), a.c0, a.c1};
+}
+
+inline Fp6 fp6_mul_fp2(const Fp6 &a, const Fp2 &b) {
+    return {fp2_mul(a.c0, b), fp2_mul(a.c1, b), fp2_mul(a.c2, b)};
+}
+
+inline Fp6 fp6_inv(const Fp6 &a) {
+    // standard: A = c0^2 - xi c1 c2, B = xi c2^2 - c0 c1,
+    //           C = c1^2 - c0 c2, F = c0 A + xi(c2 B + c1 C)
+    Fp2 A = fp2_sub(fp2_sqr(a.c0), fp2_mul_xi(fp2_mul(a.c1, a.c2)));
+    Fp2 B = fp2_sub(fp2_mul_xi(fp2_sqr(a.c2)), fp2_mul(a.c0, a.c1));
+    Fp2 C = fp2_sub(fp2_sqr(a.c1), fp2_mul(a.c0, a.c2));
+    Fp2 F = fp2_add(fp2_mul(a.c0, A),
+                    fp2_mul_xi(fp2_add(fp2_mul(a.c2, B),
+                                       fp2_mul(a.c1, C))));
+    Fp2 Fi = fp2_inv(F);
+    return {fp2_mul(A, Fi), fp2_mul(B, Fi), fp2_mul(C, Fi)};
+}
+
+// ---------------------------------------------------------------- Fp12
+
+struct Fp12 {
+    Fp6 c0, c1;  // c0 + c1 w, w^2 = v
+};
+
+inline Fp12 fp12_zero() { return {fp6_zero(), fp6_zero()}; }
+inline Fp12 fp12_one() { return {fp6_one(), fp6_zero()}; }
+
+inline bool fp12_eq(const Fp12 &a, const Fp12 &b) {
+    return fp6_eq(a.c0, b.c0) && fp6_eq(a.c1, b.c1);
+}
+
+inline Fp12 fp12_mul(const Fp12 &a, const Fp12 &b) {
+    Fp6 t0 = fp6_mul(a.c0, b.c0);
+    Fp6 t1 = fp6_mul(a.c1, b.c1);
+    // (a0+a1w)(b0+b1w) = a0b0 + v a1b1 + (a0b1 + a1b0) w
+    Fp6 s0 = fp6_add(a.c0, a.c1);
+    Fp6 s1 = fp6_add(b.c0, b.c1);
+    Fp6 t2 = fp6_mul(s0, s1);
+    Fp6 c1 = fp6_sub(fp6_sub(t2, t0), t1);
+    Fp6 c0 = fp6_add(t0, fp6_mul_v(t1));
+    return {c0, c1};
+}
+
+inline Fp12 fp12_sqr(const Fp12 &a) { return fp12_mul(a, a); }
+
+inline Fp12 fp12_conj(const Fp12 &a) { return {a.c0, fp6_neg(a.c1)}; }
+
+inline Fp12 fp12_inv(const Fp12 &a) {
+    // 1/(a0+a1w) = (a0 - a1w)/(a0^2 - v a1^2)
+    Fp6 n = fp6_sub(fp6_sqr(a.c0), fp6_mul_v(fp6_sqr(a.c1)));
+    Fp6 ni = fp6_inv(n);
+    return {fp6_mul(a.c0, ni), fp6_neg(fp6_mul(a.c1, ni))};
+}
+
+}  // namespace bls

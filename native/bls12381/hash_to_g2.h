@@ -1,0 +1,129 @@
+// Deterministic hash-to-G2.
+//
+// expand_message_xmd(SHA-256) follows RFC 9380 §5.3.1 exactly.  The
+// map-to-curve step is a documented DEVIATION from the RFC's SSWU
+// ciphersuite: the SSWU 3-isogeny constants are not derivable offline,
+// so the uniform bytes seed a deterministic try-and-increment over x
+// candidates in Fp2 followed by effective-cofactor clearing.  The
+// result is a uniform-looking, deterministic, subgroup-correct map —
+// every BLS property holds; only cross-library signature equality for
+// the SAME message differs from blst.  Swapping in RFC SSWU later
+// touches only map_to_g2().
+#pragma once
+
+#include "curve.h"
+#include "sha256.h"
+
+#include <vector>
+
+namespace bls {
+
+// RFC 9380 expand_message_xmd with SHA-256
+inline void expand_message_xmd(const std::uint8_t *msg, std::size_t msg_len,
+                               const std::uint8_t *dst, std::size_t dst_len,
+                               std::uint8_t *out, std::size_t len) {
+    const std::size_t b_in_bytes = 32, r_in_bytes = 64;
+    std::size_t ell = (len + b_in_bytes - 1) / b_in_bytes;
+    // DST longer than 255: hash it (RFC 9380 §5.3.3)
+    std::uint8_t dst_prime[256];
+    std::size_t dst_prime_len;
+    if (dst_len > 255) {
+        static const char *prefix = "H2C-OVERSIZE-DST-";
+        Sha256 s;
+        s.update((const std::uint8_t *)prefix, 17);
+        s.update(dst, dst_len);
+        s.final(dst_prime);
+        dst_prime_len = 32;
+    } else {
+        std::memcpy(dst_prime, dst, dst_len);
+        dst_prime_len = dst_len;
+    }
+    dst_prime[dst_prime_len] = (std::uint8_t)dst_prime_len;
+    dst_prime_len += 1;
+
+    std::uint8_t b0[32];
+    {
+        Sha256 s;
+        std::uint8_t z_pad[r_in_bytes] = {0};
+        s.update(z_pad, r_in_bytes);
+        s.update(msg, msg_len);
+        std::uint8_t l_i_b[3] = {(std::uint8_t)(len >> 8),
+                                 (std::uint8_t)len, 0};
+        s.update(l_i_b, 3);
+        s.update(dst_prime, dst_prime_len);
+        s.final(b0);
+    }
+    std::uint8_t bi[32];
+    std::size_t off = 0;
+    for (std::size_t i = 1; i <= ell; i++) {
+        Sha256 s;
+        if (i == 1) {
+            s.update(b0, 32);
+        } else {
+            std::uint8_t x[32];
+            for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+            s.update(x, 32);
+        }
+        std::uint8_t ib = (std::uint8_t)i;
+        s.update(&ib, 1);
+        s.update(dst_prime, dst_prime_len);
+        s.final(bi);
+        std::size_t take = len - off < 32 ? len - off : 32;
+        std::memcpy(out + off, bi, take);
+        off += take;
+    }
+}
+
+// 64 uniform bytes -> Fp via big-int mod p (RFC hash_to_field shape)
+inline Fp fp_from_wide(const std::uint8_t in[64]) {
+    // interpret big-endian 512-bit, reduce mod p via repeated folding:
+    // split hi*2^256 + lo; compute in limbs with schoolbook mod
+    // simple approach: process byte by byte: acc = acc*256 + b (mod p)
+    Fp acc = fp_zero();
+    Fp b256{};
+    b256.l[0] = 256;
+    Fp mont256 = fp_to_mont(b256);
+    for (int i = 0; i < 64; i++) {
+        acc = fp_mul(acc, mont256);
+        Fp d{};
+        d.l[0] = in[i];
+        acc = fp_add(acc, fp_to_mont(d));
+    }
+    return acc;
+}
+
+// deterministic map: try x = u0 + ctr (in Fp2) until x^3 + 4(1+u) is a
+// square; y sign chosen by a byte of the uniform input
+inline G2 map_to_g2(const std::uint8_t uniform[160]) {
+    Fp2 x;
+    x.c0 = fp_from_wide(uniform);
+    x.c1 = fp_from_wide(uniform + 64);
+    bool sign = (uniform[128] & 1) != 0;
+    Fp2 b{fp_four(), fp_four()};
+    Fp2 one = fp2_one();
+    for (int ctr = 0; ctr < 1000; ctr++) {
+        Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), b);
+        Fp2 y;
+        if (fp2_sqrt(rhs, y)) {
+            // canonical sign then flip per the hash bit
+            bool largest = fp_is_lexicographically_largest(y.c1) ||
+                           (fp_is_zero_raw(y.c1) &&
+                            fp_is_lexicographically_largest(y.c0));
+            if (largest != sign) y = fp2_neg(y);
+            G2 p{x, y, fp2_one()};
+            // clear cofactor onto the r-torsion subgroup
+            return pt_mul<FldFp2>(p, G2_COFACTOR, 8);
+        }
+        x.c0 = fp_add(x.c0, one.c0);
+    }
+    return pt_infinity<FldFp2>();  // unreachable in practice
+}
+
+inline G2 hash_to_g2(const std::uint8_t *msg, std::size_t msg_len,
+                     const std::uint8_t *dst, std::size_t dst_len) {
+    std::uint8_t uniform[160];
+    expand_message_xmd(msg, msg_len, dst, dst_len, uniform, 160);
+    return map_to_g2(uniform);
+}
+
+}  // namespace bls

@@ -1,0 +1,142 @@
+"""BLS12-381 min-pk scheme over the from-scratch native C++ library
+(native/bls12381; reference analog crypto/bls12381/key_bls12381.go via
+blst, build-tag gated — here gated on the compiled .so).
+
+Coverage mirrors the reference's key_test.go shape (sign/verify,
+tamper, encodings) plus the algebra the reference gets for free from
+blst: pairing bilinearity runs in the C self-test at library load.
+"""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as bls
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not bls.build():
+        pytest.skip("g++ unavailable; bls12381 stays gated off")
+
+
+def test_enabled_after_build():
+    assert bls.enabled()
+
+
+def test_sha256_native_matches_hashlib():
+    lib = bls._load()
+    import ctypes
+    out = ctypes.create_string_buffer(32)
+    lib.bls_sha256(b"abc", 3, out)
+    assert out.raw == hashlib.sha256(b"abc").digest()
+    lib.bls_sha256(b"", 0, out)
+    assert out.raw == hashlib.sha256(b"").digest()
+    long = b"x" * 1000
+    lib.bls_sha256(long, len(long), out)
+    assert out.raw == hashlib.sha256(long).digest()
+
+
+def test_keygen_deterministic():
+    k1 = bls.PrivKey.generate(b"\x07" * 32)
+    k2 = bls.PrivKey.generate(b"\x07" * 32)
+    k3 = bls.PrivKey.generate(b"\x08" * 32)
+    assert k1.data == k2.data != k3.data
+    assert len(k1.data) == 32
+    assert k1.type() == "bls12_381"
+
+
+def test_sign_verify_roundtrip():
+    priv = bls.PrivKey.generate(b"\x01" * 32)
+    pub = priv.pub_key()
+    assert len(pub.data) == 48
+    assert pub.validate()
+    msg = b"tendermint over bls"
+    sig = priv.sign(msg)
+    assert len(sig) == 96
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(b"other message", sig)
+    bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+    assert not pub.verify_signature(msg, bad)
+    assert not pub.verify_signature(msg, b"\x00" * 96)
+    assert not pub.verify_signature(msg, sig[:-1])
+
+
+def test_signature_deterministic_and_distinct():
+    priv = bls.PrivKey.generate(b"\x02" * 32)
+    assert priv.sign(b"m") == priv.sign(b"m")
+    assert priv.sign(b"m1") != priv.sign(b"m2")
+
+
+def test_cross_key_rejection():
+    a = bls.PrivKey.generate(b"\x03" * 32)
+    b = bls.PrivKey.generate(b"\x04" * 32)
+    sig = a.sign(b"msg")
+    assert not b.pub_key().verify_signature(b"msg", sig)
+
+
+def test_aggregate_same_message():
+    msg = b"aggregate me"
+    privs = [bls.PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
+    sigs = [p.sign(msg) for p in privs]
+    agg_sig = bls.aggregate_signatures(sigs)
+    agg_pk = bls.aggregate_pubkeys([p.pub_key().bytes() for p in privs])
+    assert bls.PubKey(agg_pk).verify_signature(msg, agg_sig)
+    # dropping one signer breaks it
+    agg_pk3 = bls.aggregate_pubkeys(
+        [p.pub_key().bytes() for p in privs[:3]])
+    assert not bls.PubKey(agg_pk3).verify_signature(msg, agg_sig)
+
+
+def test_expand_message_xmd_shape():
+    # deterministic, length-exact, DST-separated (RFC 9380 §5.3.1)
+    u1 = bls.expand_message_xmd(b"msg", b"DST-A", 96)
+    u2 = bls.expand_message_xmd(b"msg", b"DST-A", 96)
+    u3 = bls.expand_message_xmd(b"msg", b"DST-B", 96)
+    assert len(u1) == 96 and u1 == u2 and u1 != u3
+    # the requested length feeds b_0 (I2OSP(len,2) in the RFC), so a
+    # different length yields an unrelated stream, not a prefix
+    long = bls.expand_message_xmd(b"msg", b"DST-A", 128)
+    assert len(long) == 128 and long[:32] != u1[:32]
+
+
+def test_address_and_proto_encoding():
+    priv = bls.PrivKey.generate(b"\x05" * 32)
+    pub = priv.pub_key()
+    assert len(pub.address()) == 20
+    from cometbft_tpu.crypto import encoding
+    wire = encoding.pubkey_to_proto(pub)
+    back = encoding.pubkey_from_proto(wire)
+    assert back.type() == "bls12_381" and back.bytes() == pub.bytes()
+
+
+def test_validator_set_with_bls_key():
+    """A BLS validator participates in hashing/addressing paths."""
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    priv = bls.PrivKey.generate(b"\x06" * 32)
+    vs = ValidatorSet([Validator(priv.pub_key(), 10)])
+    assert vs.hash()  # SimpleValidator proto hashing accepts the key
+    idx, val = vs.get_by_address(priv.pub_key().address())
+    assert idx == 0 and val.voting_power == 10
+
+
+def test_mixed_batch_verifier_falls_back_to_single():
+    """bls12_381 has no batch kernel (same as the reference, where only
+    ed25519/sr25519 batch — crypto/batch/batch.go:12): MixedBatchVerifier
+    routes it through single-verify."""
+    from cometbft_tpu.crypto import batch as cb
+    from cometbft_tpu.crypto.ed25519 import PrivKey as EdPriv
+
+    bpriv = bls.PrivKey.generate(b"\x09" * 32)
+    epriv = EdPriv.generate(b"\x0a" * 32)
+    mv = cb.MixedBatchVerifier()
+    mv.add(bpriv.pub_key(), b"m1", bpriv.sign(b"m1"))
+    mv.add(epriv.pub_key(), b"m2", epriv.sign(b"m2"))
+    ok, verdicts = mv.verify()
+    assert ok and verdicts == [True, True]
+    mv = cb.MixedBatchVerifier()
+    mv.add(bpriv.pub_key(), b"m1", bpriv.sign(b"WRONG"))
+    mv.add(epriv.pub_key(), b"m2", epriv.sign(b"m2"))
+    ok, verdicts = mv.verify()
+    assert not ok and verdicts == [False, True]

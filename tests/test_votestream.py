@@ -102,12 +102,25 @@ class TestPreverifiedContract:
         sv.start()
         try:
             fut = sv.submit(pk, msg, sig)
+            fut.result(timeout=2)        # resolved -> consumable
             pv = Preverified(pk, msg, sig, fut)
             assert pv.verdict_for(pk, msg, sig) is True
             assert pv.verdict_for(pk, b"different", sig) is None
             assert pv.verdict_for(b"\x02" * 32, msg, sig) is None
         finally:
             sv.stop()
+
+    def test_pending_future_cancels_not_blocks(self):
+        from concurrent.futures import Future
+
+        pk, msg, sig = make_sig()
+        fut = Future()                   # never resolved
+        pv = Preverified(pk, msg, sig, fut)
+        import time as _t
+        t0 = _t.monotonic()
+        assert pv.verdict_for(pk, msg, sig) is None
+        assert _t.monotonic() - t0 < 0.005   # no blocking wait
+        assert fut.cancelled()               # dropped from worker batch
 
     def test_vote_set_consumes_preverified(self):
         """A vote carrying a preverified verdict for a DIFFERENT triple
