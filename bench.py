@@ -127,6 +127,30 @@ def bench_light_headers(n_validators: int, n_dispatches: int,
     return n_dispatches * headers_per_dispatch / dt
 
 
+def bench_blocksync(n_vals: int, blocks_per_dispatch: int,
+                    dispatches: int) -> float:
+    """Blocks/sec for blocksync replay (BASELINE '100k blocks x
+    10k-validator set', reference internal/blocksync/reactor.go:546):
+    each block costs one VerifyCommitLight = ~2/3 of the validator set
+    signing; consecutive blocks share the validator set, so batching
+    blocks_per_dispatch commits into one RLC dispatch amortizes the
+    whole A-side MSM across blocks."""
+    import jax
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    sigs_per_block = (2 * n_vals) // 3 + 1
+    pks, msgs, sigs = _make_sigs(sigs_per_block * blocks_per_dispatch,
+                                 n_keys=n_vals, msg_len=120)
+    packed = [jax.device_put(x) for x in ed.pack_rlc(pks, msgs, sigs)]
+    assert bool(np.asarray(dev.rlc_verify_device(*packed)))
+    t0 = time.perf_counter()
+    outs = [dev.rlc_verify_device(*packed) for _ in range(dispatches)]
+    assert np.asarray(outs[-1])
+    dt = time.perf_counter() - t0
+    return dispatches * blocks_per_dispatch / dt
+
+
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "4095"))
     iters = int(os.environ.get("BENCH_ITERS", "8"))
@@ -134,6 +158,7 @@ def main() -> None:
     rlc = bench_rlc(batch, iters)                 # distinct keys: one
     per_sig = bench_per_sig(min(batch + 1, 4096), iters)   # sig/validator
     light = bench_light_headers(150, 8, 24)
+    blocksync = bench_blocksync(10_000, 3, 4)
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
@@ -145,6 +170,9 @@ def main() -> None:
             "light_client_headers_per_sec": round(light, 1),
             "light_client_config":
                 "150 validators/commit, 24 commits/RLC dispatch, pipelined",
+            "blocksync_blocks_per_sec": round(blocksync, 2),
+            "blocksync_config":
+                "10k validators, 6667+1 sigs/commit, 3 blocks/dispatch",
             "rlc_batch": batch,
             "rlc_keys": "distinct (one per signature)",
         },

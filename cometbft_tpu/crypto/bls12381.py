@@ -85,15 +85,30 @@ def enabled() -> bool:
 
 def build(force: bool = False) -> bool:
     """Compile the native library (the reference's `-tags bls12381`
-    analog).  Returns enabled()."""
-    if os.path.exists(_LIB_PATH) and not force:
-        return enabled()
+    analog).  Returns enabled(); never raises — a missing toolchain or
+    failed compile leaves the scheme gated off.  Rebuilds when any
+    native source is newer than the .so."""
+    global _lib
+
     src = os.path.join(_NATIVE_DIR, "bls.cc")
     if not os.path.exists(src):
-        return False
-    subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH, src],
-        check=True, capture_output=True, cwd=_NATIVE_DIR)
+        return enabled()
+    stale = True
+    if os.path.exists(_LIB_PATH) and not force:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        stale = any(
+            os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime
+            for f in os.listdir(_NATIVE_DIR)
+            if f.endswith((".cc", ".h")))
+    if stale:
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH, src],
+                check=True, capture_output=True, cwd=_NATIVE_DIR)
+        except (OSError, subprocess.CalledProcessError):
+            return False
+        with _lib_lock:
+            _lib = None          # reload the fresh build
     return enabled()
 
 

@@ -203,6 +203,34 @@ def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
             np.ascontiguousarray(h_limbs.T), valid)
 
 
+NDIG_128 = 26       # signed-5-bit digits covering 128-bit z (+carry)
+NDIG_256 = 52       # covering scalars < L (253 bits, +carry)
+
+
+def _recode_w5(values: list[int], ndig: int, width: int):
+    """Signed radix-32 recoding: each value becomes ndig digits in
+    [-16, 15] (LSB-up with carry), emitted MSB-first as separate
+    magnitude (int32) and sign (bool) arrays of shape (ndig, width).
+    Pad columns beyond len(values) stay zero (identity contribution)."""
+    mag = np.zeros((width, ndig), np.int32)
+    neg = np.zeros((width, ndig), bool)
+    for i, s in enumerate(values):
+        for j in range(ndig):
+            d = s & 31
+            s >>= 5
+            if d > 15:
+                d -= 32
+                s += 1
+            if d < 0:
+                mag[i, j] = -d
+                neg[i, j] = True
+            else:
+                mag[i, j] = d
+        assert s == 0, "scalar out of range for recoding width"
+    return (np.ascontiguousarray(mag.T[::-1]),
+            np.ascontiguousarray(neg.T[::-1]))
+
+
 def _neg_b_encoding() -> bytes:
     """Compressed -B: flip the x-sign bit of the base point encoding."""
     enc = bytearray(ref.point_compress(ref.B))
@@ -229,17 +257,17 @@ def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     - the fixed-base term c = sum z_i*s_i mod L rides in A slot 0 as
       (-B, c).
 
-    Both batches pad to a power of two (the tree reduction halves
-    widths); pad slots hold the base point with zero scalar and
-    contribute the identity.
+    Both batches pad to bucketed widths (ops/ed25519.pad_width); pad
+    slots hold the base point with zero scalar and contribute the
+    identity.  Scalars are recoded host-side into signed 5-bit window
+    digits (_recode_w5).
 
-    Returns (a_words (8,K), r_words (8,N), zh_limbs (16,K),
-    z_limbs (8,N)) limbs-first, or None if any entry fails structural
-    checks (caller falls back to the per-signature kernel for verdicts).
+    Returns (a_words (8,K), r_words (8,N), a_mag (52,K), a_neg (52,K),
+    r_mag (26,N), r_neg (26,N)) limbs-first/MSB-first, or None if any
+    entry fails structural checks (caller falls back to the
+    per-signature kernel for verdicts).
     """
     import secrets
-
-    from ..ops import limbs as lb
 
     global _NEG_B_ENC
     if _NEG_B_ENC is None:
@@ -272,21 +300,18 @@ def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     nbatch = dev.pad_width(n)
     a_words = np.zeros((kbatch, 8), dtype=np.uint32)
     r_words = np.zeros((nbatch, 8), dtype=np.uint32)
-    zh_limbs = np.zeros((kbatch, 16), dtype=np.uint32)
-    z_limbs = np.zeros((nbatch, 8), dtype=np.uint32)
 
     filler = np.frombuffer(ref.point_compress(ref.B), dtype=np.uint32)
     a_words[:] = filler
     r_words[:] = filler
     a_words[0] = np.frombuffer(_NEG_B_ENC, dtype=np.uint32)
-    zh_limbs[0] = lb.int_to_limbs(c, 16)
-    for j, (pk, coeff) in enumerate(agg.items(), start=1):
+    a_scalars = [c] + list(agg.values())
+    for j, pk in enumerate(agg.keys(), start=1):
         a_words[j] = np.frombuffer(pk, dtype=np.uint32)
-        zh_limbs[j] = lb.int_to_limbs(coeff, 16)
     for i in range(n):
         r_words[i] = np.frombuffer(r_encs[i], dtype=np.uint32)
-        z_limbs[i] = lb.int_to_limbs(zs[i], 8)
+    a_mag, a_neg = _recode_w5(a_scalars, NDIG_256, kbatch)
+    r_mag, r_neg = _recode_w5(zs, NDIG_128, nbatch)
     return (np.ascontiguousarray(a_words.T),
             np.ascontiguousarray(r_words.T),
-            np.ascontiguousarray(zh_limbs.T),
-            np.ascontiguousarray(z_limbs.T))
+            a_mag, a_neg, r_mag, r_neg)

@@ -70,6 +70,7 @@ class Client:
                  trust_level: Fraction = DEFAULT_TRUST_LEVEL,
                  max_clock_drift_ns: int = 10 * SECOND,
                  pruning_size: int = DEFAULT_PRUNING_SIZE,
+                 sequential_batch_size: int = 24,
                  now_fn=Timestamp.now):
         verifier.validate_trust_level(trust_level)
         trust_options.validate_basic()
@@ -82,6 +83,7 @@ class Client:
         self.witnesses = list(witnesses or [])
         self.store: Store = trusted_store or MemoryStore()
         self.pruning_size = pruning_size
+        self.sequential_batch_size = max(1, sequential_batch_size)
         self._now = now_fn
         self._initialize(trust_options)
 
@@ -178,18 +180,34 @@ class Client:
 
     def _verify_sequential(self, trusted: LightBlock, target: LightBlock,
                            now: Timestamp) -> list[LightBlock]:
-        """client.go:612 verifySequential."""
+        """client.go:612 verifySequential, WINDOWED for the device:
+        headers are fetched and host-checked (chaining, valset hashes,
+        timestamps) one by one, but their commits' signatures collect
+        into a DeferredSigBatch verified once per window — one RLC
+        dispatch covers sequential_batch_size commits over the (mostly
+        repeated) validator set.  A bad signature fails the whole
+        window before anything is returned or stored."""
+        from ..types import validation
+
         trace = [trusted]
         verified = trusted
-        for h in range(trusted.height + 1, target.height + 1):
-            interim = target if h == target.height else \
-                self._from_primary(h)
-            verifier.verify_adjacent(
-                verified.signed_header, interim.signed_header,
-                interim.validator_set, self.trusting_period_ns, now,
-                self.max_clock_drift_ns)
-            verified = interim
-            trace.append(interim)
+        h = trusted.height + 1
+        while h <= target.height:
+            wend = min(h + self.sequential_batch_size - 1, target.height)
+            batch = validation.DeferredSigBatch()
+            window: list[LightBlock] = []
+            for hh in range(h, wend + 1):
+                interim = target if hh == target.height else \
+                    self._from_primary(hh)
+                verifier.verify_adjacent(
+                    verified.signed_header, interim.signed_header,
+                    interim.validator_set, self.trusting_period_ns, now,
+                    self.max_clock_drift_ns, defer_to=batch)
+                verified = interim
+                window.append(interim)
+            batch.verify()
+            trace.extend(window)
+            h = wend + 1
         return trace
 
     def _verify_skipping(self, source: Provider, trusted: LightBlock,

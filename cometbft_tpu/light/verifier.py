@@ -85,8 +85,11 @@ def _verify_new_header_and_vals(untrusted: SignedHeader, untrusted_vals,
 
 def verify_adjacent(trusted: SignedHeader, untrusted: SignedHeader,
                     untrusted_vals, trusting_period_ns: int, now: Timestamp,
-                    max_clock_drift_ns: int) -> None:
-    """verifier.go:91-127."""
+                    max_clock_drift_ns: int, defer_to=None) -> None:
+    """verifier.go:91-127.  defer_to (validation.DeferredSigBatch)
+    collects the commit's signature checks for a later cross-header
+    device batch; every header/valset structural check still runs
+    immediately."""
     if untrusted.height != trusted.height + 1:
         raise ErrHeaderHeightNotAdjacent()
     if header_expired(trusted, trusting_period_ns, now):
@@ -101,7 +104,7 @@ def verify_adjacent(trusted: SignedHeader, untrusted: SignedHeader,
     try:
         verify_commit_light(trusted.chain_id, untrusted_vals,
                             untrusted.commit.block_id, untrusted.height,
-                            untrusted.commit)
+                            untrusted.commit, defer_to=defer_to)
     except Exception as e:
         raise ErrInvalidHeader(str(e)) from e
 

@@ -62,6 +62,47 @@ def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
     return True
 
 
+class DeferredSigBatch:
+    """Cross-commit signature batching: several commit verifications
+    collect their signature checks here (host-side structure + voting
+    power tallies still run per commit at collect time), then ONE
+    device batch verifies them all — the shape behind the light
+    client's windowed sequential sync and the blocksync-replay bench.
+    The reference has no analog (it verifies one commit at a time,
+    validation.go:220); this is the TPU-first reformulation: the batch
+    axis spans commits, and pack_rlc's per-pubkey aggregation makes the
+    repeated validator set nearly free.
+    """
+
+    def __init__(self):
+        self._entries: list[tuple[str, object, bytes, bytes]] = []
+
+    def count(self) -> int:
+        return len(self._entries)
+
+    def _extend(self, label: str, entries) -> None:
+        for _, val, sign_bytes, sig in entries:
+            self._entries.append((label, val.pub_key, sign_bytes, sig))
+
+    def verify(self) -> None:
+        """Raises ErrInvalidSignature naming the first failing commit."""
+        if not self._entries:
+            return
+        bv = crypto_batch.MixedBatchVerifier()
+        for _, pub, sign_bytes, sig in self._entries:
+            bv.add(pub, sign_bytes, sig)
+        ok, verdicts = bv.verify()
+        self._entries, entries = [], self._entries
+        if ok:
+            return
+        for (label, _, _, sig), valid in zip(entries, verdicts):
+            if not valid:
+                raise ErrInvalidSignature(
+                    f"wrong signature in {label}: {sig.hex()}")
+        raise CommitVerificationError(
+            "BUG: deferred batch failed with no invalid signatures")
+
+
 def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
                   height: int, commit: Commit) -> None:
     """+2/3 signed; checks ALL signatures (validation.go:28-56)."""
@@ -75,10 +116,12 @@ def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
 
 def verify_commit_light(chain_id: str, vals: ValidatorSet,
                         block_id: BlockID, height: int,
-                        commit: Commit) -> None:
-    """+2/3 signed; stops as soon as the tally crosses (validation.go:63)."""
+                        commit: Commit, defer_to=None) -> None:
+    """+2/3 signed; stops as soon as the tally crosses (validation.go:63).
+    With defer_to (a DeferredSigBatch), signature checks are collected
+    instead of verified; the caller runs defer_to.verify() later."""
     _verify_commit_light(chain_id, vals, block_id, height, commit,
-                         count_all=False)
+                         count_all=False, defer_to=defer_to)
 
 
 def verify_commit_light_all_signatures(chain_id: str, vals: ValidatorSet,
@@ -89,13 +132,14 @@ def verify_commit_light_all_signatures(chain_id: str, vals: ValidatorSet,
 
 
 def _verify_commit_light(chain_id, vals, block_id, height, commit,
-                         count_all):
+                         count_all, defer_to=None):
     _verify_basic(vals, commit, height, block_id)
     needed = vals.total_voting_power() * 2 // 3
     ignore = lambda cs: cs.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
     count = lambda cs: True  # noqa: E731
     _verify(chain_id, vals, commit, needed, ignore, count,
-            count_all=count_all, lookup_by_index=True)
+            count_all=count_all, lookup_by_index=True, defer_to=defer_to,
+            defer_label=f"commit at height {height}")
 
 
 def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet,
@@ -151,7 +195,7 @@ def _verify_basic(vals, commit, height, block_id):
 
 
 def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
-            lookup_by_index):
+            lookup_by_index, defer_to=None, defer_label=""):
     """Unified batch/single verification.
 
     Mirrors verifyCommitBatch/verifyCommitSingle (validation.go:220-408):
@@ -197,6 +241,10 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
 
     if not entries:
         raise CommitVerificationError("BUG: no signatures to verify")
+
+    if defer_to is not None:
+        defer_to._extend(defer_label, entries)
+        return
 
     if use_batch:
         bv = crypto_batch.MixedBatchVerifier() \

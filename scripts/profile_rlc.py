@@ -97,11 +97,11 @@ marginal("decompress (per point)", dec_body, words, R=16)
 
 # ext table build (15 cached adds + stack)
 def tab_body(q):
-    t = dev._ext_table(q)
-    return t[1] + t[15] * jnp.int32(3)
+    t = dev._table17(q)
+    return t[1] + t[16] * jnp.int32(3)
 
 
-marginal("_ext_table build (per point)", tab_body, p, R=8)
+marginal("_table17 build (per point)", tab_body, p, R=8)
 
 # select from a table
 tab = jnp.stack([pt_rand() for _ in range(16)], axis=0)

@@ -291,3 +291,43 @@ def test_backwards_rejects_poisoned_valset(chain):
                primary=p, now_fn=lambda: _now(chain))
     with pytest.raises(Exception, match="validator hash"):
         c.verify_light_block_at_height(3)
+
+
+def test_client_sequential_windowed_batches(chain):
+    """The windowed sequential path (one DeferredSigBatch per
+    sequential_batch_size headers) verifies the same trace, for window
+    sizes that divide, exceed, and straddle the range."""
+    for w in (1, 3, 64):
+        c = _client(chain, verification_mode=SEQUENTIAL,
+                    sequential_batch_size=w)
+        lb = c.verify_light_block_at_height(10)
+        assert lb.height == 10
+        assert c.trusted_light_block(7) is not None
+
+
+def test_client_sequential_rejects_bad_sig_in_window(chain):
+    """A tampered commit signature mid-window fails the whole window
+    and nothing from it is stored."""
+    import copy
+
+    import dataclasses
+
+    provider = _provider(chain)
+    bad_h = 6
+    lb = provider.light_block(bad_h)
+    tampered = copy.deepcopy(lb)
+    commit = tampered.signed_header.commit
+    commit.signatures = [
+        dataclasses.replace(
+            cs, signature=cs.signature[:10]
+            + bytes([cs.signature[10] ^ 1]) + cs.signature[11:])
+        if cs.signature else cs
+        for cs in commit.signatures]
+    provider.add(tampered)
+    c = _client(chain, provider=provider, verification_mode=SEQUENTIAL,
+                sequential_batch_size=8)
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        c.verify_light_block_at_height(10)
+    assert c.trusted_light_block(5) is None or \
+        c.trusted_light_block(bad_h) is None
