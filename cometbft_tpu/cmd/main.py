@@ -297,6 +297,107 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_reindex_event(args) -> int:
+    """commands/reindex_event.go analog: rebuild the tx/block event
+    indexes from the block store + stored FinalizeBlockResponses
+    (recovers from indexer corruption or an indexer=null era)."""
+    from ..abci import types as at
+    from ..state.indexer import BlockIndexer, TxIndexer
+    from ..state.store import StateStore
+    from ..store.blockstore import BlockStore
+    from ..store.kv import open_db
+    from ..types import events as ev
+
+    cfg = _load_config(args.home)
+    backend = cfg.base.db_backend
+    block_store = BlockStore(open_db(
+        backend, os.path.join(cfg.db_dir(), "blockstore.db")))
+    state_store = StateStore(open_db(
+        backend, os.path.join(cfg.db_dir(), "state.db")))
+    tx_indexer = TxIndexer(open_db(
+        backend, os.path.join(cfg.db_dir(), "tx_index.db")))
+    block_indexer = BlockIndexer(open_db(
+        backend, os.path.join(cfg.db_dir(), "block_index.db")))
+
+    base = max(block_store.base(), 1)
+    height = block_store.height()
+    start = args.start_height or base
+    end = args.end_height or height
+    if start < base or end > height or start > end:
+        print(f"height range [{start},{end}] outside stored "
+              f"[{base},{height}]", file=sys.stderr)
+        return 1
+    n_blocks = n_txs = 0
+    for h in range(start, end + 1):
+        block = block_store.load_block(h)
+        raw = state_store.load_finalize_block_response(h)
+        if block is None or raw is None:
+            print(f"skip height {h}: missing block or results",
+                  file=sys.stderr)
+            continue
+        fin = at.FinalizeBlockResponse.from_proto(raw)
+        # the same composite maps the live event bus feeds the indexers
+        bev = ev.block_events_map(h, fin.events)
+        bev.setdefault(ev.EVENT_TYPE_KEY, []).append(
+            ev.EVENT_NEW_BLOCK_EVENTS)
+        block_indexer.index(h, bev)
+        n_blocks += 1
+        for i, tx in enumerate(block.data.txs):
+            result = fin.tx_results[i] if i < len(fin.tx_results) else None
+            tev = ev.tx_events_map(h, bytes(tx),
+                                   getattr(result, "events", None))
+            tev.setdefault(ev.EVENT_TYPE_KEY, []).append(ev.EVENT_TX)
+            tx_indexer.index(h, i, bytes(tx), result, tev)
+            n_txs += 1
+    print(f"Reindexed {n_blocks} blocks / {n_txs} txs "
+          f"over heights [{start},{end}]")
+    if n_blocks == 0:
+        print("nothing reindexed (blocks or results missing for the "
+              "whole range)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """commands/debug (dump mode) analog: snapshot a running node's
+    observable state over RPC into a directory — status, net_info,
+    consensus state dumps, unconfirmed txs, optionally at intervals."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    os.makedirs(args.output_directory, exist_ok=True)
+    routes = ["status", "net_info", "dump_consensus_state",
+              "consensus_state", "num_unconfirmed_txs", "abci_info"]
+
+    def snapshot(tag: str) -> None:
+        out = {}
+        for r in routes:
+            url = f"http://{args.rpc_laddr.replace('tcp://', '')}/{r}"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    body = _json.loads(resp.read())
+                    out[r] = body.get("result") or body
+            except Exception as e:
+                out[r] = {"error": str(e)}
+        path = os.path.join(args.output_directory, f"dump_{tag}.json")
+        with open(path, "w") as f:
+            _json.dump(out, f, indent=1)
+        print(f"wrote {path}")
+
+    # --frequency alone means "snapshot forever at that interval";
+    # --count bounds the number of snapshots (1 snapshot by default)
+    count = args.count if args.count > 1 else \
+        (2**62 if args.frequency else 1)
+    i = 0
+    while i < count:
+        snapshot(f"{int(_time.time())}_{i}")
+        i += 1
+        if i < count:
+            _time.sleep(max(args.frequency, 1.0))
+    return 0
+
+
 def cmd_replay(args) -> int:
     """commands/replay.go: replay the WAL through a fresh consensus
     state (console mode prints each message)."""
@@ -381,6 +482,21 @@ def main(argv=None) -> int:
     p.add_argument("--node-dir-prefix", default="node")
     p.add_argument("--starting-port", type=int, default=26656)
     p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser("reindex-event",
+                       help="rebuild tx/block event indexes from the "
+                            "block store")
+    p.add_argument("--start-height", type=int, default=0)
+    p.add_argument("--end-height", type=int, default=0)
+    p.set_defaults(fn=cmd_reindex_event)
+
+    p = sub.add_parser("debug", help="dump a running node's state over RPC")
+    p.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    p.add_argument("--output-directory", default="debug-dump")
+    p.add_argument("--frequency", type=float, default=0.0,
+                   help="seconds between snapshots (0 = one snapshot)")
+    p.add_argument("--count", type=int, default=1)
+    p.set_defaults(fn=cmd_debug_dump)
 
     p = sub.add_parser("compact-db", help="compact the sqlite stores")
     p.set_defaults(fn=cmd_compact_db)

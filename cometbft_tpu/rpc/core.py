@@ -252,8 +252,12 @@ class Environment:
             },
         }
 
-    def consensus_state(self) -> dict:
+    # NOTE: handler names end in _handler because the Environment
+    # dataclass FIELD consensus_state shadows any method of that name
+    def consensus_state_handler(self) -> dict:
         cs = self.consensus_state
+        if cs is None:
+            raise RPCError(-32603, "consensus state unavailable")
         with cs._mtx:
             return {"round_state": {
                 "height": str(cs.height), "round": cs.round,
@@ -263,8 +267,8 @@ class Environment:
                 "valid_round": cs.valid_round,
             }}
 
-    def dump_consensus_state(self) -> dict:
-        out = self.consensus_state()
+    def dump_consensus_state_handler(self) -> dict:
+        out = self.consensus_state_handler()
         out["peers"] = [
             {"node_address": p.node_info.node_id}
             for p in (self.p2p_switch.peers.list()
@@ -624,8 +628,8 @@ ROUTES = {
     "block_results": "block_results",
     "validators": "validators",
     "consensus_params": "consensus_params",
-    "consensus_state": "consensus_state",
-    "dump_consensus_state": "dump_consensus_state",
+    "consensus_state": "consensus_state_handler",
+    "dump_consensus_state": "dump_consensus_state_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

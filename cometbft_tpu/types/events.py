@@ -56,6 +56,27 @@ def abci_events_to_map(abci_events, base: dict[str, list[str]] | None = None
     return out
 
 
+def block_events_map(height: int, abci_events) -> dict[str, list[str]]:
+    """Composite map a NewBlockEvents publication (and hence the block
+    indexer) sees — shared by the live event bus and `reindex-event` so
+    the two can't drift."""
+    events = abci_events_to_map(abci_events)
+    events.setdefault(BLOCK_HEIGHT_KEY, []).append(str(height))
+    return events
+
+
+def tx_events_map(height: int, tx: bytes, abci_events
+                  ) -> dict[str, list[str]]:
+    """Composite map a Tx publication (and hence the tx indexer) sees —
+    tx.height + tx.hash + flattened app events."""
+    from .block import tx_hash
+
+    events = abci_events_to_map(abci_events)
+    events.setdefault(TX_HEIGHT_KEY, []).append(str(height))
+    events.setdefault(TX_HASH_KEY, []).append(tx_hash(tx).hex().upper())
+    return events
+
+
 @dataclass
 class EventDataTx:
     height: int = 0
@@ -158,8 +179,7 @@ class EventBus(BaseService):
         self._publish(EVENT_NEW_BLOCK_HEADER, data)
 
     def publish_new_block_events(self, data: EventDataNewBlockEvents) -> None:
-        events = abci_events_to_map(data.events)
-        events.setdefault(BLOCK_HEIGHT_KEY, []).append(str(data.height))
+        events = block_events_map(data.height, data.events)
         self._publish(EVENT_NEW_BLOCK_EVENTS, data, events)
 
     def publish_new_evidence(self, data: EventDataNewEvidence) -> None:
@@ -168,11 +188,8 @@ class EventBus(BaseService):
     def publish_tx(self, data: EventDataTx) -> None:
         """Indexed with tx.hash and tx.height plus app events
         (event_bus.go PublishEventTx)."""
-        from .block import tx_hash
-        events = abci_events_to_map(getattr(data.result, "events", None))
-        events.setdefault(TX_HEIGHT_KEY, []).append(str(data.height))
-        events.setdefault(TX_HASH_KEY, []).append(
-            tx_hash(data.tx).hex().upper())
+        events = tx_events_map(data.height, data.tx,
+                               getattr(data.result, "events", None))
         self._publish(EVENT_TX, data, events)
 
     def publish_new_round_step(self, data: EventDataRoundState) -> None:

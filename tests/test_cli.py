@@ -144,3 +144,80 @@ class TestLightProxy:
 
 # reuse the live-node fixture from the RPC tests
 from tests.test_node_rpc import node  # noqa: E402,F401
+
+
+class TestReindexAndDebug:
+    def test_reindex_event_rebuilds_indexes(self, tmp_path):
+        """Run a node that commits txs, wipe the tx index, reindex from
+        the stores, and find the tx by hash again (reference
+        commands/reindex_event.go)."""
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import init_files
+        from cometbft_tpu.state.indexer import TxIndexer
+        from cometbft_tpu.store.kv import open_db
+        from cometbft_tpu.types.block import tx_hash
+
+        home = str(tmp_path)
+        cfg = _tcfg(home)
+        cfg.base.db_backend = "sqlite"
+        init_files(cfg, chain_id="reindex-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 2, timeout=60)
+            tx = b"reidx=1"
+            res = n.mempool.check_tx(tx)
+            assert res.code == 0
+            deadline = time.time() + 30
+            found_h = None
+            while time.time() < deadline and found_h is None:
+                for h in range(1, n.block_store.height() + 1):
+                    b = n.block_store.load_block(h)
+                    if b and any(bytes(t) == tx for t in b.data.txs):
+                        found_h = h
+                        break
+                time.sleep(0.2)
+            assert found_h, "tx never committed"
+            # wait for its results to be persisted
+            deadline = time.time() + 20
+            while time.time() < deadline and \
+                    n.state_store.load_finalize_block_response(
+                        found_h) is None:
+                time.sleep(0.1)
+        finally:
+            n.stop()
+
+        # wipe the tx index
+        idx_path = os.path.join(cfg.db_dir(), "tx_index.db")
+        os.remove(idx_path)
+        rc = cli_main(["--home", home, "reindex-event"])
+        assert rc == 0
+        idx = TxIndexer(open_db("sqlite", idx_path))
+        rec = idx.get(tx_hash(tx))
+        assert rec is not None and rec["height"] == found_h
+
+    def test_debug_dump_snapshots_node(self, tmp_path):
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import init_files
+
+        home = str(tmp_path / "node")
+        cfg = _tcfg(home)
+        init_files(cfg, chain_id="debug-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 2, timeout=60)
+            outdir = str(tmp_path / "dump")
+            rc = cli_main([
+                "--home", home, "debug",
+                "--rpc-laddr", n.rpc_addr,
+                "--output-directory", outdir])
+            assert rc == 0
+            files = os.listdir(outdir)
+            assert len(files) == 1
+            with open(os.path.join(outdir, files[0])) as f:
+                dump = json.load(f)
+            assert dump["status"]["sync_info"]["latest_block_height"]
+            assert "round_state" in dump["dump_consensus_state"]
+        finally:
+            n.stop()
