@@ -28,6 +28,8 @@ accepted, cofactored equation, s < L enforced host-side.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -272,6 +274,15 @@ def verify_kernel(a_words, r_words, s_limbs, h_limbs):
 
 NPART_MAX = 192      # max lane-resident partial accumulators
 
+# Fused Pallas select+tree kernel for MSM windows (ops/pallas_msm.py);
+# opt-in until validated on every deployment target
+USE_PALLAS_TREE = os.environ.get("COMETBFT_TPU_PALLAS_TREE", "0") == "1"
+
+
+def _pallas_blk() -> int:
+    from . import pallas_msm
+    return pallas_msm.BLK
+
 _SMALL_WIDTHS = (8, 16, 32, 64, 96, 128, 160, 192)
 _BASE_WIDTHS = (128, 160, 192)
 
@@ -360,9 +371,22 @@ def _msm(enc_words, mags, negs):
     Returns ((4,20,1) point, all-decompressed-ok bool).
     """
     w = enc_words.shape[-1]
-    npart = _npart(w)
     pt, ok = decompress(enc_words)
     tab = _table17(point_neg(pt))            # (17, 4, 20, W)
+
+    use_pallas = USE_PALLAS_TREE and w % _pallas_blk() == 0
+    if use_pallas:
+        from . import pallas_msm
+        npart = (w // pallas_msm.BLK) * pallas_msm.OUT_PER_BLK
+
+        def window_contrib(mag, neg):
+            return pallas_msm.select_tree(tab, mag, neg)
+    else:
+        npart = _npart(w)
+
+        def window_contrib(mag, neg):
+            contrib = _cond_neg_point(_select17(tab, mag), neg)
+            return _tree_reduce(contrib, npart)
 
     def step(acc, xs):
         mag, neg = xs
@@ -371,9 +395,7 @@ def _msm(enc_words, mags, negs):
         acc = point_double(acc, with_t=False)
         acc = point_double(acc, with_t=False)
         acc = point_double(acc, with_t=True)
-        contrib = _cond_neg_point(_select17(tab, mag), neg)
-        contrib = _tree_reduce(contrib, npart)
-        return point_add(acc, contrib), None
+        return point_add(acc, window_contrib(mag, neg)), None
 
     acc = identity_point((npart,))
     acc, _ = jax.lax.scan(step, acc, (mags, negs))

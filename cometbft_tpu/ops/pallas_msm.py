@@ -1,0 +1,151 @@
+"""Pallas TPU kernel for the MSM window hot loop: fused
+table-select + conditional-negate + tree-reduce.
+
+Profiling on-chip showed the per-window tree reduction costs ~5x its
+pure mul time under XLA: every point_add level at shrinking widths
+dispatches ~20 separate (20, W) elementwise fusions whose fixed costs
+dominate below ~2048 lanes.  This kernel keeps the whole per-block
+pipeline — 16-way predicated select from the window table, signed-digit
+negation, and the log-depth tree of extended-coordinate point
+additions — inside one Pallas program with everything VMEM-resident.
+
+Grid: one program per BLK-lane slice of the batch; each program reduces
+its slice to OUT_PER_BLK partial points written to a disjoint lane
+range, giving a (4, 20, W // BLK * OUT_PER_BLK) partial tensor the
+caller folds into the accumulator (ops/ed25519._msm).
+
+The field arithmetic mirrors ops/fe.py (same radix-13 signed-limb
+bounds proof); shapes inside the kernel are (20, lanes) with the limb
+axis on sublanes, so carries are sublane-axis concatenations — no lane
+crossings, matching the VPU layout the XLA kernels use.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import fe
+
+BLK = 512            # lanes per program
+OUT_PER_BLK = 8      # partials each program writes
+
+
+# -- field ops on VALUES (not refs); shapes (20, n) ------------------------
+# fe's carry/add/sub are elementwise + axis-0 concatenate, which Mosaic
+# lowers fine — reuse them so the radix-13 bounds proof lives in ONE
+# place; only the product needs a Mosaic-specific (static-slice) rewrite.
+
+_carry = fe._carry_pass
+_norm_weak = fe.norm_weak
+_add = fe.add
+_sub = fe.sub
+
+
+def _mul(a, b):
+    """Column-sum schoolbook product (no dynamic-update-slices: Mosaic
+    wants static slicing)."""
+    nl = fe.NLIMBS
+    cols = []
+    for k in range(2 * nl - 1):
+        lo = max(0, k - nl + 1)
+        hi = min(nl - 1, k)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        cols.append(t)
+    cols.append(jnp.zeros_like(cols[0]))
+    acc = jnp.stack(cols, axis=0)                    # (40, n)
+    hi_ = acc >> fe.RADIX
+    lo_ = acc - (hi_ << fe.RADIX)
+    acc = lo_ + jnp.concatenate(
+        [jnp.zeros_like(hi_[:1]), hi_[:-1]], axis=0)
+    out = acc[:fe.NLIMBS] + jnp.int32(fe.WRAP) * acc[fe.NLIMBS:]
+    return _norm_weak(out)
+
+
+def _mul_word(a, w: int):
+    return _norm_weak(a * jnp.int32(w))
+
+
+# -- point ops; points are (4, 20, n) --------------------------------------
+
+def _to_cached(p, d2):
+    return jnp.stack([
+        _add(p[1], p[0]),
+        _sub(p[1], p[0]),
+        _mul(p[3], jnp.broadcast_to(d2, p[3].shape)),
+        _mul_word(p[2], 2)], axis=0)
+
+
+def _add_cached(p, q):
+    a = _mul(_sub(p[1], p[0]), q[1])
+    b = _mul(_add(p[1], p[0]), q[0])
+    c = _mul(p[3], q[2])
+    d = _mul(p[2], q[3])
+    e = _sub(b, a)
+    f = _sub(d, c)
+    g = _add(d, c)
+    h = _add(b, a)
+    return jnp.stack([_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h)],
+                     axis=0)
+
+
+def _point_add(p, q, d2):
+    return _add_cached(p, _to_cached(q, d2))
+
+
+# -- the kernel -------------------------------------------------------------
+
+def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
+    """tab (17, 4, 20, BLK) VMEM; mag/neg (1, BLK); d2 (20, 1);
+    out (4, 20, OUT)."""
+    mag = mag_ref[0, :]                  # (BLK,)
+    neg = neg_ref[0, :]
+    d2 = d2_ref[:, :]                    # (20, 1)
+    sel = tab_ref[0]                     # (4, 20, BLK)
+    for k in range(1, 17):
+        cond = (mag == jnp.int32(k))[None, None]
+        sel = jnp.where(cond, tab_ref[k], sel)
+    flip = (neg != 0)[None]
+    x = jnp.where(flip, -sel[0], sel[0])
+    t = jnp.where(flip, -sel[3], sel[3])
+    pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
+    w = BLK
+    while w > OUT_PER_BLK:
+        half = w // 2
+        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
+        w = half
+    out_ref[:] = pts
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def select_tree(tab, mag, neg, interpret=False):
+    """(17,4,20,W) table + (W,) digits -> (4,20,W//BLK*OUT_PER_BLK)
+    partial points, one fused Pallas program per BLK lanes."""
+    w = tab.shape[-1]
+    assert w % BLK == 0, w
+    nblk = w // BLK
+    grid = (nblk,)
+    out = pl.pallas_call(
+        _select_tree_kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (4, fe.NLIMBS, nblk * OUT_PER_BLK), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((17, 4, fe.NLIMBS, BLK),
+                         lambda i: (0, 0, 0, i)),
+            pl.BlockSpec((1, BLK), lambda i: (0, i)),
+            pl.BlockSpec((1, BLK), lambda i: (0, i)),
+            pl.BlockSpec((fe.NLIMBS, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((4, fe.NLIMBS, OUT_PER_BLK),
+                               lambda i: (0, 0, i)),
+        interpret=interpret,
+    )(tab, mag.reshape(1, -1), neg.astype(jnp.int32).reshape(1, -1),
+      jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
+    return out
