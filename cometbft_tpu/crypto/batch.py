@@ -96,7 +96,8 @@ def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
     bucket = dev.bucket_size(n)
     a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
                                       bucket, parsed=parsed)
-    verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
+    from ..ops import sharding
+    verdict = np.asarray(sharding.verify_batch_sharded(a, r, s, h))
     verdict = verdict & valid
     out = verdict[:n].tolist()
     return all(out) and bool(out), out

@@ -211,22 +211,37 @@ def _recode_w5(values: list[int], ndig: int, width: int):
     """Signed radix-32 recoding: each value becomes ndig digits in
     [-16, 15] (LSB-up with carry), emitted MSB-first as separate
     magnitude (int32) and sign (bool) arrays of shape (ndig, width).
-    Pad columns beyond len(values) stay zero (identity contribution)."""
+    Pad columns beyond len(values) stay zero (identity contribution).
+
+    Vectorized over the batch: raw 5-bit digit extraction happens on a
+    (n, nbytes) uint8 view, then one carry sweep over the ndig digits
+    (numpy ops per digit, not per scalar) — host packing must not
+    bottleneck the device pipeline."""
+    n = len(values)
     mag = np.zeros((width, ndig), np.int32)
     neg = np.zeros((width, ndig), bool)
-    for i, s in enumerate(values):
+    if n:
+        assert max(values) < 1 << (5 * ndig), \
+            "scalar out of range for recoding width"
+        nbytes = (5 * ndig + 7) // 8 + 1
+        raw = np.frombuffer(
+            b"".join(v.to_bytes(nbytes, "little") for v in values),
+            dtype=np.uint8).reshape(n, nbytes).astype(np.uint16)
+        digs = np.empty((n, ndig), np.int16)
         for j in range(ndig):
-            d = s & 31
-            s >>= 5
-            if d > 15:
-                d -= 32
-                s += 1
-            if d < 0:
-                mag[i, j] = -d
-                neg[i, j] = True
-            else:
-                mag[i, j] = d
-        assert s == 0, "scalar out of range for recoding width"
+            off = 5 * j
+            k, sh = off >> 3, off & 7
+            word = raw[:, k] | (raw[:, k + 1] << 8)
+            digs[:, j] = (word >> sh) & 31
+        carry = np.zeros(n, np.int16)
+        for j in range(ndig):
+            d = digs[:, j] + carry
+            over = d > 15
+            digs[:, j] = np.where(over, d - 32, d)
+            carry = over.astype(np.int16)
+        assert not carry.any(), "scalar out of range for recoding width"
+        mag[:n] = np.abs(digs)
+        neg[:n] = digs < 0
     return (np.ascontiguousarray(mag.T[::-1]),
             np.ascontiguousarray(neg.T[::-1]))
 

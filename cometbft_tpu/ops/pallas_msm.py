@@ -27,7 +27,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from . import fe
 
@@ -40,7 +39,6 @@ OUT_PER_BLK = 8      # partials each program writes
 # lowers fine — reuse them so the radix-13 bounds proof lives in ONE
 # place; only the product needs a Mosaic-specific (static-slice) rewrite.
 
-_carry = fe._carry_pass
 _norm_weak = fe.norm_weak
 _add = fe.add
 _sub = fe.sub

@@ -1,0 +1,60 @@
+"""Multi-chip signature verification: the batch IS the sequence axis
+(SURVEY §5 "long-context"): shard it over a 1-D `jax.sharding.Mesh`
+and let XLA insert the verdict collectives over ICI.
+
+This is the production analog of __graft_entry__.dryrun_multichip: the
+per-signature kernel is embarrassingly parallel along the batch axis
+(each signature verifies independently), so data-parallel sharding
+needs no communication until the final verdict gather.  The RLC
+whole-batch kernel stays single-chip per dispatch — with >1 chip the
+caller splits commits ACROSS chips (one RLC per chip) instead, which
+preserves the per-commit verdict structure.
+
+Tests exercise this on the 8-virtual-device CPU mesh from
+tests/conftest.py; the driver's dryrun does the same with the full
+verify step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import ed25519 as dev
+
+
+def device_count() -> int:
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 1
+
+
+@functools.lru_cache(maxsize=1)
+def _mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()), ("sig",))
+
+
+@functools.lru_cache(maxsize=1)
+def _sharded_verify():
+    """Jitted verify step with batch-axis input/output shardings; the
+    jit shards plain numpy inputs itself."""
+    mesh = _mesh()
+    shard_in = NamedSharding(mesh, P(None, "sig"))
+    out = NamedSharding(mesh, P("sig"))
+    return jax.jit(dev.verify_kernel,
+                   in_shardings=(shard_in,) * 4,
+                   out_shardings=out)
+
+
+def verify_batch_sharded(a_words, r_words, s_limbs, h_limbs):
+    """Per-signature verdicts with the batch axis sharded over every
+    local device.  Caller guarantees batch % n_devices == 0 (pack to a
+    bucket that divides; dev.BATCH_BUCKETS are powers of two)."""
+    n = device_count()
+    if n < 2 or a_words.shape[-1] % n != 0:
+        return dev.verify_batch_device(a_words, r_words, s_limbs, h_limbs)
+    return _sharded_verify()(a_words, r_words, s_limbs, h_limbs)
