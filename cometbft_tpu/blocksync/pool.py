@@ -233,6 +233,24 @@ class BlockPool(BaseService):
             second = r2.block if r2 else None
             return first, ext, second
 
+    def peek_window(self, max_blocks: int):
+        """Consecutive downloaded blocks from self.height: a list of
+        (block, ext_commit) of length <= max_blocks, plus the block at
+        the following height if present (its LastCommit verifies the
+        last window entry).  The windowed verify path batches all the
+        commits into one device dispatch (types.DeferredSigBatch)."""
+        with self._mtx:
+            window = []
+            h = self.height
+            while len(window) < max_blocks:
+                r = self._requesters.get(h)
+                if r is None or r.block is None:
+                    break
+                window.append((r.block, r.ext_commit))
+                h += 1
+            nxt = self._requesters.get(h)
+            return window, (nxt.block if nxt else None)
+
     def pop_request(self) -> None:
         """The block at self.height was applied (pool.go PopRequest)."""
         with self._mtx:

@@ -21,6 +21,8 @@ from .pool import BlockPool
 
 BLOCKSYNC_CHANNEL = 0x40
 TRY_SYNC_INTERVAL = 0.01
+# blocks whose LastCommit sigs batch into one device dispatch
+VERIFY_WINDOW = 16
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 
@@ -153,48 +155,108 @@ class BlocksyncReactor(Reactor):
                 time.sleep(TRY_SYNC_INTERVAL)
 
     def _try_sync_one(self) -> bool:
-        """reactor.go:534 processBlock: verify first with second's
-        LastCommit, then apply."""
-        first, first_ext, second = self.pool.peek_two_blocks()
-        if first is None or second is None:
-            return False
+        """reactor.go:534 processBlock, WINDOWED: all the LastCommit
+        signature checks for a run of downloaded blocks batch into ONE
+        device dispatch (types.DeferredSigBatch — the BASELINE
+        'blocksync replay' configuration), then blocks apply one by
+        one.  Batching beyond the next height is gated on the headers
+        carrying the CURRENT next_validators hash; a lying header
+        cannot commit anything — apply-time validate_block re-checks
+        the executed validator set before each block lands."""
+        from ..types.validation import DeferredSigBatch
 
-        ext_enabled = self.state.consensus_params \
-            .vote_extensions_enabled(first.header.height)
-        if ext_enabled and first_ext is None:
-            # the peer MUST supply the extended commit when extensions
-            # are enabled (reactor.go:540) — refetch from another peer
-            for pid in self.pool.redo_request(first.header.height):
-                self._on_peer_error(pid, "missing extended commit")
+        window, after = self.pool.peek_window(VERIFY_WINDOW)
+        usable = len(window) if after is not None else len(window) - 1
+        if usable < 1:
             return False
+        # a missing extended commit makes its block unusable — gate the
+        # window BEFORE burning a device dispatch (reactor.go:540)
+        for i in range(usable):
+            block, ext = window[i]
+            if ext is None and self.state.consensus_params \
+                    .vote_extensions_enabled(block.header.height):
+                if i == 0:
+                    for pid in self.pool.redo_request(
+                            block.header.height):
+                        self._on_peer_error(pid,
+                                            "missing extended commit")
+                    return False
+                usable = i
+                break
+        # quantize to a power of two so the device sees few distinct
+        # batch shapes (each new shape is a one-off compile)
+        while usable & (usable - 1):
+            usable &= usable - 1
+        blocks = [b for b, _ in window]
+        commits = []
+        for i in range(usable):
+            nxt = blocks[i + 1] if i + 1 < len(window) else after
+            commits.append(nxt.last_commit)
 
-        parts = PartSet.from_data(first.to_proto())
-        first_id = BlockID(first.hash(), parts.header)
+        # valset per window offset: exact for +0/+1; further only while
+        # headers pin the unchanged next_validators hash
+        next_hash = self.state.next_validators.hash() \
+            if self.state.next_validators else None
+        batch = DeferredSigBatch()
+        verified = 0
+        parts_ids = []
         try:
-            # HOT PATH: batched signature verification on device
-            self.state.validators.verify_commit_light(
-                self.state.chain_id, first_id, first.header.height,
-                second.last_commit)
-            if ext_enabled:
-                first_ext.ensure_extensions(True)
-            self.block_exec.validate_block(self.state, first)
-        except Exception:
-            # evict BOTH suppliers (reactor.go:560 StopPeerForError):
-            # the second block's LastCommit drove the failed verify
-            for pid in self.pool.redo_request(first.header.height):
+            for i in range(usable):
+                block = blocks[i]
+                if i == 0:
+                    vals = self.state.validators
+                elif block.header.validators_hash == next_hash:
+                    vals = self.state.next_validators
+                else:
+                    break
+                parts = PartSet.from_data(block.to_proto())
+                bid = BlockID(block.hash(), parts.header)
+                parts_ids.append((parts, bid))
+                vals.verify_commit_light(
+                    self.state.chain_id, bid, block.header.height,
+                    commits[i], defer_to=batch)
+                verified += 1
+            # HOT PATH: one device dispatch for the whole window
+            batch.verify()
+        except Exception as e:
+            # blame the failing height (the commit for height h rides
+            # in the block at h+1, and redo_request evicts both
+            # suppliers); fall back to the window head
+            bad_h = getattr(e, "failed_ctx", None) or \
+                blocks[0].header.height
+            for pid in self.pool.redo_request(bad_h):
                 self._on_peer_error(pid, "served invalid block")
             return False
 
-        self.pool.pop_request()
-        if ext_enabled:
-            self.store.save_block(first, parts, first_ext.to_commit(),
-                                  ext_commit=first_ext.to_proto())
-        else:
-            self.store.save_block(first, parts, second.last_commit)
-        self.state = self.block_exec.apply_verified_block(
-            self.state, first_id, first,
-            syncing_to_height=self.pool.max_peer_height())
-        return True
+        progressed = False
+        for i in range(verified):
+            first = blocks[i]
+            first_ext = window[i][1]
+            ext_enabled = self.state.consensus_params \
+                .vote_extensions_enabled(first.header.height)
+            parts, first_id = parts_ids[i]
+            try:
+                if ext_enabled:
+                    first_ext.ensure_extensions(True)
+                self.block_exec.validate_block(self.state, first)
+            except Exception:
+                # evict BOTH suppliers (reactor.go:560): the next
+                # block's LastCommit drove the batched verify
+                for pid in self.pool.redo_request(first.header.height):
+                    self._on_peer_error(pid, "served invalid block")
+                return progressed
+            self.pool.pop_request()
+            if ext_enabled:
+                self.store.save_block(first, parts,
+                                      first_ext.to_commit(),
+                                      ext_commit=first_ext.to_proto())
+            else:
+                self.store.save_block(first, parts, commits[i])
+            self.state = self.block_exec.apply_verified_block(
+                self.state, first_id, first,
+                syncing_to_height=self.pool.max_peer_height())
+            progressed = True
+        return progressed
 
     def _maybe_switch_to_consensus(self) -> bool:
         """reactor.go:520: hand off when caught up."""

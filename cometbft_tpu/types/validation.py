@@ -75,30 +75,50 @@ class DeferredSigBatch:
     """
 
     def __init__(self):
-        self._entries: list[tuple[str, object, bytes, bytes]] = []
+        # (label, context, pubkey, sign_bytes, sig); context is an
+        # opaque caller value (e.g. a height) surfaced as
+        # .failed_ctx on the raised error for blame attribution
+        self._entries: list = []
 
     def count(self) -> int:
         return len(self._entries)
 
-    def _extend(self, label: str, entries) -> None:
+    def _extend(self, label: str, ctx, entries) -> None:
         for _, val, sign_bytes, sig in entries:
-            self._entries.append((label, val.pub_key, sign_bytes, sig))
+            self._entries.append((label, ctx, val.pub_key, sign_bytes,
+                                  sig))
+
+    # below this many signatures the host fast path wins over a device
+    # dispatch (and avoids cold-compiling a fresh batch shape)
+    DEVICE_THRESHOLD = 128
+
+    @staticmethod
+    def _fail(label, ctx, sig):
+        err = ErrInvalidSignature(
+            f"wrong signature in {label}: {sig.hex()}")
+        err.failed_ctx = ctx
+        return err
 
     def verify(self) -> None:
-        """Raises ErrInvalidSignature naming the first failing commit."""
+        """Raises ErrInvalidSignature naming the first failing commit
+        (with .failed_ctx carrying that commit's context value)."""
         if not self._entries:
             return
+        self._entries, entries = [], self._entries
+        if len(entries) < self.DEVICE_THRESHOLD:
+            for label, ctx, pub, sign_bytes, sig in entries:
+                if not pub.verify_signature(sign_bytes, sig):
+                    raise self._fail(label, ctx, sig)
+            return
         bv = crypto_batch.MixedBatchVerifier()
-        for _, pub, sign_bytes, sig in self._entries:
+        for _, _, pub, sign_bytes, sig in entries:
             bv.add(pub, sign_bytes, sig)
         ok, verdicts = bv.verify()
-        self._entries, entries = [], self._entries
         if ok:
             return
-        for (label, _, _, sig), valid in zip(entries, verdicts):
+        for (label, ctx, _, _, sig), valid in zip(entries, verdicts):
             if not valid:
-                raise ErrInvalidSignature(
-                    f"wrong signature in {label}: {sig.hex()}")
+                raise self._fail(label, ctx, sig)
         raise CommitVerificationError(
             "BUG: deferred batch failed with no invalid signatures")
 
@@ -139,7 +159,7 @@ def _verify_commit_light(chain_id, vals, block_id, height, commit,
     count = lambda cs: True  # noqa: E731
     _verify(chain_id, vals, commit, needed, ignore, count,
             count_all=count_all, lookup_by_index=True, defer_to=defer_to,
-            defer_label=f"commit at height {height}")
+            defer_label=f"commit at height {height}", defer_ctx=height)
 
 
 def verify_commit_light_trusting(chain_id: str, vals: ValidatorSet,
@@ -195,7 +215,8 @@ def _verify_basic(vals, commit, height, block_id):
 
 
 def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
-            lookup_by_index, defer_to=None, defer_label=""):
+            lookup_by_index, defer_to=None, defer_label="",
+            defer_ctx=None):
     """Unified batch/single verification.
 
     Mirrors verifyCommitBatch/verifyCommitSingle (validation.go:220-408):
@@ -243,7 +264,7 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
         raise CommitVerificationError("BUG: no signatures to verify")
 
     if defer_to is not None:
-        defer_to._extend(defer_label, entries)
+        defer_to._extend(defer_label, defer_ctx, entries)
         return
 
     if use_batch:

@@ -340,9 +340,10 @@ class ValidatorSet:
         verify_commit(chain_id, self, block_id, height, commit)
 
     def verify_commit_light(self, chain_id: str, block_id, height: int,
-                            commit) -> None:
+                            commit, defer_to=None) -> None:
         from .validation import verify_commit_light
-        verify_commit_light(chain_id, self, block_id, height, commit)
+        verify_commit_light(chain_id, self, block_id, height, commit,
+                            defer_to=defer_to)
 
     def verify_commit_light_trusting(self, chain_id: str, commit,
                                      trust_level) -> None:

@@ -157,3 +157,43 @@ class TestPreverifiedContract:
         # without the marker the same vote is valid
         vote2.preverified = None
         assert vs.add_vote(vote2)
+
+
+class TestDeferredSigBatch:
+    def test_failed_ctx_attribution(self):
+        """A bad signature raises with .failed_ctx naming the commit's
+        context (the blocksync window uses the height for peer blame)."""
+        import pytest
+
+        from cometbft_tpu.types.validation import (
+            DeferredSigBatch, ErrInvalidSignature)
+        from cometbft_tpu.types.vote import PRECOMMIT_TYPE
+        from cometbft_tpu.types.vote_set import commit_to_vote_set
+        from tests.test_vote_set import (
+            CHAIN, block_id, make_valset, signed_vote)
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        vals, privs = make_valset(3)
+        batch = DeferredSigBatch()
+        commits = []
+        for h in (5, 6, 7):
+            vs = VoteSet(CHAIN, h, 0, PRECOMMIT_TYPE, vals)
+            bid = block_id(h)
+            for i, p in enumerate(privs):
+                vs.add_vote(signed_vote(p, i, PRECOMMIT_TYPE, h, 0, bid))
+            commits.append(vs.make_commit())
+        # corrupt height 6's commit
+        import dataclasses
+        bad = commits[1]
+        bad.signatures = [
+            dataclasses.replace(
+                cs, signature=cs.signature[:6]
+                + bytes([cs.signature[6] ^ 1]) + cs.signature[7:])
+            if cs.signature else cs
+            for cs in bad.signatures]
+        for h, commit in zip((5, 6, 7), commits):
+            vals.verify_commit_light(CHAIN, commit.block_id, h, commit,
+                                     defer_to=batch)
+        with pytest.raises(ErrInvalidSignature) as ei:
+            batch.verify()
+        assert ei.value.failed_ctx == 6
