@@ -200,9 +200,11 @@ class BlocksyncReactor(Reactor):
         batch = DeferredSigBatch()
         verified = 0
         parts_ids = []
+        collecting_h = None
         try:
             for i in range(usable):
                 block = blocks[i]
+                collecting_h = block.header.height
                 if i == 0:
                     vals = self.state.validators
                 elif block.header.validators_hash == next_hash:
@@ -216,13 +218,14 @@ class BlocksyncReactor(Reactor):
                     self.state.chain_id, bid, block.header.height,
                     commits[i], defer_to=batch)
                 verified += 1
+            collecting_h = None
             # HOT PATH: one device dispatch for the whole window
             batch.verify()
         except Exception as e:
-            # blame the failing height (the commit for height h rides
-            # in the block at h+1, and redo_request evicts both
-            # suppliers); fall back to the window head
-            bad_h = getattr(e, "failed_ctx", None) or \
+            # blame the failing height: a deferred sig failure carries
+            # it as failed_ctx; structural errors (bad commit shape,
+            # not enough power) fail while collecting that height
+            bad_h = getattr(e, "failed_ctx", None) or collecting_h or \
                 blocks[0].header.height
             for pid in self.pool.redo_request(bad_h):
                 self._on_peer_error(pid, "served invalid block")
@@ -234,6 +237,13 @@ class BlocksyncReactor(Reactor):
             first_ext = window[i][1]
             ext_enabled = self.state.consensus_params \
                 .vote_extensions_enabled(first.header.height)
+            if ext_enabled and first_ext is None:
+                # params changed mid-window (a block we just applied
+                # enabled extensions): the pre-gate used the old
+                # params — refetch, don't evict (reactor.go:540)
+                for pid in self.pool.redo_request(first.header.height):
+                    self._on_peer_error(pid, "missing extended commit")
+                return progressed
             parts, first_id = parts_ids[i]
             try:
                 if ext_enabled:

@@ -1,0 +1,89 @@
+"""Dump a consensus WAL as JSON lines (reference scripts/wal2json):
+every consensus decision is reconstructable from the WAL, and this is
+the operator's window into it after an incident.
+
+    python -m cometbft_tpu.tools.wal2json <data_dir>/cs.wal/wal
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import sys
+
+
+def _jsonable(obj):
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return base64.b64encode(obj).decode()
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        return {f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if hasattr(obj, "__dict__"):
+        return {k: _jsonable(v) for k, v in vars(obj).items()
+                if not k.startswith("_")}
+    return repr(obj)
+
+
+def wal_to_json_lines(head_path: str):
+    """Yield one JSON-ready dict per WAL record (time, type, body).
+
+    STRICTLY read-only: constructing consensus.wal.WAL would repair
+    (truncate) a torn head and open it for append — exactly what a
+    forensic dump must never do.  The rotated-chunk naming is read
+    directly (libs/autofile Group layout: head, head.000, head.001...).
+    """
+    import os
+    import re
+
+    from ..consensus.wal import decode_records
+
+    if not os.path.exists(head_path):
+        raise FileNotFoundError(head_path)
+    d = os.path.dirname(head_path) or "."
+    base = os.path.basename(head_path)
+    pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+    indexes = sorted(int(m.group(1)) for f in os.listdir(d)
+                     if (m := pat.match(f)))
+    paths = [os.path.join(d, f"{base}.{i:03d}") for i in indexes]
+    paths.append(head_path)
+    buf = b""
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                buf += f.read()
+        except FileNotFoundError:
+            pass
+    for timed in decode_records(buf):
+        msg = timed.msg
+        yield {
+            "time": {"seconds": timed.time.seconds,
+                     "nanos": timed.time.nanos},
+            "type": type(msg).__name__,
+            "msg": _jsonable(msg),
+        }
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m cometbft_tpu.tools.wal2json <wal-head-path>",
+              file=sys.stderr)
+        return 2
+    try:
+        for rec in wal_to_json_lines(argv[0]):
+            print(json.dumps(rec))
+    except FileNotFoundError:
+        print(f"no WAL at {argv[0]}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

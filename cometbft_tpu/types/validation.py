@@ -13,6 +13,7 @@ design:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 from ..crypto import batch as crypto_batch
@@ -88,9 +89,14 @@ class DeferredSigBatch:
             self._entries.append((label, ctx, val.pub_key, sign_bytes,
                                   sig))
 
-    # below this many signatures the host fast path wins over a device
-    # dispatch (and avoids cold-compiling a fresh batch shape)
-    DEVICE_THRESHOLD = 128
+    # Below this many signatures the host fast path wins over a device
+    # dispatch (and avoids cold-compiling a fresh batch shape).  The
+    # crossover is higher than crypto/batch.DEVICE_THRESHOLD (which
+    # gates a SINGLE commit's verify) because deferred windows produce
+    # more distinct batch shapes; tunable, never below the batch knob.
+    DEVICE_THRESHOLD = max(
+        crypto_batch.DEVICE_THRESHOLD,
+        int(os.environ.get("COMETBFT_TPU_DEFERRED_THRESHOLD", "128")))
 
     @staticmethod
     def _fail(label, ctx, sig):

@@ -161,3 +161,53 @@ class TestSQLEventSink:
             assert n.tx_indexer is None
         finally:
             n.stop()
+
+
+class TestWal2Json:
+    def test_dump_real_wal(self, tmp_path):
+        """Run a node for a few heights, then dump its WAL to JSON
+        lines (reference scripts/wal2json)."""
+        import json as _json
+        import os
+        import time
+
+        from cometbft_tpu.config import test_config as _tcfg
+        from cometbft_tpu.node import Node, init_files
+        from cometbft_tpu.tools.wal2json import main as wal2json_main
+        from tests.test_consensus import wait_for_height
+
+        home = str(tmp_path)
+        cfg = _tcfg(home)
+        init_files(cfg, chain_id="wal-chain")
+        n = Node(cfg)
+        n.start()
+        try:
+            assert wait_for_height(n.consensus_state, 3, timeout=60)
+        finally:
+            n.stop()
+        head = os.path.join(cfg.db_dir(), "cs.wal", "wal")
+        assert os.path.exists(head)
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = wal2json_main([head])
+        assert rc == 0
+        lines = [l for l in buf.getvalue().splitlines() if l]
+        assert len(lines) > 5
+        types = {_json.loads(l)["type"] for l in lines}
+        assert "EndHeightMessage" in types
+        assert "MsgInfo" in types
+        # every line is valid JSON with a time
+        rec = _json.loads(lines[0])
+        assert "time" in rec and "msg" in rec
+
+    def test_missing_wal(self, tmp_path):
+        import os
+
+        from cometbft_tpu.tools.wal2json import main as wal2json_main
+
+        missing = str(tmp_path / "no-such-dir" / "wal")
+        assert wal2json_main([missing]) == 1
+        # the dump tool must not create anything (WAL() would)
+        assert not os.path.exists(os.path.dirname(missing))
