@@ -29,8 +29,8 @@ class BatchVerifier(Protocol):
     def count(self) -> int: ...
 
 
-class CpuEd25519BatchVerifier:
-    """Host-side loop with ZIP-215 semantics (parity oracle)."""
+class _SigCollector:
+    """Shared add/count scaffolding: items are (pubkey_bytes, msg, sig)."""
 
     def __init__(self):
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -42,40 +42,44 @@ class CpuEd25519BatchVerifier:
     def count(self) -> int:
         return len(self._items)
 
+
+class _CpuLoopVerifier(_SigCollector):
+    """Host-side per-signature loop (parity oracle for a device path);
+    subclasses provide _check(pk, msg, sig) -> bool."""
+
     def verify(self) -> tuple[bool, list[bool]]:
-        from . import ed25519_ref as ref
-        verdicts = [ref.verify(pk, m, s) for pk, m, s in self._items]
+        verdicts = []
+        for pk, m, s in self._items:
+            try:
+                verdicts.append(bool(self._check(pk, m, s)))
+            except ValueError:
+                verdicts.append(False)
         return all(verdicts) and bool(verdicts), verdicts
 
 
-class TpuEd25519BatchVerifier:
+class CpuEd25519BatchVerifier(_CpuLoopVerifier):
+    """ZIP-215 host loop (crypto/ed25519_ref)."""
+
+    def _check(self, pk, m, s):
+        from . import ed25519_ref as ref
+        return ref.verify(pk, m, s)
+
+
+class TpuEd25519BatchVerifier(_SigCollector):
     """Packs the batch into uint32 arrays and runs the device kernel.
 
     Batch sizes are bucketed (ops/ed25519.BATCH_BUCKETS) so the jitted
     kernel compiles once per bucket; slots past the real batch are masked.
     """
 
-    def __init__(self):
-        self._pks: list[bytes] = []
-        self._msgs: list[bytes] = []
-        self._sigs: list[bytes] = []
-
-    def add(self, pubkey, msg: bytes, sig: bytes) -> None:
-        pk = pubkey.bytes() if hasattr(pubkey, "bytes") else bytes(pubkey)
-        self._pks.append(pk)
-        self._msgs.append(msg)
-        self._sigs.append(sig)
-
-    def count(self) -> int:
-        return len(self._pks)
-
     def verify(self) -> tuple[bool, list[bool]]:
-        n = len(self._pks)
-        if n == 0:
+        if not self._items:
             return False, []
+        pks = [i[0] for i in self._items]
         # parse + hash ONCE; both device packings build from this
-        parsed = ed.parse_and_hash(self._pks, self._msgs, self._sigs)
-        return _device_verify(self._pks, parsed)
+        parsed = ed.parse_and_hash(pks, [i[1] for i in self._items],
+                                   [i[2] for i in self._items])
+        return _device_verify(pks, parsed)
 
 
 def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
@@ -103,45 +107,56 @@ def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
     return all(out) and bool(out), out
 
 
-class CpuSr25519BatchVerifier:
-    """Host-side loop (parity oracle for the sr25519 device path)."""
+class CpuSecp256k1BatchVerifier(_CpuLoopVerifier):
+    """Parity oracle for the secp256k1 device path."""
 
-    def __init__(self):
-        self._items: list[tuple[bytes, bytes, bytes]] = []
+    def _check(self, pk, m, s):
+        from . import secp256k1 as sk
+        return sk.PubKey(pk).verify_signature(m, s)
 
-    def add(self, pubkey, msg: bytes, sig: bytes) -> None:
-        pk = pubkey.bytes() if hasattr(pubkey, "bytes") else bytes(pubkey)
-        self._items.append((pk, msg, sig))
 
-    def count(self) -> int:
-        return len(self._items)
+class TpuSecp256k1BatchVerifier(_SigCollector):
+    """ECDSA batch on the device: per-signature Straus double-scalar
+    multiplication with a verdict bitmap (ops/secp256k1.verify_kernel).
+    ECDSA admits no RLC whole-batch equation (each check compares an
+    x-coordinate), so the per-signature kernel IS the batch path —
+    still one dispatch for the whole batch.  The reference refuses to
+    batch secp256k1 at all (crypto/batch/batch.go:12)."""
 
     def verify(self) -> tuple[bool, list[bool]]:
+        import numpy as np
+
+        from ..ops import ed25519 as ed_dev
+        from ..ops import secp256k1 as dev
+        from . import secp256k1 as sk
+
+        n = len(self._items)
+        if n == 0:
+            return False, []
+        bucket = ed_dev.bucket_size(n)      # same bucketing discipline
+        packed = sk.pack_batch([i[0] for i in self._items],
+                               [i[1] for i in self._items],
+                               [i[2] for i in self._items], bucket)
+        valid = packed[-1]
+        verdict = np.asarray(dev.verify_batch_device(*packed[:-1]))
+        verdict = verdict & valid
+        out = verdict[:n].tolist()
+        return all(out) and bool(out), out
+
+
+class CpuSr25519BatchVerifier(_CpuLoopVerifier):
+    """Parity oracle for the sr25519 device path."""
+
+    def _check(self, pk, m, s):
         from . import sr25519 as sr
-        verdicts = []
-        for pk, m, s in self._items:
-            try:
-                verdicts.append(sr.PubKey(pk).verify_signature(m, s))
-            except ValueError:
-                verdicts.append(False)
-        return all(verdicts) and bool(verdicts), verdicts
+        return sr.PubKey(pk).verify_signature(m, s)
 
 
-class TpuSr25519BatchVerifier:
+class TpuSr25519BatchVerifier(_SigCollector):
     """sr25519 batches on the ed25519 device kernels: ristretto points
     re-encoded in Edwards form, Merlin challenges in place of the
     SHA-512 challenge (see crypto/sr25519.to_edwards_inputs; the
     reference's analog is sr25519.BatchVerifier in batch.go)."""
-
-    def __init__(self):
-        self._items: list[tuple[bytes, bytes, bytes]] = []
-
-    def add(self, pubkey, msg: bytes, sig: bytes) -> None:
-        pk = pubkey.bytes() if hasattr(pubkey, "bytes") else bytes(pubkey)
-        self._items.append((pk, msg, sig))
-
-    def count(self) -> int:
-        return len(self._items)
 
     def verify(self) -> tuple[bool, list[bool]]:
         from . import sr25519 as sr
@@ -170,14 +185,16 @@ class TpuSr25519BatchVerifier:
 # ours is higher because the device round-trip has fixed cost).
 DEVICE_THRESHOLD = int(os.environ.get("COMETBFT_TPU_BATCH_THRESHOLD", "8"))
 
-# ed25519 & sr25519 support batching, like the reference
-# (crypto/batch/batch.go:12-35)
-_SUPPORTED = {"ed25519", "sr25519"}
+# the reference batches only ed25519 & sr25519 (crypto/batch/batch.go:
+# 12-35); we also batch secp256k1 on device (a BASELINE.json target)
+_SUPPORTED = {"ed25519", "sr25519", "secp256k1"}
 
 _CPU_BY_TYPE = {"ed25519": CpuEd25519BatchVerifier,
-                "sr25519": CpuSr25519BatchVerifier}
+                "sr25519": CpuSr25519BatchVerifier,
+                "secp256k1": CpuSecp256k1BatchVerifier}
 _TPU_BY_TYPE = {"ed25519": TpuEd25519BatchVerifier,
-                "sr25519": TpuSr25519BatchVerifier}
+                "sr25519": TpuSr25519BatchVerifier,
+                "secp256k1": TpuSecp256k1BatchVerifier}
 
 
 def supports_batch_verifier(key_type: str) -> bool:
@@ -210,7 +227,7 @@ class MixedBatchVerifier:
 
     def __init__(self, provider: str | None = None):
         self._provider = provider
-        self._subs: dict[str, BatchVerifier] = {}
+        self._items: dict[str, list] = {}
         self._order: list[tuple[str, int] | None] = []
         self._singles: list[tuple[object, bytes, bytes]] = []
 
@@ -222,18 +239,25 @@ class MixedBatchVerifier:
             self._order.append(None)
             self._singles.append((pubkey, msg, sig))
             return
-        sub = self._subs.get(kt)
-        if sub is None:
-            sub = create_batch_verifier(kt, provider=self._provider)
-            self._subs[kt] = sub
-        self._order.append((kt, sub.count()))
-        sub.add(pubkey, msg, sig)
+        items = self._items.setdefault(kt, [])
+        self._order.append((kt, len(items)))
+        items.append((pubkey, msg, sig))
 
     def count(self) -> int:
         return len(self._order)
 
     def verify(self) -> tuple[bool, list[bool]]:
-        results = {kt: sub.verify()[1] for kt, sub in self._subs.items()}
+        # per-type verifiers are created HERE so n_hint can route
+        # sub-threshold sub-batches (e.g. a lone secp256k1 validator in
+        # an ed25519 set) to the cheap host loop instead of a device
+        # dispatch + cold kernel compile
+        results = {}
+        for kt, items in self._items.items():
+            sub = create_batch_verifier(kt, n_hint=len(items),
+                                        provider=self._provider)
+            for pk, msg, sig in items:
+                sub.add(pk, msg, sig)
+            results[kt] = sub.verify()[1]
         singles = iter(self._singles)
         out = []
         for slot in self._order:

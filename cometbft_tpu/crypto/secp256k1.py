@@ -153,6 +153,22 @@ def _rfc6979_k(x: int, h1: bytes) -> int:
         v = hmac.new(key, v, hashlib.sha256).digest()
 
 
+def parse_signature(sig: bytes) -> tuple[int, int] | None:
+    """The ONE place the signature accept-set is defined (64-byte R||S,
+    1 <= r,s < n, lower-S malleability rule — secp256k1.go:205-214);
+    used by both the host verify and the device batch packer so the
+    accept sets cannot drift."""
+    if len(sig) != SIGNATURE_SIZE:
+        return None
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return None
+    if s > N // 2:
+        return None
+    return r, s
+
+
 def _verify_py(pub_xy: tuple[int, int], digest: bytes, r: int, s: int) -> bool:
     """Textbook ECDSA verify over the already-parsed values."""
     e = int.from_bytes(digest, "big")
@@ -212,14 +228,10 @@ class PubKey:
         return hashlib.new("ripemd160", sum_sha256(self.data)).digest()
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        if len(sig) != SIGNATURE_SIZE:
+        parsed = parse_signature(sig)
+        if parsed is None:
             return False
-        r = int.from_bytes(sig[:32], "big")
-        s = int.from_bytes(sig[32:], "big")
-        if not (1 <= r < N and 1 <= s < N):
-            return False
-        if s > N // 2:  # lower-S malleability rule (secp256k1.go:205-214)
-            return False
+        r, s = parsed
         if _HAVE_OPENSSL:
             return _verify_openssl(self.data, msg, r, s)
         xy = _decompress(self.data)
@@ -281,3 +293,74 @@ class PrivKey:
         if s > N // 2:
             s = N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+# -- device batch packing ---------------------------------------------------
+
+def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
+               batch_size: int):
+    """Pack an ECDSA batch for ops/secp256k1.verify_kernel.
+
+    Host side per signature (all cheap bigint work): structural checks
+    (lengths, 1 <= r,s < n, lower-S), pubkey decompression, e = SHA-256,
+    w = s^-1 mod n, u1 = e*w, u2 = r*w, and MSB-first 4-bit window
+    recoding of u1/u2.  Entries failing a structural check get a benign
+    filler whose verdict is False by construction (u1 = 1, u2 = 0,
+    r = 0: x(G) != 0).
+
+    Returns (qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs, rn_valid,
+    valid) with the kernel's limbs-first layouts.
+    """
+    import numpy as np
+
+    from ..ops import fe_secp as fs
+
+    n = len(pubkeys)
+    assert batch_size >= n
+    qx = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    qy = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    u1n = np.zeros((batch_size, 64), np.int32)
+    u2n = np.zeros((batch_size, 64), np.int32)
+    r_l = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    rn_l = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    rn_ok = np.zeros(batch_size, bool)
+    valid = np.zeros(batch_size, bool)
+
+    def nibs(v: int) -> np.ndarray:
+        out = np.zeros(64, np.int32)
+        for j in range(63, -1, -1):
+            out[j] = v & 0xF
+            v >>= 4
+        return out
+
+    gx_l = fs.int_to_limbs(GX)
+    gy_l = fs.int_to_limbs(GY)
+    filler_u1 = nibs(1)
+    for i in range(batch_size):
+        ok = False
+        if i < n:
+            parsed = parse_signature(sigs[i])
+            if parsed is not None:
+                r, s = parsed
+                xy = _decompress(pubkeys[i])
+                if xy is not None:
+                    e = int.from_bytes(sum_sha256(msgs[i]), "big")
+                    w = _inv(s, N)
+                    u1, u2 = e * w % N, r * w % N
+                    qx[i] = fs.int_to_limbs(xy[0])
+                    qy[i] = fs.int_to_limbs(xy[1])
+                    u1n[i] = nibs(u1)
+                    u2n[i] = nibs(u2)
+                    r_l[i] = fs.int_to_limbs(r)
+                    if r + N < P:
+                        rn_l[i] = fs.int_to_limbs(r + N)
+                        rn_ok[i] = True
+                    ok = True
+        if not ok:
+            qx[i], qy[i] = gx_l, gy_l
+            u1n[i] = filler_u1
+        valid[i] = ok
+    return (np.ascontiguousarray(qx.T), np.ascontiguousarray(qy.T),
+            np.ascontiguousarray(u1n.T), np.ascontiguousarray(u2n.T),
+            np.ascontiguousarray(r_l.T), np.ascontiguousarray(rn_l.T),
+            rn_ok, valid)

@@ -1,0 +1,248 @@
+"""GF(p) arithmetic for secp256k1 on TPU, p = 2^256 - 2^32 - 977.
+
+Same layout discipline as ops/fe.py (the ed25519 field): limbs-first
+(NLIMBS, ...batch) signed int32 limbs, elementwise ops only, carries as
+sublane-axis shifts.  The representation is 22 limbs of radix 2^12
+(264 bits) chosen so the wrap constant is SMALL: 2^264 == 2^40 + 250112
+(mod p), which decomposes onto limbs as
+
+    250112 = 61*2^12 + 256      -> +256 at limb 0, +61 at limb 1
+    2^40   = 2^4 * 2^36         -> +16 at limb 3
+
+so a top carry re-enters as three adds with multipliers <= 256 and the
+carry iteration converges to a weak form |limb| <= ~4900 (the naive
+20x13 layout would need a 7440 multiplier at limb 0, which never
+converges below the mul input bound).
+
+Bounds proof sketch:
+- weak form: limbs in [-1100, 4900]; mul accepts |limb| <= 5000
+  (22 * 5000^2 = 5.5e8 < 2^31).
+- product columns <= 5.5e8; one column carry pass leaves them
+  <= 2^12 + 5.5e8/2^12 ~ 139k; the fold multiplies by <= 256:
+  139k*256 = 3.6e7, summed with the 61x and 16x terms < 5e7 << 2^31.
+
+Reference analog: the field arithmetic inside btcec consumed by
+/root/reference/crypto/secp256k1/secp256k1.go:193.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NLIMBS = 22
+RADIX = 12
+BASE = 1 << RADIX
+MASK = BASE - 1
+P = (1 << 256) - (1 << 32) - 977
+
+# 2^264 mod p decomposed onto limbs: (multiplier, limb offset)
+_WRAP = ((256, 0), (61, 1), (16, 3))
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    x %= P
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = x & MASK
+        x >>= RADIX
+    assert x == 0
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(arr)) % P
+
+
+ZERO_LIMBS = int_to_limbs(0)
+ONE_LIMBS = int_to_limbs(1)
+SEVEN_LIMBS = int_to_limbs(7)
+
+# canonical digits of p
+_P_CANON = np.zeros(NLIMBS, dtype=np.int32)
+_t = P
+for _i in range(NLIMBS):
+    _P_CANON[_i] = _t & MASK
+    _t >>= RADIX
+
+# 17p: every digit >= 3839 — weak-form limbs can reach about -1800
+# (mul's norm_weak lower bound), and the pad must absorb that before
+# the exact sequential carries in freeze()
+_PAD_8P = np.zeros(NLIMBS, dtype=np.int32)
+_t = 17 * P
+for _i in range(NLIMBS - 1):
+    _PAD_8P[_i] = _t & MASK
+    _t >>= RADIX
+_PAD_8P[NLIMBS - 1] = _t
+assert sum(int(v) << (RADIX * i) for i, v in enumerate(_PAD_8P)) == 17 * P
+assert (_PAD_8P[:-1] >= 3839).all(), _PAD_8P
+
+
+def _bcast(limbs: np.ndarray, ndim: int) -> jnp.ndarray:
+    return jnp.asarray(limbs.reshape((NLIMBS,) + (1,) * (ndim - 1)))
+
+
+def _carry_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry step; the top limb's carry wraps through
+    2^264 as three small-multiplier adds."""
+    hi = x >> RADIX
+    lo = x - (hi << RADIX)
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(hi[-1:]), hi[:-1]], axis=0)
+    out = lo + shifted
+    top = hi[-1]
+    for w, off in _WRAP:
+        out = out.at[off].add(top * jnp.int32(w))
+    return out
+
+
+def norm_weak(x: jnp.ndarray) -> jnp.ndarray:
+    """Two passes: |limb| < 2^27 -> weak form."""
+    return _carry_pass(_carry_pass(x))
+
+
+def add(a, b):
+    return _carry_pass(a + b)
+
+
+def sub(a, b):
+    return _carry_pass(a - b)
+
+
+def neg(a):
+    return _carry_pass(-a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product -> 43 columns -> one column carry pass ->
+    wrap fold (cols 22.. re-enter via 2^264 multiples) -> spill fold ->
+    weak normalization.  Inputs: |limb| <= 5000."""
+    batch = a.shape[1:]
+    ncols = 2 * NLIMBS - 1                      # 43
+    acc = jnp.zeros((ncols,) + batch, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        acc = acc.at[i:i + NLIMBS].add(a[i] * b)
+    # one carry pass in (ncols+1)-column space
+    acc = jnp.concatenate([acc, jnp.zeros((1,) + batch, jnp.int32)], axis=0)
+    hi = acc >> RADIX
+    lo = acc - (hi << RADIX)
+    acc = lo + jnp.concatenate(
+        [jnp.zeros((1,) + batch, jnp.int32), hi[:-1]], axis=0)
+    # cols now <= 2^12 + 5.5e8/2^12 ~ 139k
+    out = acc[:NLIMBS]
+    hi_cols = acc[NLIMBS:]                      # 22 high cols
+    nh = hi_cols.shape[0]
+    # Spill accumulator for target limbs NLIMBS..NLIMBS+4: limbs 0..2
+    # receive the out-of-range wrap terms (|value| <= 77 * 139k ~ 2^24);
+    # limbs 3..4 hold the single carry pass's output.  Exactly ONE
+    # carry pass: it drops nothing (spill[4] is zero going in, so the
+    # top shift-out is zero) and leaves |limb| <= 4096 + 2^24/2^12
+    # ~ 6.7k, small enough for the x256 fold below (1.7e6 << 2^31).
+    # More passes would be WRONG, not just wasteful: floor-shifting a
+    # -1 borrow yields -1 forever, and earlier revisions dropped that
+    # borrow from the top limb, corrupting one product in ~2^12.
+    spill = jnp.zeros((5,) + batch, dtype=jnp.int32)
+    for w, off in _WRAP:
+        term = hi_cols * jnp.int32(w)
+        fit = min(nh, NLIMBS - off)             # rows landing in-range
+        out = out.at[off:off + fit].add(term[:fit])
+        if fit < nh:                            # rows spilling past top
+            nspill = nh - fit
+            spill = spill.at[off + fit - NLIMBS:
+                             off + fit - NLIMBS + nspill].add(term[fit:])
+    s_hi = spill >> RADIX
+    s_lo = spill - (s_hi << RADIX)
+    spill = s_lo + jnp.concatenate(
+        [jnp.zeros_like(s_hi[:1]), s_hi[:-1]], axis=0)
+    # fold spill limbs j (value 2^(12j) * 2^264) back into the low limbs
+    for j in range(5):
+        for w, off in _WRAP:
+            out = out.at[j + off].add(spill[j] * jnp.int32(w))
+    return norm_weak(out)
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def mul_word(a, w: int):
+    """|w| * 5000 must stay < 2^27 for the carry pass."""
+    return norm_weak(a * jnp.int32(w))
+
+
+# exponent bits of p-2 (MSB-first) for Fermat inversion
+_PM2_BITS_MSB = np.array([(P - 2) >> i & 1 for i in range(255, -1, -1)],
+                         dtype=np.int32)
+
+
+def inv(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) by square-and-multiply over the fixed exponent bits."""
+    bits = jnp.asarray(_PM2_BITS_MSB)
+
+    def step(acc, bit):
+        acc = sqr(acc)
+        with_mul = mul(acc, z)
+        acc = jnp.where(bit == 1, with_mul, acc)
+        return acc, None
+
+    one = jnp.broadcast_to(_bcast(ONE_LIMBS, z.ndim), z.shape)
+    acc, _ = jax.lax.scan(step, one, bits)
+    return acc
+
+
+def _seq_canonical_pass(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact sequential carry, then reduce bits >= 2^256 through
+    2^256 == 2^32 + 977:  2^256 = 2^(21*12 + 4) -> limb 21 bits >= 4."""
+    c = jnp.zeros(x.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(NLIMBS):
+        v = x[i] + c
+        lo = v & jnp.int32(MASK)
+        outs.append(lo)
+        c = (v - lo) >> RADIX
+    x = jnp.stack(outs, axis=0)
+    top = x[21] >> jnp.int32(4)          # value units of 2^256
+    x = x.at[21].set(x[21] & jnp.int32(0xF))
+    extra = top + c * jnp.int32(1 << 8)  # carry c is units of 2^264
+    # v*2^256 == v*(2^32+977): 2^32 = 2^(2*12+8)
+    x = x.at[0].add(extra * jnp.int32(977))
+    x = x.at[2].add(extra * jnp.int32(1 << 8))
+    return x
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p)."""
+    x = norm_weak(a) + _bcast(_PAD_8P, a.ndim)
+    for _ in range(3):
+        x = _seq_canonical_pass(x)
+    return _cond_sub_p(x)
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    p_l = jnp.asarray(_P_CANON)
+    gt = jnp.zeros(x.shape[1:], dtype=bool)
+    eq_ = jnp.ones(x.shape[1:], dtype=bool)
+    for i in range(NLIMBS - 1, -1, -1):
+        gt = gt | (eq_ & (x[i] > p_l[i]))
+        eq_ = eq_ & (x[i] == p_l[i])
+    take = (gt | eq_)[None]
+    diff = x - _bcast(_P_CANON, x.ndim)
+    c = jnp.zeros(diff.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(NLIMBS):
+        v = diff[i] + c
+        lo = v & jnp.int32(MASK)
+        outs.append(lo)
+        c = (v - lo) >> RADIX
+    diff = jnp.stack(outs, axis=0)
+    return jnp.where(take, diff, x)
+
+
+def is_zero(a):
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def eq(a, b):
+    return is_zero(sub(a, b))

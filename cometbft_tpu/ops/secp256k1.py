@@ -1,0 +1,228 @@
+"""Batched secp256k1 ECDSA verification on TPU.
+
+Mirrors _verify_py in crypto/secp256k1.py (itself the reference's
+btcec-backed PubKey.VerifySignature,
+/root/reference/crypto/secp256k1/secp256k1.go:193): the host computes
+e = SHA-256(msg), w = s^-1 mod n, u1 = e*w, u2 = r*w and decompresses
+the pubkey; the device computes R' = u1*G + u2*Q with a shared-doubling
+Straus loop and checks x(R') == r (mod n).
+
+TPU-first structure (same playbook as ops/ed25519.py):
+- field ops from ops/fe_secp (22x12-bit signed limbs, limbs-first);
+- Jacobian points as (3, 22, batch) stacks, infinity as an explicit
+  boolean plane (the short-Weierstrass formulas are not complete, so
+  special cases select between computed branches);
+- window tables as 16-way predicated-select cascades;
+- the in-loop additions handle the H=0 collision cases exactly
+  (doubling / inverse), because u1, u2 and Q are attacker-controlled
+  in verification and a silent wrong-curve-result must not be
+  reachable by construction.
+
+The reference never batches secp256k1 (crypto/batch/batch.go supports
+only ed25519/sr25519); doing it on device is a BASELINE.json target
+("mixed keytypes per commit").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import fe_secp as fs
+
+# secp256k1 group order
+N_ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_X, _Y, _Z = 0, 1, 2
+
+
+def _pt(x, y, z):
+    return jnp.stack([x, y, z], axis=0)
+
+
+def _zero_fe(batch_shape):
+    return jnp.zeros((fs.NLIMBS,) + batch_shape, dtype=jnp.int32)
+
+
+def _one_fe(batch_shape):
+    return jnp.broadcast_to(
+        jnp.asarray(fs.ONE_LIMBS).reshape(
+            (fs.NLIMBS,) + (1,) * len(batch_shape)),
+        (fs.NLIMBS,) + batch_shape).astype(jnp.int32)
+
+
+def jdbl(p):
+    """dbl-2009-l for a=0; complete (Z=0 stays Z=0, no 2-torsion)."""
+    x, y, z = p[_X], p[_Y], p[_Z]
+    a = fs.sqr(x)
+    b = fs.sqr(y)
+    c = fs.sqr(b)
+    d = fs.sub(fs.sub(fs.sqr(fs.add(x, b)), a), c)
+    d = fs.add(d, d)
+    e = fs.add(fs.add(a, a), a)
+    f = fs.sqr(e)
+    x3 = fs.sub(f, fs.add(d, d))
+    c8 = fs.add(c, c)
+    c8 = fs.add(c8, c8)
+    c8 = fs.add(c8, c8)
+    y3 = fs.sub(fs.mul(e, fs.sub(d, x3)), c8)
+    z3 = fs.mul(y, z)
+    z3 = fs.add(z3, z3)
+    return _pt(x3, y3, z3)
+
+
+def _jadd_core(p, q):
+    """add-2007-bl; UNDEFINED for p == +-q or infinities (callers
+    select around those)."""
+    z1z1 = fs.sqr(p[_Z])
+    z2z2 = fs.sqr(q[_Z])
+    u1 = fs.mul(p[_X], z2z2)
+    u2 = fs.mul(q[_X], z1z1)
+    s1 = fs.mul(fs.mul(p[_Y], q[_Z]), z2z2)
+    s2 = fs.mul(fs.mul(q[_Y], p[_Z]), z1z1)
+    h = fs.sub(u2, u1)
+    rr = fs.sub(s2, s1)
+    h2 = fs.sqr(h)
+    h3 = fs.mul(h, h2)
+    v = fs.mul(u1, h2)
+    x3 = fs.sub(fs.sub(fs.sqr(rr), h3), fs.add(v, v))
+    y3 = fs.sub(fs.mul(rr, fs.sub(v, x3)), fs.mul(s1, h3))
+    z3 = fs.mul(fs.mul(p[_Z], q[_Z]), h)
+    return _pt(x3, y3, z3), h, rr
+
+
+def jadd_fast(p, q):
+    """Addition for structurally-distinct nonzero points (table build:
+    rows (k-1)Q + Q with 2 <= k <= 15 can never collide)."""
+    out, _, _ = _jadd_core(p, q)
+    return out
+
+
+def jadd_complete(p, p_inf, q, q_inf):
+    """Exact addition: handles p/q infinity, p == q (doubling) and
+    p == -q (infinity) by selecting among computed branches.  The
+    zero-tests are exact (canonical) — u1/u2/Q are adversarial inputs
+    in signature verification, so the collision branches must be
+    correct, not just overwhelmingly probable."""
+    added, h, rr = _jadd_core(p, q)
+    doubled = jdbl(p)
+    h_zero = fs.is_zero(h)
+    r_zero = fs.is_zero(rr)
+    is_dbl = h_zero & r_zero & ~p_inf & ~q_inf
+    is_cancel = h_zero & ~r_zero & ~p_inf & ~q_inf
+
+    out = jnp.where(is_dbl[None, None], doubled, added)
+    out = jnp.where(p_inf[None, None], q, out)
+    out = jnp.where(q_inf[None, None], p, out)
+    out_inf = (p_inf & q_inf) | is_cancel
+    # a cancelled pair must also present valid coords for later ops
+    one = _one_fe(p.shape[2:])
+    zero = _zero_fe(p.shape[2:])
+    ident = _pt(one, one, zero * 0 + one)     # (1,1,1): harmless filler
+    out = jnp.where(is_cancel[None, None], ident, out)
+    return out, out_inf
+
+
+# static 16-row G window table, affine (Z=1), row 0 = filler (the
+# nib==0 case is handled by the entry-infinity mask)
+def _g_table_np() -> np.ndarray:
+    from ..crypto import secp256k1 as host
+
+    rows = np.zeros((16, 3, fs.NLIMBS), dtype=np.int32)
+    for k in range(16):
+        if k == 0:
+            rows[0, 0] = fs.ONE_LIMBS
+            rows[0, 1] = fs.ONE_LIMBS
+            rows[0, 2] = fs.ONE_LIMBS
+            continue
+        pt = host._jaffine(host._jmul(k, (GX, GY, 1)))
+        rows[k, 0] = fs.int_to_limbs(pt[0])
+        rows[k, 1] = fs.int_to_limbs(pt[1])
+        rows[k, 2] = fs.ONE_LIMBS
+    return rows
+
+
+_GTAB_NP = None
+
+
+def _g_table():
+    global _GTAB_NP
+    if _GTAB_NP is None:
+        _GTAB_NP = _g_table_np()
+    return _GTAB_NP
+
+
+def _select(table, nib):
+    """(16, 3, 22, ...) table + (...) nibbles -> (3, 22, ...)."""
+    sel = table[0]
+    cond = nib[None, None]
+    for k in range(1, 16):
+        sel = jnp.where(cond == jnp.int32(k), table[k], sel)
+    return sel
+
+
+def _q_table(qx, qy):
+    """Per-signature 16-row table of k*Q, Jacobian, via scan."""
+    batch = qx.shape[1:]
+    one = _one_fe(batch)
+    q1 = _pt(qx, qy, one)
+    q2 = jdbl(q1)
+
+    def body(prev, _):
+        nxt = jadd_fast(prev, q1)
+        return nxt, nxt
+
+    _, rows = jax.lax.scan(body, q2, None, length=13)   # 3Q..15Q
+    filler = _pt(one, one, one)
+    return jnp.concatenate(
+        [filler[None], q1[None], q2[None], rows], axis=0)
+
+
+def verify_kernel(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs, rn_valid):
+    """Batched ECDSA verify.
+
+    qx, qy: (22, B) affine pubkey coords (host-decompressed).
+    u1_nibs, u2_nibs: (64, B) int32 4-bit windows, MSB-first.
+    r_limbs: (22, B) r as a field element; rn_limbs: (22, B) r + n
+    (field-reduced) with rn_valid: (B,) marking r + n < p.
+    Returns (B,) bool: x(u1 G + u2 Q) == r (mod n), not infinity.
+    """
+    batch = qx.shape[1:]
+    gtab = jnp.asarray(_g_table().reshape(
+        (16, 3, fs.NLIMBS) + (1,) * len(batch)))
+    gtab = jnp.broadcast_to(gtab, (16, 3, fs.NLIMBS) + batch)
+    qtab = _q_table(qx, qy)
+
+    acc = _pt(_one_fe(batch), _one_fe(batch), _zero_fe(batch))
+    acc_inf = jnp.ones(batch, dtype=bool)
+
+    def step(carry, xs):
+        acc, acc_inf = carry
+        n1, n2 = xs
+        acc = jdbl(jdbl(jdbl(jdbl(acc))))
+        g_entry = _select(gtab, n1)
+        acc, acc_inf = jadd_complete(acc, acc_inf, g_entry, n1 == 0)
+        q_entry = _select(qtab, n2)
+        acc, acc_inf = jadd_complete(acc, acc_inf, q_entry, n2 == 0)
+        return (acc, acc_inf), None
+
+    (acc, acc_inf), _ = jax.lax.scan(step, (acc, acc_inf),
+                                     (u1_nibs, u2_nibs))
+
+    # affine x = X / Z^2; compare against r and (when < p) r + n
+    z2 = fs.sqr(acc[_Z])
+    x_aff = fs.mul(acc[_X], fs.inv(z2))
+    eq_r = fs.eq(x_aff, r_limbs)
+    eq_rn = fs.eq(x_aff, rn_limbs) & rn_valid
+    return ~acc_inf & (eq_r | eq_rn)
+
+
+_jitted = jax.jit(verify_kernel)
+
+
+def verify_batch_device(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs,
+                        rn_valid):
+    return _jitted(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs, rn_valid)

@@ -1,0 +1,174 @@
+"""secp256k1 ECDSA on the device (ops/fe_secp.py + ops/secp256k1.py)
+against the host implementation as oracle.  The reference never
+batches secp256k1 (crypto/batch/batch.go supports only ed25519 and
+sr25519); batching it on device is a BASELINE.json target."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import batch as cb
+from cometbft_tpu.crypto import secp256k1 as sk
+from cometbft_tpu.ops import fe_secp as fs
+from cometbft_tpu.ops import secp256k1 as dev
+
+
+# -- field layer ------------------------------------------------------------
+
+class TestFeSecp:
+    def test_ops_match_bigint(self):
+        rng = np.random.default_rng(0)
+        vals_a = [int.from_bytes(rng.bytes(32), "little") % fs.P
+                  for _ in range(32)]
+        vals_b = [int.from_bytes(rng.bytes(32), "little") % fs.P
+                  for _ in range(32)]
+        vals_a[:4] = [0, 1, fs.P - 1, fs.P - 977]
+        vals_b[:4] = [0, fs.P - 1, fs.P - 1, 1 << 255]
+        A = jnp.asarray(np.stack([fs.int_to_limbs(v) for v in vals_a], 1))
+        B = jnp.asarray(np.stack([fs.int_to_limbs(v) for v in vals_b], 1))
+        for name, got, want in (
+                ("add", fs.add(A, B), lambda a, b: (a + b) % fs.P),
+                ("sub", fs.sub(A, B), lambda a, b: (a - b) % fs.P),
+                ("mul", fs.mul(A, B), lambda a, b: a * b % fs.P),
+                ("neg", fs.neg(A), lambda a, b: -a % fs.P)):
+            out = np.asarray(fs.freeze(got))
+            for i in range(32):
+                assert fs.limbs_to_int(out[:, i]) == \
+                    want(vals_a[i], vals_b[i]), (name, i)
+
+    def test_deep_chain_and_weak_form_inputs(self):
+        """Long op chains keep redundant-form bounds AND correctness —
+        the spill-borrow bug this pins appeared only on weak-form
+        (negative-limb) operands after dozens of ops."""
+        rng = np.random.default_rng(1)
+        vals = [int.from_bytes(rng.bytes(32), "little") % fs.P
+                for _ in range(16)]
+        X = jnp.asarray(np.stack([fs.int_to_limbs(v) for v in vals], 1))
+        Y = X
+        want = list(vals)
+        for step in range(60):
+            # alternate sub (creates negative limbs) and mul
+            Y = fs.sub(Y, X) if step % 3 == 0 else Y
+            Y = fs.mul(Y, X)
+            for i in range(16):
+                w = want[i]
+                if step % 3 == 0:
+                    w = (w - vals[i]) % fs.P
+                want[i] = w * vals[i] % fs.P
+            assert int(np.abs(np.asarray(Y)).max()) < 6000
+        out = np.asarray(fs.freeze(Y))
+        for i in range(16):
+            assert fs.limbs_to_int(out[:, i]) == want[i], i
+
+    def test_inv(self):
+        vals = [3, 977, fs.P - 2, 1 << 200]
+        X = jnp.asarray(np.stack([fs.int_to_limbs(v) for v in vals], 1))
+        out = np.asarray(fs.freeze(fs.mul(fs.inv(X), X)))
+        for i in range(4):
+            assert fs.limbs_to_int(out[:, i]) == 1
+
+
+# -- point ops --------------------------------------------------------------
+
+class TestSecpPoints:
+    def test_jadd_complete_branches(self):
+        def to_dev(x, y, z):
+            arr = lambda v: jnp.asarray(  # noqa: E731
+                np.stack([fs.int_to_limbs(v)], 1))
+            return dev._pt(arr(x), arr(y), arr(z))
+
+        g2 = sk._jaffine(sk._jmul(2, sk._G))
+        g4 = sk._jaffine(sk._jmul(4, sk._G))
+        lam = 987654321
+        scaled = (g2[0] * lam * lam % sk.P,
+                  g2[1] * pow(lam, 3, sk.P) % sk.P, lam)
+        F = jnp.asarray([False])
+        # doubling collision (same point, different Z scaling)
+        out, inf = dev.jadd_complete(to_dev(*scaled), F,
+                                     to_dev(g2[0], g2[1], 1), F)
+        gx = fs.limbs_to_int(np.asarray(fs.freeze(out[0]))[:, 0])
+        gz = fs.limbs_to_int(np.asarray(fs.freeze(out[2]))[:, 0])
+        zi = pow(gz, fs.P - 2, fs.P)
+        assert gx * zi * zi % fs.P == g4[0] and not bool(np.asarray(inf)[0])
+        # cancellation -> infinity
+        out, inf = dev.jadd_complete(
+            to_dev(*scaled), F, to_dev(g2[0], -g2[1] % sk.P, 1), F)
+        assert bool(np.asarray(inf)[0])
+        # infinity absorbs
+        out, inf = dev.jadd_complete(
+            to_dev(1, 1, 0), jnp.asarray([True]),
+            to_dev(g2[0], g2[1], 1), F)
+        gx = fs.limbs_to_int(np.asarray(fs.freeze(out[0]))[:, 0])
+        assert gx == g2[0] and not bool(np.asarray(inf)[0])
+
+
+# -- full kernel ------------------------------------------------------------
+
+def _sign_batch(n, tamper=None):
+    privs = [sk.PrivKey.generate(bytes([i + 1]) * 32) for i in range(n)]
+    pks, msgs, sigs = [], [], []
+    for i, p in enumerate(privs):
+        m = f"secp dev tx {i}".encode() * 2
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    if tamper:
+        tamper(pks, msgs, sigs)
+    return pks, msgs, sigs
+
+
+class TestSecpKernel:
+    def test_all_good_batch(self):
+        pks, msgs, sigs = _sign_batch(5)
+        packed = sk.pack_batch(pks, msgs, sigs, 8)
+        v = np.asarray(dev.verify_batch_device(*packed[:-1])) & packed[-1]
+        assert v[:5].all() and not v[5:].any()
+
+    def test_reject_classes(self):
+        def tamper(pks, msgs, sigs):
+            sigs[1] = sigs[1][:8] + bytes([sigs[1][8] ^ 1]) + sigs[1][9:]
+            msgs[2] = b"wrong message"
+            pks[3] = pks[0]                         # wrong key
+            s = int.from_bytes(sigs[4][32:], "big")
+            sigs[4] = sigs[4][:32] + (sk.N - s).to_bytes(32, "big")  # high-S
+
+        pks, msgs, sigs = _sign_batch(5, tamper)
+        packed = sk.pack_batch(pks, msgs, sigs, 8)
+        v = np.asarray(dev.verify_batch_device(*packed[:-1])) & packed[-1]
+        assert bool(v[0]) and not v[1:].any()
+
+    def test_host_oracle_fuzz_agreement(self):
+        rng = np.random.default_rng(3)
+        pks, msgs, sigs = _sign_batch(8)
+        want = []
+        for i in range(8):
+            if i % 3 == 1:
+                sigs[i] = bytes(rng.bytes(64))
+            elif i % 3 == 2:
+                msgs[i] = rng.bytes(17)
+            want.append(sk.PubKey(pks[i]).verify_signature(msgs[i],
+                                                           sigs[i]))
+        packed = sk.pack_batch(pks, msgs, sigs, 8)
+        v = (np.asarray(dev.verify_batch_device(*packed[:-1]))
+             & packed[-1])
+        assert v.tolist() == want
+
+    def test_batch_seam_and_mixed(self):
+        from cometbft_tpu.crypto.ed25519 import PrivKey as EdPriv
+
+        pks, msgs, sigs = _sign_batch(3)
+        bv = cb.create_batch_verifier("secp256k1", provider="tpu")
+        for pk, m, s in zip(pks, msgs, sigs):
+            bv.add(sk.PubKey(pk), m, s)
+        ok, verdicts = bv.verify()
+        assert ok and verdicts == [True, True, True]
+
+        ep = EdPriv.generate(b"\x0b" * 32)
+        mv = cb.MixedBatchVerifier(provider="tpu")
+        sp = sk.PrivKey.generate(bytes([9]) * 32)
+        mv.add(sp.pub_key(), b"m0", sp.sign(b"m0"))
+        mv.add(ep.pub_key(), b"m1", ep.sign(b"m1"))
+        mv.add(sp.pub_key(), b"m2", sp.sign(b"OTHER"))
+        ok, verdicts = mv.verify()
+        assert not ok and verdicts == [True, True, False]
