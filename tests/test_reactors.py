@@ -91,11 +91,29 @@ class P2PNode:
         return f"{self.node_key.id}@{self.switch.bound_addr}"
 
 
-def connect_all(nodes):
-    """Full mesh."""
-    for i, a in enumerate(nodes):
-        for b in nodes[i + 1:]:
-            b.switch.dial_peer(a.addr)
+def connect_all(nodes, timeout: float = 30.0):
+    """Full mesh, retrying failed dials until every node sees every
+    peer — under full-suite CPU saturation a first dial can time out,
+    and a 4-validator net that silently lost a link never commits."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    want = len(nodes) - 1
+    while _time.monotonic() < deadline:
+        for i, a in enumerate(nodes):
+            for b in nodes[i + 1:]:
+                if b.switch.peers.size() < want or \
+                        a.switch.peers.size() < want:
+                    try:
+                        b.switch.dial_peer(a.addr)
+                    except Exception:
+                        pass
+        if all(n.switch.peers.size() >= want for n in nodes):
+            return
+        _time.sleep(0.5)
+    raise AssertionError(
+        "mesh incomplete: " +
+        str([n.switch.peers.size() for n in nodes]))
 
 
 @pytest.fixture
