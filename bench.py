@@ -151,31 +151,77 @@ def bench_blocksync(n_vals: int, blocks_per_dispatch: int,
     return dispatches * blocks_per_dispatch / dt
 
 
+def bench_secp(batch: int, iters: int) -> float:
+    """secp256k1 ECDSA verifies/sec on device (the reference cannot
+    batch this key type at all; crypto/batch/batch.go)."""
+    import jax
+    from cometbft_tpu.crypto import secp256k1 as sk
+    from cometbft_tpu.ops import secp256k1 as dev
+
+    privs = [sk.PrivKey.generate(bytes([i & 0xFF, i >> 8] + [11] * 30))
+             for i in range(min(batch, 128))]
+    pks, msgs, sigs = [], [], []
+    for i in range(batch):
+        p = privs[i % len(privs)]
+        m = i.to_bytes(8, "little") * 8
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    packed = sk.pack_batch(pks, msgs, sigs, batch)
+    args = [jax.device_put(x) for x in packed[:-1]]
+    assert np.asarray(dev.verify_batch_device(*args)).all()
+    t0 = time.perf_counter()
+    outs = [dev.verify_batch_device(*args) for _ in range(iters)]
+    np.asarray(outs[-1])
+    dt = (time.perf_counter() - t0) / iters
+    return batch / dt
+
+
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "4095"))
     iters = int(os.environ.get("BENCH_ITERS", "8"))
+    # first compiles of every kernel can dominate a cold cache; the
+    # secondary metrics yield to the budget so the headline ALWAYS
+    # prints before any driver timeout
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", "1500"))
+    t0 = time.perf_counter()
 
     rlc = bench_rlc(batch, iters)                 # distinct keys: one
-    per_sig = bench_per_sig(min(batch + 1, 4096), iters)   # sig/validator
-    light = bench_light_headers(150, 8, 24)
-    blocksync = bench_blocksync(10_000, 3, 4)
+    extra = {
+        "rlc_batch": batch,                       # sig/validator
+        "rlc_keys": "distinct (one per signature)",
+    }
+
+    def run_extra(key, fn, config_key=None, note=None):
+        if time.perf_counter() - t0 > budget:
+            extra[key] = "skipped (time budget)"
+            return
+        try:
+            extra[key] = fn()
+            if note:
+                extra[config_key] = note
+        except Exception as e:  # never lose the headline to an extra
+            extra[key] = f"error: {e!r}"[:120]
+
+    run_extra("per_sig_kernel_sigs_per_sec",
+              lambda: round(bench_per_sig(min(batch + 1, 4096), iters), 1))
+    run_extra("light_client_headers_per_sec",
+              lambda: round(bench_light_headers(150, 8, 24), 1),
+              "light_client_config",
+              "150 validators/commit, 24 commits/RLC dispatch, pipelined")
+    run_extra("blocksync_blocks_per_sec",
+              lambda: round(bench_blocksync(10_000, 3, 4), 2),
+              "blocksync_config",
+              "10k validators, 6667+1 sigs/commit, 3 blocks/dispatch")
+    run_extra("secp256k1_sigs_per_sec",
+              lambda: round(bench_secp(1024, 6), 1))
 
     print(json.dumps({
         "metric": "ed25519_batch_verify_throughput",
         "value": round(rlc, 1),
         "unit": "sigs/sec/chip",
         "vs_baseline": round(rlc / GO_CPU_BASELINE_SIGS_PER_SEC, 3),
-        "extra": {
-            "per_sig_kernel_sigs_per_sec": round(per_sig, 1),
-            "light_client_headers_per_sec": round(light, 1),
-            "light_client_config":
-                "150 validators/commit, 24 commits/RLC dispatch, pipelined",
-            "blocksync_blocks_per_sec": round(blocksync, 2),
-            "blocksync_config":
-                "10k validators, 6667+1 sigs/commit, 3 blocks/dispatch",
-            "rlc_batch": batch,
-            "rlc_keys": "distinct (one per signature)",
-        },
+        "extra": extra,
     }))
 
 
