@@ -177,9 +177,34 @@ def bench_secp(batch: int, iters: int) -> float:
     return batch / dt
 
 
+def _probe_device(timeout_s: float = 120.0) -> None:
+    """Fail FAST with a diagnosis if the TPU relay is wedged — a raw
+    jax.devices() on a wedged axon relay hangs indefinitely, which
+    would burn the whole bench timeout with no output."""
+    import subprocess
+    import sys
+
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if res.returncode == 0:
+            return
+        detail = (res.stderr or res.stdout).strip()[-500:]
+        raise SystemExit(
+            f"TPU backend unavailable (probe rc={res.returncode}): "
+            f"{detail}")
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"TPU relay unresponsive: jax.devices() hung for "
+            f"{timeout_s:.0f}s (axon relay wedged — retry later)")
+
+
 def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "4095"))
     iters = int(os.environ.get("BENCH_ITERS", "8"))
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        _probe_device()
     # first compiles of every kernel can dominate a cold cache; the
     # secondary metrics yield to the budget so the headline ALWAYS
     # prints before any driver timeout
