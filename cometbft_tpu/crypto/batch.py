@@ -185,6 +185,20 @@ class TpuSr25519BatchVerifier(_SigCollector):
 # ours is higher because the device round-trip has fixed cost).
 DEVICE_THRESHOLD = int(os.environ.get("COMETBFT_TPU_BATCH_THRESHOLD", "8"))
 
+
+def safe_verify(pub_key, msg: bytes, sig: bytes) -> bool:
+    """verify_signature with backend errors mapped to invalid.
+
+    The single source of truth for how malformed input or an
+    unavailable native backend (bls12381 without its .so) is handled:
+    every host single-verify loop — here, types/validation.py's commit
+    loop, and DeferredSigBatch — must agree, or the same commit could
+    crash one path and merely fail another."""
+    try:
+        return bool(pub_key.verify_signature(msg, sig))
+    except Exception:
+        return False
+
 # the reference batches only ed25519 & sr25519 (crypto/batch/batch.go:
 # 12-35); we also batch secp256k1 on device (a BASELINE.json target)
 _SUPPORTED = {"ed25519", "sr25519", "secp256k1"}
@@ -263,10 +277,7 @@ class MixedBatchVerifier:
         for slot in self._order:
             if slot is None:
                 pk, msg, sig = next(singles)
-                try:
-                    out.append(bool(pk.verify_signature(msg, sig)))
-                except Exception:
-                    out.append(False)
+                out.append(safe_verify(pk, msg, sig))
             else:
                 kt, i = slot
                 out.append(results[kt][i])

@@ -29,6 +29,19 @@ PUBKEY_SIZE = 48
 PRIVKEY_SIZE = 32
 SIGNATURE_SIZE = 96
 
+# Reference key_bls12381.go MaxMsgLen: messages longer than 32 bytes
+# are SHA-256 pre-hashed before BLS signing/verification (vote and
+# commit sign-bytes always exceed 32 bytes).  Messages SHORTER than 32
+# bytes are signable but never verifiable in the reference — its
+# VerifySignature does a [32]byte conversion that panics for short
+# input (key_bls12381.go:137) — so verify_signature maps them to False
+# rather than diverging by accepting what a reference node cannot.
+MAX_MSG_LEN = 32
+
+
+def _prehash(msg: bytes) -> bytes:
+    return sum_sha256(msg) if len(msg) > MAX_MSG_LEN else msg
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "native", "bls12381")
@@ -64,6 +77,9 @@ def _load():
             "bls_expand_message_xmd": [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
                 ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t],
+            "bls_hash_to_g2_compressed": [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t, ctypes.c_char_p],
         }.items():
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -142,7 +158,10 @@ class PubKey:
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if len(msg) < MAX_MSG_LEN:
+            return False  # unverifiable in the reference (see MAX_MSG_LEN)
         lib = _require()
+        msg = _prehash(msg)
         return bool(lib.bls_verify(self.data, msg, len(msg), sig))
 
     def validate(self) -> bool:
@@ -188,6 +207,7 @@ class PrivKey:
 
     def sign(self, msg: bytes) -> bytes:
         lib = _require()
+        msg = _prehash(msg)
         out = ctypes.create_string_buffer(SIGNATURE_SIZE)
         if not lib.bls_sign(self.data, msg, len(msg), out):
             raise RuntimeError("bls sign failed")
@@ -220,4 +240,13 @@ def expand_message_xmd(msg: bytes, dst: bytes, length: int) -> bytes:
     lib = _require()
     out = ctypes.create_string_buffer(length)
     lib.bls_expand_message_xmd(msg, len(msg), dst, len(dst), out, length)
+    return out.raw
+
+
+def hash_to_g2(msg: bytes, dst: bytes) -> bytes:
+    """RFC 9380 hash-to-G2, compressed output (test/KAT surface)."""
+    lib = _require()
+    out = ctypes.create_string_buffer(SIGNATURE_SIZE)
+    if not lib.bls_hash_to_g2_compressed(msg, len(msg), dst, len(dst), out):
+        raise RuntimeError("hash_to_g2 failed")
     return out.raw

@@ -113,7 +113,7 @@ class DeferredSigBatch:
         self._entries, entries = [], self._entries
         if len(entries) < self.DEVICE_THRESHOLD:
             for label, ctx, pub, sign_bytes, sig in entries:
-                if not pub.verify_signature(sign_bytes, sig):
+                if not crypto_batch.safe_verify(pub, sign_bytes, sig):
                     raise self._fail(label, ctx, sig)
             return
         bv = crypto_batch.MixedBatchVerifier()
@@ -291,6 +291,6 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
             "BUG: batch verification failed with no invalid signatures")
 
     for idx, val, sign_bytes, sig in entries:
-        if not val.pub_key.verify_signature(sign_bytes, sig):
+        if not crypto_batch.safe_verify(val.pub_key, sign_bytes, sig):
             raise ErrInvalidSignature(
                 f"wrong signature (#{idx}): {sig.hex()}")

@@ -1,14 +1,15 @@
-// Deterministic hash-to-G2.
+// Deterministic hash-to-G2: RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_.
 //
-// expand_message_xmd(SHA-256) follows RFC 9380 §5.3.1 exactly.  The
-// map-to-curve step is a documented DEVIATION from the RFC's SSWU
-// ciphersuite: the SSWU 3-isogeny constants are not derivable offline,
-// so the uniform bytes seed a deterministic try-and-increment over x
-// candidates in Fp2 followed by effective-cofactor clearing.  The
-// result is a uniform-looking, deterministic, subgroup-correct map —
-// every BLS property holds; only cross-library signature equality for
-// the SAME message differs from blst.  Swapping in RFC SSWU later
-// touches only map_to_g2().
+// expand_message_xmd(SHA-256) follows RFC 9380 §5.3.1; hash_to_field
+// uses m=2, L=64, count=2 (256 uniform bytes); map_to_curve is the
+// simplified SWU map on the isogenous curve E' (A' = 240i,
+// B' = 1012(1+i), Z = -(2+i)) followed by the 3-isogeny to E
+// (constants from RFC 9380 Appendix E.3); clear_cofactor multiplies by
+// the suite's effective cofactor h_eff (§8.8.2).  This matches blst's
+// Hash-to-G2 used by the reference's gated bls12_381 key type
+// (/root/reference/crypto/bls12381/key_bls12381.go), pinned by the
+// RFC Appendix K known-answer vectors in tests/test_bls12381.py and
+// cross-checked against the pure-Python oracle tests/bls_ref.py.
 #pragma once
 
 #include "curve.h"
@@ -74,11 +75,9 @@ inline void expand_message_xmd(const std::uint8_t *msg, std::size_t msg_len,
     }
 }
 
-// 64 uniform bytes -> Fp via big-int mod p (RFC hash_to_field shape)
+// 64 uniform bytes -> Fp via big-int mod p (RFC hash_to_field, L=64)
 inline Fp fp_from_wide(const std::uint8_t in[64]) {
-    // interpret big-endian 512-bit, reduce mod p via repeated folding:
-    // split hi*2^256 + lo; compute in limbs with schoolbook mod
-    // simple approach: process byte by byte: acc = acc*256 + b (mod p)
+    // byte-by-byte Horner: acc = acc*256 + b (mod p), Montgomery form
     Fp acc = fp_zero();
     Fp b256{};
     b256.l[0] = 256;
@@ -92,38 +91,171 @@ inline Fp fp_from_wide(const std::uint8_t in[64]) {
     return acc;
 }
 
-// deterministic map: try x = u0 + ctr (in Fp2) until x^3 + 4(1+u) is a
-// square; y sign chosen by a byte of the uniform input
-inline G2 map_to_g2(const std::uint8_t uniform[160]) {
-    Fp2 x;
-    x.c0 = fp_from_wide(uniform);
-    x.c1 = fp_from_wide(uniform + 64);
-    bool sign = (uniform[128] & 1) != 0;
-    Fp2 b{fp_four(), fp_four()};
-    Fp2 one = fp2_one();
-    for (int ctr = 0; ctr < 1000; ctr++) {
-        Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), b);
-        Fp2 y;
-        if (fp2_sqrt(rhs, y)) {
-            // canonical sign then flip per the hash bit
-            bool largest = fp_is_lexicographically_largest(y.c1) ||
-                           (fp_is_zero_raw(y.c1) &&
-                            fp_is_lexicographically_largest(y.c0));
-            if (largest != sign) y = fp2_neg(y);
-            G2 p{x, y, fp2_one()};
-            // clear cofactor onto the r-torsion subgroup
-            return pt_mul<FldFp2>(p, G2_COFACTOR, 8);
-        }
-        x.c0 = fp_add(x.c0, one.c0);
+// ---------------------------------------------------------- SSWU map
+// on E': y^2 = x^3 + A'x + B', A' = 240i, B' = 1012(1+i), Z = -(2+i)
+
+inline Fp fp_small(u64 v) {
+    Fp f{};
+    f.l[0] = v;
+    return fp_to_mont(f);
+}
+
+inline Fp2 fp2_from_hex(const char *c0, const char *c1) {
+    std::uint8_t b[48];
+    Fp2 r;
+    hex48(c0, b);
+    fp_from_bytes(b, r.c0);
+    hex48(c1, b);
+    fp_from_bytes(b, r.c1);
+    return r;
+}
+
+struct SswuConsts {
+    Fp2 A, B, Z, neg_b_over_a, b_over_za;
+    // RFC 9380 Appendix E.3 3-isogeny coefficients (x_num deg 3,
+    // x_den deg 2 monic, y_num deg 3, y_den deg 3 monic)
+    Fp2 xn[4], xd[2], yn[4], yd[3];
+    u64 h_eff[10];  // §8.8.2 effective cofactor, 636 bits
+
+    SswuConsts() {
+        A = {fp_zero(), fp_small(240)};
+        B = {fp_small(1012), fp_small(1012)};
+        Z = {fp_neg(fp_small(2)), fp_neg(fp_small(1))};
+        neg_b_over_a = fp2_mul(fp2_neg(B), fp2_inv(A));
+        b_over_za = fp2_mul(B, fp2_inv(fp2_mul(Z, A)));
+        xn[0] = fp2_from_hex(
+            "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6",
+            "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6");
+        xn[1] = fp2_from_hex(
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+            "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a");
+        xn[2] = fp2_from_hex(
+            "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e",
+            "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d");
+        xn[3] = fp2_from_hex(
+            "171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1",
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000");
+        xd[0] = fp2_from_hex(
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa63");
+        xd[1] = fp2_from_hex(
+            "00000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000c",
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa9f");
+        yn[0] = fp2_from_hex(
+            "1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706",
+            "1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706");
+        yn[1] = fp2_from_hex(
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+            "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97be");
+        yn[2] = fp2_from_hex(
+            "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71c",
+            "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38f");
+        yn[3] = fp2_from_hex(
+            "124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10",
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000");
+        yd[0] = fp2_from_hex(
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb",
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb");
+        yd[1] = fp2_from_hex(
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa9d3");
+        yd[2] = fp2_from_hex(
+            "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000012",
+            "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa99");
+        static const u64 he[10] = {
+            0xe8020005aaa95551ULL, 0x59894c0adebbf6b4ULL,
+            0xe954cbc06689f6a3ULL, 0x2ec0ec69d7477c1aULL,
+            0x6d82bf015d1212b0ULL, 0x329c2f178731db95ULL,
+            0x9986ff031508ffe1ULL, 0x88e2a8e9145ad768ULL,
+            0x584c6a0ea91b3528ULL, 0x0bc69f08f2ee75b3ULL};
+        for (int i = 0; i < 10; i++) h_eff[i] = he[i];
     }
-    return pt_infinity<FldFp2>();  // unreachable in practice
+};
+
+inline const SswuConsts &sswu_consts() {
+    static const SswuConsts c;
+    return c;
+}
+
+// RFC 9380 §4.1 sgn0 for m=2 (parity of the canonical representation)
+inline bool fp_sgn0(const Fp &a) {
+    Fp n = fp_from_mont(a);
+    return (n.l[0] & 1) != 0;
+}
+
+inline bool fp2_sgn0(const Fp2 &a) {
+    bool sign_0 = fp_sgn0(a.c0);
+    bool zero_0 = fp_is_zero_raw(a.c0);
+    bool sign_1 = fp_sgn0(a.c1);
+    return sign_0 || (zero_0 && sign_1);
+}
+
+// g'(x) = x^3 + A'x + B' on the isogenous curve
+inline Fp2 sswu_g(const Fp2 &x) {
+    const SswuConsts &C = sswu_consts();
+    return fp2_add(fp2_add(fp2_mul(fp2_sqr(x), x), fp2_mul(C.A, x)), C.B);
+}
+
+// simplified SWU map: u in Fp2 -> affine point on E'
+inline void map_to_curve_sswu(const Fp2 &u, Fp2 &out_x, Fp2 &out_y) {
+    const SswuConsts &C = sswu_consts();
+    Fp2 z_u2 = fp2_mul(C.Z, fp2_sqr(u));
+    Fp2 tv1 = fp2_add(fp2_sqr(z_u2), z_u2);  // Z^2 u^4 + Z u^2
+    Fp2 x1;
+    if (fp2_is_zero(tv1)) {
+        x1 = C.b_over_za;
+    } else {
+        x1 = fp2_mul(C.neg_b_over_a, fp2_add(fp2_one(), fp2_inv(tv1)));
+    }
+    Fp2 gx1 = sswu_g(x1);
+    Fp2 x, y;
+    if (fp2_sqrt(gx1, y)) {
+        x = x1;
+    } else {
+        x = fp2_mul(z_u2, x1);
+        Fp2 gx2 = sswu_g(x);
+        bool ok = fp2_sqrt(gx2, y);
+        (void)ok;  // guaranteed square when gx1 is not
+    }
+    if (fp2_sgn0(u) != fp2_sgn0(y)) y = fp2_neg(y);
+    out_x = x;
+    out_y = y;
+}
+
+// 3-isogeny E' -> E (Appendix E.3), affine in, affine out
+inline void iso3_map(const Fp2 &xp, const Fp2 &yp, Fp2 &out_x, Fp2 &out_y) {
+    const SswuConsts &C = sswu_consts();
+    Fp2 x2 = fp2_sqr(xp);
+    Fp2 x3 = fp2_mul(x2, xp);
+    Fp2 x_num = fp2_add(fp2_add(fp2_mul(C.xn[3], x3),
+                                fp2_mul(C.xn[2], x2)),
+                        fp2_add(fp2_mul(C.xn[1], xp), C.xn[0]));
+    Fp2 x_den = fp2_add(fp2_add(x2, fp2_mul(C.xd[1], xp)), C.xd[0]);
+    Fp2 y_num = fp2_add(fp2_add(fp2_mul(C.yn[3], x3),
+                                fp2_mul(C.yn[2], x2)),
+                        fp2_add(fp2_mul(C.yn[1], xp), C.yn[0]));
+    Fp2 y_den = fp2_add(fp2_add(x3, fp2_mul(C.yd[2], x2)),
+                        fp2_add(fp2_mul(C.yd[1], xp), C.yd[0]));
+    out_x = fp2_mul(x_num, fp2_inv(x_den));
+    out_y = fp2_mul(yp, fp2_mul(y_num, fp2_inv(y_den)));
 }
 
 inline G2 hash_to_g2(const std::uint8_t *msg, std::size_t msg_len,
                      const std::uint8_t *dst, std::size_t dst_len) {
-    std::uint8_t uniform[160];
-    expand_message_xmd(msg, msg_len, dst, dst_len, uniform, 160);
-    return map_to_g2(uniform);
+    // hash_to_field: count=2, m=2, L=64 -> 256 uniform bytes
+    std::uint8_t uniform[256];
+    expand_message_xmd(msg, msg_len, dst, dst_len, uniform, 256);
+    Fp2 u0{fp_from_wide(uniform), fp_from_wide(uniform + 64)};
+    Fp2 u1{fp_from_wide(uniform + 128), fp_from_wide(uniform + 192)};
+    Fp2 x0, y0, x1, y1;
+    map_to_curve_sswu(u0, x0, y0);
+    iso3_map(x0, y0, x0, y0);
+    map_to_curve_sswu(u1, x1, y1);
+    iso3_map(x1, y1, x1, y1);
+    G2 q0{x0, y0, fp2_one()};
+    G2 q1{x1, y1, fp2_one()};
+    G2 q = pt_add<FldFp2>(q0, q1);
+    return pt_mul<FldFp2>(q, sswu_consts().h_eff, 10);
 }
 
 }  // namespace bls

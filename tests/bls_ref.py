@@ -1,0 +1,328 @@
+"""Pure-Python reference for RFC 9380 hash-to-G2 on BLS12-381.
+
+Test-only oracle for the native C++ implementation
+(`native/bls12381/hash_to_g2.h`).  Implements the full
+BLS12381G2_XMD:SHA-256_SSWU_RO_ suite — expand_message_xmd,
+hash_to_field (m=2, L=64, count=2), simplified SWU on the isogenous
+curve E', the 3-isogeny to E, and effective-cofactor clearing — with
+plain Python integers, so every constant can be validated empirically
+(on-curve identities, homomorphism of the isogeny, [r][h_eff]P == inf)
+without network access.
+
+Reference behavior being matched: the Go reference's bls12_381 key type
+signs via blst's Hash-to-G2 with this ciphersuite
+(/root/reference/crypto/bls12381/key_bls12381.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# G2 cofactor (published curve constant) and RFC 9380 §8.8.2 effective
+# cofactor h_eff used by clear_cofactor in the G2 suite.
+H2 = 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D9E82EF21537E293A6691AE1616EC6E786F0C70CF1C38E31C7238E5
+H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+
+
+# ---------------------------------------------------------------- Fp2
+# elements are (c0, c1) = c0 + c1*I with I^2 = -1
+
+def f2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def f2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def f2_neg(a):
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def f2_mul(a, b):
+    return ((a[0] * b[0] - a[1] * b[1]) % P,
+            (a[0] * b[1] + a[1] * b[0]) % P)
+
+
+def f2_sqr(a):
+    return f2_mul(a, a)
+
+
+def f2_muli(a, k):
+    return ((a[0] * k) % P, (a[1] * k) % P)
+
+
+def f2_inv(a):
+    n = (a[0] * a[0] + a[1] * a[1]) % P
+    ni = pow(n, P - 2, P)
+    return ((a[0] * ni) % P, (-a[1] * ni) % P)
+
+
+def f2_is_zero(a):
+    return a[0] == 0 and a[1] == 0
+
+
+def f2_is_square(a):
+    # a^((p^2-1)/2) == norm(a)^((p-1)/2)
+    n = (a[0] * a[0] + a[1] * a[1]) % P
+    return pow(n, (P - 1) // 2, P) in (0, 1)
+
+
+def f2_sqrt(a):
+    """Any square root of a (sign fixed by the caller via sgn0)."""
+    if f2_is_zero(a):
+        return (0, 0)
+    # p ≡ 3 (mod 4): candidate sqrt in Fp is x^((p+1)/4)
+    if a[1] == 0:
+        s = pow(a[0], (P + 1) // 4, P)
+        if s * s % P == a[0]:
+            return (s, 0)
+        s = pow(-a[0] % P, (P + 1) // 4, P)
+        assert s * s % P == (-a[0]) % P
+        return (0, s)
+    n = (a[0] * a[0] + a[1] * a[1]) % P
+    s = pow(n, (P + 1) // 4, P)
+    assert s * s % P == n, "not a square"
+    two_inv = pow(2, P - 2, P)
+    t = (a[0] + s) * two_inv % P
+    x = pow(t, (P + 1) // 4, P)
+    if x * x % P != t:
+        t = (a[0] - s) * two_inv % P
+        x = pow(t, (P + 1) // 4, P)
+        assert x * x % P == t, "not a square"
+    y = a[1] * pow(2 * x, P - 2, P) % P
+    out = (x, y)
+    assert f2_sqr(out) == (a[0] % P, a[1] % P)
+    return out
+
+
+def f2_sgn0(a):
+    """RFC 9380 §4.1 sgn0 for m=2."""
+    sign_0 = a[0] % 2
+    zero_0 = a[0] == 0
+    sign_1 = a[1] % 2
+    return sign_0 or (zero_0 and sign_1)
+
+
+# ------------------------------------------------- expand_message_xmd
+
+def expand_message_xmd(msg: bytes, dst: bytes, length: int) -> bytes:
+    """RFC 9380 §5.3.1 with SHA-256."""
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(64)
+    l_i_b_str = length.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    ell = (length + 31) // 32
+    assert ell <= 255
+    bs = []
+    bi = b""
+    for i in range(1, ell + 1):
+        x = b0 if i == 1 else bytes(p ^ q for p, q in zip(b0, bi))
+        bi = hashlib.sha256(x + bytes([i]) + dst_prime).digest()
+        bs.append(bi)
+    return b"".join(bs)[:length]
+
+
+def hash_to_field_fp2(msg: bytes, dst: bytes, count: int):
+    """RFC 9380 §5.2: m=2, L=64."""
+    L = 64
+    uniform = expand_message_xmd(msg, dst, count * 2 * L)
+    out = []
+    for i in range(count):
+        c0 = int.from_bytes(uniform[2 * i * L:(2 * i + 1) * L], "big") % P
+        c1 = int.from_bytes(uniform[(2 * i + 1) * L:(2 * i + 2) * L],
+                            "big") % P
+        out.append((c0, c1))
+    return out
+
+
+# ------------------------------------------------------ SSWU on E'
+# E': y^2 = x^3 + A'x + B' with A' = 240*I, B' = 1012*(1+I),
+# Z = -(2 + I)  (RFC 9380 §8.8.2)
+
+A_PRIME = (0, 240)
+B_PRIME = (1012, 1012)
+Z_SSWU = (P - 2, P - 1)
+
+
+def g_prime(x):
+    return f2_add(f2_add(f2_mul(f2_sqr(x), x), f2_mul(A_PRIME, x)), B_PRIME)
+
+
+def sswu(u):
+    """Simplified SWU, variable-time (verification of public data)."""
+    z_u2 = f2_mul(Z_SSWU, f2_sqr(u))
+    tv1 = f2_add(f2_sqr(z_u2), z_u2)     # Z^2 u^4 + Z u^2
+    neg_b_over_a = f2_mul(f2_neg(B_PRIME), f2_inv(A_PRIME))
+    if f2_is_zero(tv1):
+        # x1 = B / (Z * A)
+        x1 = f2_mul(B_PRIME, f2_inv(f2_mul(Z_SSWU, A_PRIME)))
+    else:
+        x1 = f2_mul(neg_b_over_a, f2_add((1, 0), f2_inv(tv1)))
+    gx1 = g_prime(x1)
+    if f2_is_square(gx1):
+        x, y = x1, f2_sqrt(gx1)
+    else:
+        x2 = f2_mul(z_u2, x1)
+        gx2 = g_prime(x2)
+        x, y = x2, f2_sqrt(gx2)
+    if f2_sgn0(u) != f2_sgn0(y):
+        y = f2_neg(y)
+    return (x, y)
+
+
+# --------------------------------------------- 3-isogeny E' -> E
+# Constants from RFC 9380 Appendix E.3 (validated empirically by
+# tests/test_bls12381.py: on-curve identity + homomorphism).
+
+_K = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+_K2 = 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A
+_K3 = 0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D
+
+ISO_X_NUM = [
+    (_K, _K),
+    (0, _K2),
+    (_K2 + 4, _K3),                       # (…c71e, …e38d)
+    (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0),
+]
+ISO_X_DEN = [
+    (0, P - 72),
+    (12, P - 12),
+    (1, 0),                               # leading x^2 coefficient
+]
+ISO_Y_NUM = [
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+     0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0, _K - 24),                         # (0, …97be)
+    (_K2 + 2, _K3 + 2),                   # (…c71c, …e38f)
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0),
+]
+ISO_Y_DEN = [
+    (P - 432, P - 432),
+    (0, P - 216),
+    (18, P - 18),
+    (1, 0),                               # leading x^3 coefficient
+]
+
+
+def _horner(coeffs, x):
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = f2_add(f2_mul(acc, x), c)
+    return acc
+
+
+def iso_map(pt):
+    """Apply the 3-isogeny E' -> E: y^2 = x^3 + 4(1+I)."""
+    x, y = pt
+    x_num = _horner(ISO_X_NUM, x)
+    x_den = _horner(ISO_X_DEN, x)
+    y_num = _horner(ISO_Y_NUM, x)
+    y_den = _horner(ISO_Y_DEN, x)
+    X = f2_mul(x_num, f2_inv(x_den))
+    Y = f2_mul(y, f2_mul(y_num, f2_inv(y_den)))
+    return (X, Y)
+
+
+# ------------------------------------------------- E(Fp2) group ops
+# affine with None = infinity; E: y^2 = x^3 + 4(1+I)
+
+B_E = (4, 4)
+
+
+def on_curve_e(pt):
+    if pt is None:
+        return True
+    x, y = pt
+    return f2_sqr(y) == f2_add(f2_mul(f2_sqr(x), x), B_E)
+
+
+def on_curve_e_prime(pt):
+    if pt is None:
+        return True
+    x, y = pt
+    return f2_sqr(y) == g_prime(x)
+
+
+def pt_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2:
+        if f2_is_zero(f2_add(y1, y2)):
+            return None
+        lam = f2_mul(f2_muli(f2_sqr(x1), 3), f2_inv(f2_muli(y1, 2)))
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    x3 = f2_sub(f2_sub(f2_sqr(lam), x1), x2)
+    y3 = f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def pt_mul(p, k):
+    acc = None
+    while k:
+        if k & 1:
+            acc = pt_add(acc, p)
+        p = pt_add(p, p)
+        k >>= 1
+    return acc
+
+
+def pt_add_prime(p, q):
+    """Addition on E' (has a nonzero A coefficient)."""
+    if p is None:
+        return q
+    if q is None:
+        return p
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2:
+        if f2_is_zero(f2_add(y1, y2)):
+            return None
+        num = f2_add(f2_muli(f2_sqr(x1), 3), A_PRIME)
+        lam = f2_mul(num, f2_inv(f2_muli(y1, 2)))
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    x3 = f2_sub(f2_sub(f2_sqr(lam), x1), x2)
+    y3 = f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def pt_mul_prime(p, k):
+    acc = None
+    while k:
+        if k & 1:
+            acc = pt_add_prime(acc, p)
+        p = pt_add_prime(p, p)
+        k >>= 1
+    return acc
+
+
+# ------------------------------------------------------- full suite
+
+DST_POP = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_POP):
+    """RFC 9380 hash_to_curve for the G2 suite (affine result)."""
+    u0, u1 = hash_to_field_fp2(msg, dst, 2)
+    q0 = iso_map(sswu(u0))
+    q1 = iso_map(sswu(u1))
+    return pt_mul(pt_add(q0, q1), H_EFF)
+
+
+def random_e_prime_point(seed: int):
+    """Deterministic 'random' point on E' for constant validation."""
+    x = (seed, seed * seed + 7)
+    while True:
+        g = g_prime(x)
+        if f2_is_square(g):
+            return (x, f2_sqrt(g))
+        x = ((x[0] + 1) % P, x[1])

@@ -51,11 +51,11 @@ def test_sign_verify_roundtrip():
     pub = priv.pub_key()
     assert len(pub.data) == 48
     assert pub.validate()
-    msg = b"tendermint over bls"
+    msg = b"tendermint over bls, padded past MaxMsgLen"
     sig = priv.sign(msg)
     assert len(sig) == 96
     assert pub.verify_signature(msg, sig)
-    assert not pub.verify_signature(b"other message", sig)
+    assert not pub.verify_signature(b"other message padded past 32 b.", sig)
     bad = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
     assert not pub.verify_signature(msg, bad)
     assert not pub.verify_signature(msg, b"\x00" * 96)
@@ -64,19 +64,35 @@ def test_sign_verify_roundtrip():
 
 def test_signature_deterministic_and_distinct():
     priv = bls.PrivKey.generate(b"\x02" * 32)
-    assert priv.sign(b"m") == priv.sign(b"m")
-    assert priv.sign(b"m1") != priv.sign(b"m2")
+    m1, m2 = b"m1" * 16, b"m2" * 16
+    assert priv.sign(m1) == priv.sign(m1)
+    assert priv.sign(m1) != priv.sign(m2)
+
+
+def test_short_message_contract():
+    """Messages <32B are signable but unverifiable — the reference's
+    VerifySignature panics on them ([32]byte conversion,
+    key_bls12381.go:137), mapped here to a clean False."""
+    priv = bls.PrivKey.generate(b"\x0c" * 32)
+    pub = priv.pub_key()
+    sig = priv.sign(b"short")        # signs raw, like the reference
+    assert len(sig) == 96
+    assert not pub.verify_signature(b"short", sig)
+    # exactly 32 bytes: verified raw, no prehash
+    m32 = b"m" * 32
+    assert pub.verify_signature(m32, priv.sign(m32))
 
 
 def test_cross_key_rejection():
     a = bls.PrivKey.generate(b"\x03" * 32)
     b = bls.PrivKey.generate(b"\x04" * 32)
-    sig = a.sign(b"msg")
-    assert not b.pub_key().verify_signature(b"msg", sig)
+    msg = b"cross-key rejection message >32B"
+    sig = a.sign(msg)
+    assert not b.pub_key().verify_signature(msg, sig)
 
 
 def test_aggregate_same_message():
-    msg = b"aggregate me"
+    msg = b"aggregate me (padded past MaxMsgLen)"
     privs = [bls.PrivKey.generate(bytes([i + 1]) * 32) for i in range(4)]
     sigs = [p.sign(msg) for p in privs]
     agg_sig = bls.aggregate_signatures(sigs)
@@ -121,6 +137,79 @@ def test_validator_set_with_bls_key():
     assert idx == 0 and val.voting_power == 10
 
 
+RO_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+
+def bls_ref():
+    import bls_ref as B
+    return B
+
+
+def compress_g2(xc0, xc1, yc0, yc1):
+    """zcash G2 compression: x.c1 || x.c0 big-endian, flags in byte 0
+    (0x80 compressed, 0x20 lexicographically-largest y)."""
+    B = bls_ref()
+    out = bytearray(xc1.to_bytes(48, "big") + xc0.to_bytes(48, "big"))
+    out[0] |= 0x80
+    half = (B.P - 1) // 2
+    if yc1 > half or (yc1 == 0 and yc0 > half):
+        out[0] |= 0x20
+    return bytes(out)
+
+
+def test_expand_message_xmd_rfc9380_k1_vector():
+    """RFC 9380 Appendix K.1 (SHA-256, len_in_bytes=0x20, msg='')."""
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert bls.expand_message_xmd(b"", dst, 32).hex() == (
+        "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235")
+
+
+def test_hash_to_g2_rfc9380_appendix_k_vector():
+    """The Appendix K hash_to_curve vector for the G2 RO suite,
+    msg='' — pins cross-implementation (blst) compatibility of the
+    whole pipeline: expand_message_xmd, hash_to_field, SSWU, the
+    3-isogeny, and the effective-cofactor scalar."""
+    x_c0 = 0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a
+    x_c1 = 0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d
+    y_c0 = 0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92
+    y_c1 = 0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6
+    assert bls.hash_to_g2(b"", RO_DST) == compress_g2(
+        x_c0, x_c1, y_c0, y_c1)
+
+
+def test_hash_to_g2_matches_python_oracle():
+    """Native C++ vs the pure-Python RFC 9380 reference (bls_ref.py)
+    on assorted messages and a non-suite DST."""
+    B = bls_ref()
+
+    def compress(pt):
+        (xc0, xc1), (yc0, yc1) = pt
+        return compress_g2(xc0, xc1, yc0, yc1)
+
+    for msg in (b"", b"abc", b"a" * 33, bytes(64), b"\xff" * 7):
+        assert bls.hash_to_g2(msg, RO_DST) == compress(
+            B.hash_to_g2(msg, RO_DST))
+    other_dst = b"COMETBFT-TPU-TEST-DST"
+    assert bls.hash_to_g2(b"m", other_dst) == compress(
+        B.hash_to_g2(b"m", other_dst))
+
+
+def test_sign_prehashes_long_messages():
+    """Reference key_bls12381.go MaxMsgLen=32: messages longer than 32
+    bytes are SHA-256 pre-hashed, so vote/commit sign-bytes (always
+    >32B) produce signatures a blst-backed reference node accepts."""
+    priv = bls.PrivKey.generate(b"\x0b" * 32)
+    pub = priv.pub_key()
+    long_msg = b"q" * 200
+    sig = priv.sign(long_msg)
+    assert sig == priv.sign(hashlib.sha256(long_msg).digest())
+    assert pub.verify_signature(long_msg, sig)
+    assert pub.verify_signature(hashlib.sha256(long_msg).digest(), sig)
+    # boundary: exactly 32 bytes is NOT prehashed
+    m32 = b"m" * 32
+    assert priv.sign(m32) != priv.sign(hashlib.sha256(m32).digest())
+
+
 def test_mixed_batch_verifier_falls_back_to_single():
     """bls12_381 has no batch kernel (same as the reference, where only
     ed25519/sr25519 batch — crypto/batch/batch.go:12): MixedBatchVerifier
@@ -130,13 +219,14 @@ def test_mixed_batch_verifier_falls_back_to_single():
 
     bpriv = bls.PrivKey.generate(b"\x09" * 32)
     epriv = EdPriv.generate(b"\x0a" * 32)
+    m1, m2 = b"m1" * 16, b"m2" * 16
     mv = cb.MixedBatchVerifier()
-    mv.add(bpriv.pub_key(), b"m1", bpriv.sign(b"m1"))
-    mv.add(epriv.pub_key(), b"m2", epriv.sign(b"m2"))
+    mv.add(bpriv.pub_key(), m1, bpriv.sign(m1))
+    mv.add(epriv.pub_key(), m2, epriv.sign(m2))
     ok, verdicts = mv.verify()
     assert ok and verdicts == [True, True]
     mv = cb.MixedBatchVerifier()
-    mv.add(bpriv.pub_key(), b"m1", bpriv.sign(b"WRONG"))
-    mv.add(epriv.pub_key(), b"m2", epriv.sign(b"m2"))
+    mv.add(bpriv.pub_key(), m1, bpriv.sign(b"WRONG" * 8))
+    mv.add(epriv.pub_key(), m2, epriv.sign(m2))
     ok, verdicts = mv.verify()
     assert not ok and verdicts == [False, True]
