@@ -29,7 +29,13 @@ CODE_UNKNOWN_ERROR = 2
 
 
 class KVStoreApplication(BaseApplication):
-    def __init__(self):
+    def __init__(self, snapshot_interval: int = 1,
+                 snapshot_keep: int = 10):
+        """snapshot_interval: take a snapshot every N heights (the
+        reference kvstore's --snapshot-interval); snapshot_keep: how
+        many to retain.  keep * interval is the serving WINDOW — a
+        statesyncing peer must fetch all chunks before the chain
+        advances past it, so fast chains want interval > 1."""
         self._lock = threading.RLock()
         self.kv: dict[str, str] = {}
         self.height = 0
@@ -39,6 +45,8 @@ class KVStoreApplication(BaseApplication):
         self._staged: list[tuple[str, str]] = []
         self._staged_vals: list[at.ValidatorUpdate] = []
         self._snapshots: dict[int, bytes] = {}
+        self.snapshot_interval = max(1, snapshot_interval)
+        self.snapshot_keep = max(1, snapshot_keep)
 
     # -- info/query --------------------------------------------------------
 
@@ -149,10 +157,10 @@ class KVStoreApplication(BaseApplication):
             for attr in ("_pending_height", "_pending_hash"):
                 if hasattr(self, attr):
                     delattr(self, attr)
-            self._snapshots[self.height] = self._snapshot_bytes()
-            # keep the 10 most recent snapshots
-            for h in sorted(self._snapshots)[:-10]:
-                del self._snapshots[h]
+            if self.height % self.snapshot_interval == 0:
+                self._snapshots[self.height] = self._snapshot_bytes()
+                for h in sorted(self._snapshots)[:-self.snapshot_keep]:
+                    del self._snapshots[h]
             return at.CommitResponse(retain_height=0)
 
     # -- statesync ---------------------------------------------------------

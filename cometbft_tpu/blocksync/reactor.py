@@ -42,6 +42,7 @@ class BlocksyncReactor(Reactor):
             self._send_block_request, self._on_peer_error)
         self._stop_sync = threading.Event()
         self.synced = not block_sync
+        self.metrics = None        # BlockSyncMetrics when the node meters
 
     def get_channels(self) -> list:
         return [ChannelDescriptor(
@@ -50,6 +51,8 @@ class BlocksyncReactor(Reactor):
             recv_message_capacity=150 * 1024 * 1024)]
 
     def on_start(self) -> None:
+        if self.metrics is not None:
+            self.metrics.syncing.set(1 if self.block_sync else 0)
         if self.block_sync:
             self.pool.start()
             threading.Thread(target=self._pool_routine,
@@ -68,6 +71,8 @@ class BlocksyncReactor(Reactor):
         self.initial_state = state
         self.synced = False
         self.block_sync = True
+        if self.metrics is not None:
+            self.metrics.syncing.set(1)
         self.pool = BlockPool(max(self.store.height() + 1,
                                   state.last_block_height + 1,
                                   state.initial_height),
@@ -265,6 +270,8 @@ class BlocksyncReactor(Reactor):
             self.state = self.block_exec.apply_verified_block(
                 self.state, first_id, first,
                 syncing_to_height=self.pool.max_peer_height())
+            if self.metrics is not None:
+                self.metrics.record_block(first, size_bytes=parts.byte_size)
             progressed = True
         return progressed
 
@@ -273,6 +280,8 @@ class BlocksyncReactor(Reactor):
         if self.pool.is_caught_up():
             self.block_sync = False
             self.synced = True
+            if self.metrics is not None:
+                self.metrics.syncing.set(0)
             self._stop_sync.set()
             self.pool.stop()
             if self.consensus_reactor is not None:

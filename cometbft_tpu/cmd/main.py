@@ -398,6 +398,64 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_debug_kill(args) -> int:
+    """commands/debug/kill.go: aggregate a running node's state
+    (status, net_info, consensus state over RPC; WAL + config copies)
+    into a zip archive, then kill the process with SIGABRT."""
+    import json as _json
+    import shutil
+    import signal as _signal
+    import tempfile
+    import urllib.request
+    import zipfile
+
+    cfg = _load_config(args.home)
+    tmp = tempfile.mkdtemp(prefix="cometbft_debug_")
+    try:
+        for route, fname in (("status", "status.json"),
+                             ("net_info", "net_info.json"),
+                             ("dump_consensus_state",
+                              "consensus_state.json")):
+            url = (f"http://{args.rpc_laddr.replace('tcp://', '')}"
+                   f"/{route}")
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    body = _json.loads(resp.read())
+                payload = body.get("result") or body
+            except Exception as e:
+                payload = {"error": str(e)}
+            with open(os.path.join(tmp, fname), "w") as f:
+                _json.dump(payload, f, indent=1)
+
+        wal_path = cfg.wal_file()
+        if os.path.exists(wal_path):
+            shutil.copy2(wal_path, os.path.join(tmp, "cs.wal"))
+        conf_dir = os.path.join(cfg.base.root_dir, "config")
+        if os.path.isdir(conf_dir):
+            shutil.copytree(conf_dir, os.path.join(tmp, "config"),
+                            dirs_exist_ok=True)
+
+        # SIGABRT, like the reference (stacktrace-on-abort semantics;
+        # Python nodes dump a traceback via faulthandler when enabled)
+        killed = True
+        try:
+            os.kill(args.pid, _signal.SIGABRT)
+        except ProcessLookupError:
+            killed = False
+            print(f"process {args.pid} not found", file=sys.stderr)
+
+        with zipfile.ZipFile(args.output_file, "w",
+                             zipfile.ZIP_DEFLATED) as zf:
+            for root, _, files in os.walk(tmp):
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    zf.write(full, os.path.relpath(full, tmp))
+        print(f"wrote {args.output_file}")
+        return 0 if killed else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def cmd_replay(args) -> int:
     """commands/replay.go: replay the WAL through a fresh consensus
     state (console mode prints each message)."""
@@ -490,13 +548,22 @@ def main(argv=None) -> int:
     p.add_argument("--end-height", type=int, default=0)
     p.set_defaults(fn=cmd_reindex_event)
 
-    p = sub.add_parser("debug", help="dump a running node's state over RPC")
-    p.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
-    p.add_argument("--output-directory", default="debug-dump")
-    p.add_argument("--frequency", type=float, default=0.0,
+    p = sub.add_parser(
+        "debug", help="debug a running node (dump | kill)")
+    dsub = p.add_subparsers(dest="debug_mode", required=True)
+    d = dsub.add_parser("dump", help="snapshot node state over RPC")
+    d.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    d.add_argument("--output-directory", default="debug-dump")
+    d.add_argument("--frequency", type=float, default=0.0,
                    help="seconds between snapshots (0 = one snapshot)")
-    p.add_argument("--count", type=int, default=1)
-    p.set_defaults(fn=cmd_debug_dump)
+    d.add_argument("--count", type=int, default=1)
+    d.set_defaults(fn=cmd_debug_dump)
+    k = dsub.add_parser(
+        "kill", help="archive node state, then SIGABRT the process")
+    k.add_argument("pid", type=int)
+    k.add_argument("output_file")
+    k.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    k.set_defaults(fn=cmd_debug_kill)
 
     p = sub.add_parser("compact-db", help="compact the sqlite stores")
     p.set_defaults(fn=cmd_compact_db)

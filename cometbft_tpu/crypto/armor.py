@@ -1,0 +1,111 @@
+"""OpenPGP ASCII armor (reference crypto/armor/armor.go, which wraps
+golang.org/x/crypto/openpgp/armor).
+
+Wire format (RFC 4880 §6.2): an armor header line naming the block
+type, optional `Key: Value` headers, a blank line, base64 body wrapped
+at 64 columns, a CRC24 checksum line (`=` + 4 base64 chars), and the
+tail line.  encode_armor/decode_armor mirror EncodeArmor/DecodeArmor.
+"""
+
+from __future__ import annotations
+
+import base64
+
+_CRC24_INIT = 0xB704CE
+_CRC24_POLY = 0x1864CFB
+_LINE_WIDTH = 64
+
+
+class ArmorError(ValueError):
+    """Malformed armor input (reference returns wrapped errors)."""
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+def encode_armor(block_type: str, headers: dict[str, str] | None,
+                 data: bytes) -> str:
+    """EncodeArmor (crypto/armor/armor.go:24)."""
+    if not block_type or "\n" in block_type:
+        raise ArmorError("invalid block type")
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k, v in (headers or {}).items():
+        if ":" in k or "\n" in k or "\n" in v:
+            raise ArmorError(f"invalid armor header {k!r}")
+        lines.append(f"{k}: {v}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    lines.extend(b64[i:i + _LINE_WIDTH]
+                 for i in range(0, len(b64), _LINE_WIDTH))
+    crc = _crc24(data).to_bytes(3, "big")
+    lines.append("=" + base64.b64encode(crc).decode())
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> tuple[str, dict[str, str], bytes]:
+    """DecodeArmor (crypto/armor/armor.go:41): returns
+    (block_type, headers, data); raises ArmorError on malformed input,
+    a bad checksum, or a BEGIN/END type mismatch."""
+    lines = armor_str.splitlines()
+    i = 0
+    while i < len(lines) and not lines[i].startswith("-----BEGIN "):
+        i += 1
+    if i == len(lines) or not lines[i].endswith("-----"):
+        raise ArmorError("no armor begin line")
+    block_type = lines[i][len("-----BEGIN "):-len("-----")]
+    i += 1
+
+    headers: dict[str, str] = {}
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line:
+            i += 1
+            break
+        if ": " in line:
+            k, _, v = line.partition(": ")
+            headers[k] = v
+            i += 1
+        else:
+            break                      # body starts without blank line
+
+    b64_parts: list[str] = []
+    crc_line = None
+    end_type = None
+    for j in range(i, len(lines)):
+        line = lines[j].strip()
+        if line.startswith("-----END ") and line.endswith("-----"):
+            end_type = line[len("-----END "):-len("-----")]
+            break
+        if line.startswith("="):
+            crc_line = line[1:]
+            continue
+        if line:
+            b64_parts.append(line)
+    if end_type is None:
+        raise ArmorError("no armor end line")
+    if end_type != block_type:
+        raise ArmorError(
+            f"armor type mismatch: BEGIN {block_type!r} vs END "
+            f"{end_type!r}")
+    try:
+        data = base64.b64decode("".join(b64_parts), validate=True)
+    except Exception as e:
+        raise ArmorError(f"invalid armor body: {e}") from e
+    if crc_line is not None:
+        try:
+            want = int.from_bytes(
+                base64.b64decode(crc_line, validate=True), "big")
+        except Exception as e:
+            raise ArmorError(f"invalid armor checksum: {e}") from e
+        if want != _crc24(data):
+            raise ArmorError("armor checksum mismatch")
+    return block_type, headers, data

@@ -94,9 +94,13 @@ def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
     n = len(pubkeys)
     if n >= 2:
         packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n, parsed=parsed)
-        if packed is not None and bool(
-                np.asarray(dev.rlc_verify_device(*packed))):
+        if packed is not None and ed.rlc_verify(packed):
             return True, [True] * n
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.rlc_fallbacks.inc()
     bucket = dev.bucket_size(n)
     a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
                                       bucket, parsed=parsed)

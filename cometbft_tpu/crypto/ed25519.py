@@ -9,6 +9,8 @@ signatures into uint32 device arrays and runs one jitted TPU program
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass
 
 import numpy as np
@@ -330,3 +332,118 @@ def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     return (np.ascontiguousarray(a_words.T),
             np.ascontiguousarray(r_words.T),
             a_mag, a_neg, r_mag, r_neg)
+
+
+class ATableCache:
+    """Device cache of decompressed A-side window tables.
+
+    A validator set's distinct pubkeys produce the same packed a_words
+    every commit (pack_rlc's aggregation preserves first-seen order,
+    which follows the address-sorted validator iteration), so the
+    decompression + 17-row table build — the whole per-key cost of the
+    A-side MSM — can live in HBM across dispatches.  The reference
+    caches expanded pubkeys for the same access pattern
+    (/root/reference/crypto/ed25519/ed25519.go:64-70); here the cached
+    object is the device-resident table, so a 10k-header light-client
+    sync pays the valset decompression once, not 10k times.
+
+    Keyed by the raw a_words bytes; LRU-bounded.  Thread-safe.
+    """
+
+    def __init__(self, capacity: int = 8):
+        import collections
+        import threading
+
+        self._cap = capacity
+        self._entries = collections.OrderedDict()
+        self._seen: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, a_words: np.ndarray):
+        """(8, K) packed encodings -> (device table, device ok-flag)."""
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        key = a_words.tobytes()
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if dm is not None:
+                    dm.a_table_cache_hits.inc()
+                return self._entries[key]
+        from ..ops import ed25519 as dev
+
+        entry = dev.build_a_tables_device(a_words)
+        with self._lock:
+            self.misses += 1
+            if dm is not None:
+                dm.a_table_cache_misses.inc()
+            self._entries[key] = entry
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+        return entry
+
+    # Below this many A slots the cached kernel can't win: the saved
+    # decompression/table work is proportional to K, while the split
+    # into two dispatches (and, on cold caches, a fresh compile of the
+    # cached-kernel shape) is constant.  Small-K batches — live
+    # consensus vote flushes — stay on the fused kernel.
+    MIN_K = int(os.environ.get("COMETBFT_TPU_A_CACHE_MIN_K", "64"))
+
+    def get_if_worthwhile(self, a_words: np.ndarray):
+        """Entry if cached; else None — and only SECOND sightings of a
+        large-K key trigger a build.  One-shot batches (streaming vote
+        flushes have nondeterministic signer subsets/order, so nearly
+        every flush is a fresh key) must not thrash the LRU with ~MB
+        device tables; a repeated large valset (light client windows,
+        blocksync) shows up identically twice and earns its table."""
+        import hashlib
+
+        if a_words.shape[-1] < self.MIN_K:
+            return None
+        key = a_words.tobytes()
+        with self._lock:
+            if key in self._entries:
+                pass                       # hit: fall through to get()
+            else:
+                digest = hashlib.sha256(key).digest()
+                if digest not in self._seen:
+                    self._seen[digest] = True
+                    while len(self._seen) > 64:
+                        self._seen.popitem(last=False)
+                    return None            # first sighting: stay fused
+        return self.get(a_words)
+
+
+_A_TABLE_CACHE = ATableCache(
+    capacity=int(os.environ.get("COMETBFT_TPU_A_CACHE_CAP", "8")))
+
+USE_A_CACHE = os.environ.get("COMETBFT_TPU_A_CACHE", "1") == "1"
+
+
+def rlc_verify(packed, use_cache: bool | None = None) -> bool:
+    """Dispatch a pack_rlc batch through the A-table cache when it
+    pays.  use_cache=True forces the cached kernel (benchmarks /
+    callers that KNOW the valset repeats), False forces the fused
+    kernel, None (the default policy, COMETBFT_TPU_A_CACHE=0 disables)
+    uses a cached table only for valsets seen before — one-shot
+    batches keep the single fused dispatch.  Returns the verdict bit."""
+    from ..ops import ed25519 as dev
+
+    a_words, r_words, a_mag, a_neg, r_mag, r_neg = packed
+    entry = None
+    if use_cache is True:
+        entry = _A_TABLE_CACHE.get(np.asarray(a_words))
+    elif use_cache is None and USE_A_CACHE:
+        entry = _A_TABLE_CACHE.get_if_worthwhile(np.asarray(a_words))
+    if entry is not None:
+        a_tab, a_ok = entry
+        out = dev.rlc_verify_device_cached_a(
+            a_tab, a_ok, r_words, a_mag, a_neg, r_mag, r_neg)
+    else:
+        out = dev.rlc_verify_device(a_words, r_words,
+                                    a_mag, a_neg, r_mag, r_neg)
+    return bool(np.asarray(out))

@@ -124,19 +124,31 @@ class StreamingVerifier(BaseService):
             return
         self.flushes += 1
         self.verified += len(batch)
-        if len(batch) >= self.device_threshold:
-            try:
-                self._flush_device(batch)
-                return
-            except Exception:      # device trouble: host path still right
-                pass
-        for pk, msg, sig, fut in batch:
-            if not fut.set_running_or_notify_cancel():
-                continue
-            try:
-                fut.set_result(_host_verify(pk, msg, sig))
-            except Exception as e:  # pragma: no cover
-                fut.set_exception(e)
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        t0 = time.monotonic()
+        path = "host"
+        try:
+            if len(batch) >= self.device_threshold:
+                try:
+                    self._flush_device(batch)
+                    path = "device"
+                    return
+                except Exception:  # device trouble: host path still right
+                    pass
+            for pk, msg, sig, fut in batch:
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(_host_verify(pk, msg, sig))
+                except Exception as e:  # pragma: no cover
+                    fut.set_exception(e)
+        finally:
+            if dm is not None:
+                dm.flushes.labels(path).inc()
+                dm.batch_size.labels(path).observe(len(batch))
+                dm.flush_latency_seconds.observe(time.monotonic() - t0)
 
     def _flush_device(self, batch) -> None:
         from . import batch as cb

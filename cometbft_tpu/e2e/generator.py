@@ -1,0 +1,80 @@
+"""Deterministic-random testnet manifest generator (reference
+test/e2e/generator/main.go: seeded permutations over topology, node
+modes, phased starts, state-sync, and perturbations).
+
+`generate(seed)` always returns the same manifest for the same seed, so
+a failing generated topology is reproducible by seed alone.  The
+distributions mirror the reference generator's knobs scaled down to a
+single-machine subprocess testnet: 2-4 validators (possibly one
+secp256k1 — mixed-keytype sets are a headline capability here, where
+the reference refuses to batch them), 0-2 full nodes, maybe one late
+joiner, maybe one state-sync node, and a sprinkle of perturbations.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .manifest import Manifest, NodeManifest
+
+PERTURB_CHOICES = ("kill", "pause", "restart", "disconnect")
+
+
+def generate(seed: int) -> Manifest:
+    rng = random.Random(seed)
+    nodes: list[NodeManifest] = []
+
+    n_validators = rng.randint(2, 4)
+    mixed = rng.random() < 0.5      # one secp256k1 validator in the set
+    for i in range(n_validators):
+        key_type = "secp256k1" if (mixed and i == n_validators - 1) \
+            else "ed25519"
+        nodes.append(NodeManifest(name=f"validator{i}",
+                                  key_type=key_type))
+
+    n_full = rng.randint(0, 2)
+    for i in range(n_full):
+        late = rng.random() < 0.5
+        nodes.append(NodeManifest(
+            name=f"full{i}", mode="full",
+            start_at=rng.randint(2, 4) if late else 0))
+
+    if rng.random() < 0.6:          # a state-sync joiner
+        nodes.append(NodeManifest(
+            name="statesync0", mode="full", state_sync=True,
+            start_at=rng.randint(3, 5)))
+
+    # perturbations on a random subset of always-on nodes (late nodes
+    # have enough to do already)
+    candidates = [n for n in nodes if n.start_at == 0]
+    for n in rng.sample(candidates, k=min(len(candidates),
+                                          rng.randint(0, 2))):
+        n.perturb = [rng.choice(PERTURB_CHOICES)]
+
+    m = Manifest(nodes=nodes,
+                 load_tx_rate=rng.choice([5, 10, 20]),
+                 run_blocks=rng.randint(6, 10))
+    m.validate()
+    return m
+
+
+def to_toml(m: Manifest) -> str:
+    """Serialize for artifact dumps / reproduction by hand."""
+    lines = [f"initial_height = {m.initial_height}",
+             f"load_tx_rate = {m.load_tx_rate}",
+             f"run_blocks = {m.run_blocks}", ""]
+    for n in m.nodes:
+        lines.append(f"[node.{n.name}]")
+        if n.mode != "validator":
+            lines.append(f'mode = "{n.mode}"')
+        if n.start_at:
+            lines.append(f"start_at = {n.start_at}")
+        if n.key_type != "ed25519":
+            lines.append(f'key_type = "{n.key_type}"')
+        if n.state_sync:
+            lines.append("state_sync = true")
+        if n.perturb:
+            lines.append("perturb = ["
+                         + ", ".join(f'"{p}"' for p in n.perturb) + "]")
+        lines.append("")
+    return "\n".join(lines)

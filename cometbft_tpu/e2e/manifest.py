@@ -19,12 +19,20 @@ from dataclasses import dataclass, field
 PERTURBATIONS = ("kill", "pause", "restart", "disconnect")
 
 
+KEY_TYPES = ("ed25519", "secp256k1")
+# sr25519 signs/verifies here, but like the reference it is not a legal
+# validator pubkey type (types/params.go ABCIPubKeyTypesToNames has
+# ed25519/secp256k1/bls12381 only), so manifests don't offer it.
+
+
 @dataclass
 class NodeManifest:
     name: str
     mode: str = "validator"          # validator | full
     start_at: int = 0                # join when the chain reaches height
     perturb: list[str] = field(default_factory=list)
+    key_type: str = "ed25519"        # validator key (generator mixes)
+    state_sync: bool = False         # bootstrap from a snapshot on join
 
     def validate(self) -> None:
         if self.mode not in ("validator", "full"):
@@ -32,6 +40,18 @@ class NodeManifest:
         for p in self.perturb:
             if p not in PERTURBATIONS:
                 raise ValueError(f"{self.name}: unknown perturbation {p!r}")
+        if self.key_type not in KEY_TYPES:
+            raise ValueError(f"{self.name}: unknown key type "
+                             f"{self.key_type!r}")
+        if self.state_sync:
+            if self.mode != "full":
+                raise ValueError(
+                    f"{self.name}: only full nodes state-sync "
+                    "(a genesis validator must sign from height 1)")
+            if self.start_at == 0:
+                raise ValueError(
+                    f"{self.name}: a state-sync node needs start_at > 0 "
+                    "(it bootstraps from a snapshot of a running chain)")
 
 
 @dataclass
@@ -53,7 +73,9 @@ class Manifest:
                 name=name,
                 mode=spec.get("mode", "validator"),
                 start_at=int(spec.get("start_at", 0)),
-                perturb=list(spec.get("perturb", []))))
+                perturb=list(spec.get("perturb", [])),
+                key_type=spec.get("key_type", "ed25519"),
+                state_sync=bool(spec.get("state_sync", False))))
         m.validate()
         return m
 

@@ -85,7 +85,10 @@ class TestnetNode:
             return -1
 
     def start(self) -> None:
-        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        # snapshot window = interval * keep ≈ 100 heights: a fast e2e
+        # chain must not outrun a statesyncing peer's chunk fetches
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "COMETBFT_TPU_KVSTORE_SNAPSHOT_INTERVAL": "10"}
         # the child duplicates the fd; close the parent's copy
         with open(self.log_path, "ab") as log:
             self.proc = subprocess.Popen(
@@ -132,6 +135,7 @@ class Testnet:
 
     def setup(self) -> None:
         validators = []
+        key_types = set()
         for nm in self.manifest.nodes:
             home = os.path.join(self.out_dir, nm.name)
             node = TestnetNode(nm, home, _free_port(), _free_port())
@@ -140,9 +144,11 @@ class Testnet:
             cfg.ensure_dirs()
             pv = FilePV.load_or_generate(
                 cfg.priv_validator_key_file(),
-                cfg.priv_validator_state_file())
+                cfg.priv_validator_state_file(),
+                key_type=nm.key_type)
             node.node_id = NodeKey.load_or_gen(cfg.node_key_file()).id
             if nm.mode == "validator":
+                key_types.add(nm.key_type)
                 validators.append(
                     GenesisValidator(pub_key=pv.get_pub_key(), power=10))
             self.nodes.append(node)
@@ -151,6 +157,10 @@ class Testnet:
             chain_id=self.chain_id, genesis_time=Timestamp.now(),
             initial_height=self.manifest.initial_height,
             validators=validators)
+        # a mixed-keytype validator set needs the matching params
+        # (types/params.go ValidateBasic against ABCIPubKeyTypes)
+        genesis.consensus_params.validator.pub_key_types = sorted(
+            key_types | {"ed25519"})
 
         for node in self.nodes:
             cfg = load_config(node.home)
@@ -182,7 +192,8 @@ class Testnet:
     def wait_for_height(self, height: int, timeout: float = 120.0,
                         nodes: list[TestnetNode] | None = None) -> None:
         """Also handles phased starts: late nodes join when the chain
-        reaches their start_at height (runner/start.go:47)."""
+        reaches their start_at height (runner/start.go:47); state-sync
+        nodes get their trust anchor written just before launch."""
         deadline = time.monotonic() + timeout
         targets = nodes or [n for n in self.nodes
                             if n.manifest.start_at == 0]
@@ -193,6 +204,11 @@ class Testnet:
             tip = max(heights, default=-1)
             for late in list(pending):
                 if tip >= late.manifest.start_at:
+                    if late.manifest.state_sync:
+                        try:
+                            self._configure_statesync(late)
+                        except E2EError:
+                            continue   # retry on the next poll tick
                     late.start()
                     pending.remove(late)
             if heights and min(heights) >= height and not pending:
@@ -201,6 +217,31 @@ class Testnet:
         raise E2EError(
             f"testnet never reached height {height}: "
             f"{[(n.name, n.height()) for n in self.nodes]}")
+
+    def _configure_statesync(self, node: TestnetNode) -> None:
+        """Write the trust anchor into a state-sync node's config right
+        before it starts (the reference runner does the same dance:
+        test/e2e/runner/setup.go fetches trust height/hash from a
+        running node once the chain exists)."""
+        sources = [n for n in self.nodes
+                   if n.running() and n is not node]
+        if len(sources) < 2:
+            raise E2EError("statesync needs 2 running RPC sources")
+        src = sources[0]
+        commit = src.rpc("commit")
+        trust_height = int(commit["signed_header"]["header"]["height"])
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+        cfg = load_config(node.home)
+        cfg.base.root_dir = node.home
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = [
+            f"http://127.0.0.1:{n.rpc_port}" for n in sources[:2]]
+        cfg.statesync.trust_height = trust_height
+        cfg.statesync.trust_hash = trust_hash
+        cfg.statesync.discovery_time = 2.0   # fast chains: stale
+        # snapshots age out of the app's window in seconds
+        write_config_file(
+            os.path.join(node.home, "config", "config.toml"), cfg)
 
     def stop(self) -> None:
         for node in self.nodes:

@@ -187,27 +187,45 @@ class Client:
         dispatch covers sequential_batch_size commits over the (mostly
         repeated) validator set.  A bad signature fails the whole
         window before anything is returned or stored."""
+        import concurrent.futures as cf
+
         from ..types import validation
+
+        def fetch_window(start: int, end: int) -> list[LightBlock]:
+            return [target if hh == target.height else
+                    self._from_primary(hh)
+                    for hh in range(start, end + 1)]
 
         trace = [trusted]
         verified = trusted
         h = trusted.height + 1
-        while h <= target.height:
+        # overlap: while window w's signatures run on the device, a
+        # single worker thread fetches window w+1 from the provider —
+        # a syncing client's wall-clock is max(fetch, verify), not sum
+        with cf.ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix="light-prefetch") as ex:
             wend = min(h + self.sequential_batch_size - 1, target.height)
-            batch = validation.DeferredSigBatch()
-            window: list[LightBlock] = []
-            for hh in range(h, wend + 1):
-                interim = target if hh == target.height else \
-                    self._from_primary(hh)
-                verifier.verify_adjacent(
-                    verified.signed_header, interim.signed_header,
-                    interim.validator_set, self.trusting_period_ns, now,
-                    self.max_clock_drift_ns, defer_to=batch)
-                verified = interim
-                window.append(interim)
-            batch.verify()
-            trace.extend(window)
-            h = wend + 1
+            pending = ex.submit(fetch_window, h, wend)
+            while h <= target.height:
+                window = pending.result()
+                nxt = wend + 1
+                if nxt <= target.height:
+                    nxt_end = min(nxt + self.sequential_batch_size - 1,
+                                  target.height)
+                    pending = ex.submit(fetch_window, nxt, nxt_end)
+                batch = validation.DeferredSigBatch()
+                for interim in window:
+                    verifier.verify_adjacent(
+                        verified.signed_header, interim.signed_header,
+                        interim.validator_set, self.trusting_period_ns,
+                        now, self.max_clock_drift_ns, defer_to=batch)
+                    verified = interim
+                batch.verify()
+                trace.extend(window)
+                h = wend + 1
+                wend = min(h + self.sequential_batch_size - 1,
+                           target.height)
         return trace
 
     def _verify_skipping(self, source: Provider, trusted: LightBlock,

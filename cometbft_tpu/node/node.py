@@ -107,7 +107,11 @@ class Node(BaseService):
 
         # L4: app connections (node.go createAndStartProxyAppConns)
         if app is None and config.base.abci == "kvstore":
-            app = KVStoreApplication()
+            # the reference kvstore takes --snapshot-interval as an app
+            # flag, not node config; the env var is this build's analog
+            app = KVStoreApplication(
+                snapshot_interval=int(os.environ.get(
+                    "COMETBFT_TPU_KVSTORE_SNAPSHOT_INTERVAL", "1")))
         self.app = app
         creator = default_client_creator(config.base.abci, app=app)
         self.app_conns = AppConns(creator)
@@ -318,14 +322,38 @@ class Node(BaseService):
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
         self.metrics_server = None
+        self.statesync_metrics = None
         if config.instrumentation.prometheus:
-            from ..libs.metrics import (ConsensusMetrics, MempoolMetrics,
-                                        MetricsServer, P2PMetrics, Registry)
+            from ..libs import metrics as libmetrics
+            from ..libs.metrics import (BlockSyncMetrics, ConsensusMetrics,
+                                        DeviceMetrics, MempoolMetrics,
+                                        MetricsServer, P2PMetrics,
+                                        ProxyMetrics, Registry, StateMetrics,
+                                        StateSyncMetrics, StoreMetrics)
             registry = Registry(config.instrumentation.namespace)
             self.metrics_registry = registry
             self.consensus_state.metrics = ConsensusMetrics(registry)
             self.mempool.metrics = MempoolMetrics(registry)
             self.switch.metrics = P2PMetrics(registry)
+            self.state_metrics = StateMetrics(registry)
+            self.block_exec.metrics = self.state_metrics
+            self.pruner.metrics = self.state_metrics
+            self.blocksync_reactor.metrics = BlockSyncMetrics(registry)
+            self.statesync_metrics = StateSyncMetrics(registry)
+            self.statesync_metrics.syncing.set(
+                1 if self._statesync_enabled else 0)
+            self.app_conns.set_metrics(ProxyMetrics(registry))
+            self.store_metrics = StoreMetrics(registry)
+            libmetrics.instrument_methods(
+                self.state_store,
+                self.state_metrics.store_access_duration_seconds,
+                libmetrics.STATE_STORE_TIMED_METHODS)
+            libmetrics.instrument_methods(
+                self.block_store,
+                self.store_metrics.block_store_access_duration_seconds,
+                libmetrics.BLOCK_STORE_TIMED_METHODS)
+            # the crypto layers report through the process-wide seam
+            libmetrics.set_device_metrics(DeviceMetrics(registry))
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -393,10 +421,14 @@ class Node(BaseService):
             _log.error("statesync failed: %s; falling back to blocksync",
                        e)
             self.statesync_reactor.syncer = None
+            if self.statesync_metrics is not None:
+                self.statesync_metrics.syncing.set(0)
             self.blocksync_reactor.switch_to_blocksync(self.initial_state)
             return
         # the reactor reverts to a pure server once sync finishes
         self.statesync_reactor.syncer = None
+        if self.statesync_metrics is not None:
+            self.statesync_metrics.syncing.set(0)
         # BootstrapState: persist trusted state + the commit FOR the
         # snapshot height so blocksync/consensus can verify onward
         self.state_store.bootstrap(state)
@@ -404,6 +436,10 @@ class Node(BaseService):
         self.blocksync_reactor.switch_to_blocksync(state)
 
     def on_stop(self) -> None:
+        if self.metrics_server is not None:
+            # this node owns the process-wide device-metrics seam
+            from ..libs import metrics as libmetrics
+            libmetrics.set_device_metrics(None)
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if self.privileged_rpc_server is not None:

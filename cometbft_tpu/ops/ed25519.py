@@ -110,8 +110,18 @@ def point_is_identity(p):
 # decompression (ZIP-215: no canonical-y check)
 # ---------------------------------------------------------------------------
 
+# Fused Pallas decompress (ops/pallas_decompress.py); opt-in until
+# A/B-validated on hardware, like the select+tree kernel
+USE_PALLAS_DECOMPRESS = os.environ.get(
+    "COMETBFT_TPU_PALLAS_DECOMPRESS", "0") == "1"
+
 def decompress(enc_words: jnp.ndarray):
     """(8, ...) uint32 LE words of a 32-byte encoding -> (point, ok)."""
+    if USE_PALLAS_DECOMPRESS and enc_words.ndim == 2:
+        from . import pallas_decompress as pd
+        if enc_words.shape[-1] % pd.BLK == 0:
+            pt, ok = pd.decompress(enc_words)
+            return pt, ok
     y = fe.words32_to_limbs(enc_words)
     sign = ((enc_words[7] >> 31) & jnp.uint32(1)).astype(jnp.int32)
     y2 = fe.sqr(y)
@@ -358,22 +368,25 @@ def _cond_neg_point(p, neg):
                jnp.where(n, -p[_T], p[_T]))
 
 
-def _msm(enc_words, mags, negs):
-    """Straus MSM sum_i e_i * (-P_i) over one batch with SIGNED 5-bit
-    windows: decompress, 17-row per-point tables, shared-doubling scan
-    (5 doublings/window) with per-window lane-parallel tree reduction.
-
-    enc_words: (8, W) point encodings; mags: (nwin, W) int32 digit
-    magnitudes 0..16, MSB-first; negs: (nwin, W) bool signs.  Host
-    recoding (crypto/ed25519._recode_w5) gives digits in [-16, 16]:
-    128-bit z_i take 26 windows, 256-bit aggregated zh take 52 — vs
-    32/64 with unsigned 4-bit windows for one extra table row.
-    Returns ((4,20,1) point, all-decompressed-ok bool).
-    """
-    w = enc_words.shape[-1]
+def _msm_tables(enc_words):
+    """Decompress one MSM side and build its negated 17-row window
+    tables: (8, W) encodings -> ((17, 4, 20, W) table, all-ok bool).
+    Split out of _msm so a repeated side (the distinct-pubkey A side of
+    a validator set verifying many commits) can be built ONCE and
+    cached on device — the reference caches expanded pubkeys for the
+    same reason (/root/reference/crypto/ed25519/ed25519.go:64)."""
     pt, ok = decompress(enc_words)
-    tab = _table17(point_neg(pt))            # (17, 4, 20, W)
+    return _table17(point_neg(pt)), jnp.all(ok)
 
+
+def _msm_scan(tab, mags, negs):
+    """Shared-doubling Straus scan over pre-built window tables.
+
+    tab: (17, 4, 20, W); mags: (nwin, W) int32 digit magnitudes 0..16,
+    MSB-first; negs: (nwin, W) bool signs.  5 doublings/window act on
+    <= NPART_MAX lane-resident partials.  Returns a (4, 20, 1) point.
+    """
+    w = tab.shape[-1]
     use_pallas = USE_PALLAS_TREE and w % _pallas_blk() == 0
     if use_pallas:
         from . import pallas_msm
@@ -399,7 +412,21 @@ def _msm(enc_words, mags, negs):
 
     acc = identity_point((npart,))
     acc, _ = jax.lax.scan(step, acc, (mags, negs))
-    return _tree_reduce(acc, 1), jnp.all(ok)
+    return _tree_reduce(acc, 1)
+
+
+def _msm(enc_words, mags, negs):
+    """Straus MSM sum_i e_i * (-P_i) over one batch with SIGNED 5-bit
+    windows: decompress, 17-row per-point tables, shared-doubling scan
+    (5 doublings/window) with per-window lane-parallel tree reduction.
+
+    Host recoding (crypto/ed25519._recode_w5) gives digits in
+    [-16, 16]: 128-bit z_i take 26 windows, 256-bit aggregated zh take
+    52 — vs 32/64 with unsigned 4-bit windows for one extra table row.
+    Returns ((4,20,1) point, all-decompressed-ok bool).
+    """
+    tab, ok = _msm_tables(enc_words)
+    return _msm_scan(tab, mags, negs), ok
 
 
 def rlc_verify_kernel(a_words, r_words, a_mag, a_neg, r_mag, r_neg):
@@ -424,6 +451,37 @@ _rlc_jitted = jax.jit(rlc_verify_kernel)
 
 def rlc_verify_device(a_words, r_words, a_mag, a_neg, r_mag, r_neg):
     return _rlc_jitted(a_words, r_words, a_mag, a_neg, r_mag, r_neg)
+
+
+def rlc_verify_kernel_cached_a(a_tab, a_ok, r_words,
+                               a_mag, a_neg, r_mag, r_neg):
+    """RLC verify with a PRE-BUILT A-side table (see _msm_tables):
+    skips the A decompression (two ~270-mul sqrt chains per distinct
+    key — the measured per-point floor) and the 16 sequential table
+    adds, the dominant A-side cost when the same validator set verifies
+    a stream of commits (light-client sync, blocksync replay)."""
+    acc_a = _msm_scan(a_tab, a_mag, a_neg)
+    r_tab, ok_r = _msm_tables(r_words)
+    acc_r = _msm_scan(r_tab, r_mag, r_neg)
+    total = point_add(acc_a, acc_r)
+    for _ in range(3):               # cofactor 8
+        total = point_double(total, with_t=False)
+    return a_ok & ok_r & point_is_identity(total)[0]
+
+
+_a_tables_jitted = jax.jit(_msm_tables)
+_rlc_cached_jitted = jax.jit(rlc_verify_kernel_cached_a)
+
+
+def build_a_tables_device(a_words):
+    """One-time device build of an A-side table for the cache."""
+    return _a_tables_jitted(a_words)
+
+
+def rlc_verify_device_cached_a(a_tab, a_ok, r_words,
+                               a_mag, a_neg, r_mag, r_neg):
+    return _rlc_cached_jitted(a_tab, a_ok, r_words,
+                              a_mag, a_neg, r_mag, r_neg)
 
 
 # jitted entry with bucketed batch sizes to avoid re-compiles

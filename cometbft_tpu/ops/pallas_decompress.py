@@ -1,0 +1,210 @@
+"""Pallas TPU kernel for fused point decompression.
+
+Decompression is the measured per-signature floor of the RLC path
+(docs/PERF.md): two ~270-mul sqrt-exponent chains per point (A and R),
+~1.8 us/point at width 4096 under XLA.  The chain is pure elementwise
+radix-13 arithmetic — its cost under XLA is dominated by per-op
+dispatch/fusion boundaries, which is exactly what a single VMEM-
+resident Pallas program removes: one program per BLK-lane slice runs
+words->limbs, y^2, the (p-5)/8 power chain (fori_loop of fused
+squarings), the sqrt checks, sign fix, and T=X*Y without leaving VMEM.
+
+Opt-in via COMETBFT_TPU_PALLAS_DECOMPRESS=1 (ops/ed25519.decompress)
+until A/B-validated on hardware, mirroring the select+tree kernel's
+rollout (ops/pallas_msm.py).
+
+Reference behavior matched: ZIP-215 decompression
+(/root/reference/crypto/ed25519/ed25519.go:181 via curve25519-voi),
+oracled against ops/fe.sqrt_ratio + ops/ed25519.decompress in
+tests/test_pallas_msm.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import fe
+from .pallas_msm import _mul, _norm_weak
+
+BLK = 512            # lanes per program
+
+
+def _sqr(a):
+    return _mul(a, a)
+
+
+def _sq_n(x, n: int):
+    return jax.lax.fori_loop(0, n, lambda i, v: _sqr(v), x, unroll=4)
+
+
+def _pow_p58(z):
+    """z**((p-5)/8) — fe._pow_22501's chain with Mosaic-safe ops."""
+    z2 = _sqr(z)
+    z9 = _mul(_sq_n(z2, 2), z)
+    z11 = _mul(z9, z2)
+    z2_5_0 = _mul(_sqr(z11), z9)
+    z2_10_0 = _mul(_sq_n(z2_5_0, 5), z2_5_0)
+    z2_20_0 = _mul(_sq_n(z2_10_0, 10), z2_10_0)
+    z2_40_0 = _mul(_sq_n(z2_20_0, 20), z2_20_0)
+    z2_50_0 = _mul(_sq_n(z2_40_0, 10), z2_10_0)
+    z2_100_0 = _mul(_sq_n(z2_50_0, 50), z2_50_0)
+    z2_200_0 = _mul(_sq_n(z2_100_0, 100), z2_100_0)
+    z2_250_0 = _mul(_sq_n(z2_200_0, 50), z2_50_0)
+    return _mul(_sq_n(z2_250_0, 2), z)
+
+
+def _carry(x):
+    hi = x >> fe.RADIX
+    lo = x - (hi << fe.RADIX)
+    wrapped = jnp.concatenate(
+        [hi[-1:] * jnp.int32(fe.WRAP), hi[:-1]], axis=0)
+    return lo + wrapped
+
+
+def _add(a, b):
+    return _carry(a + b)
+
+
+def _sub(a, b):
+    return _carry(a - b)
+
+
+def _neg(a):
+    return _carry(-a)
+
+
+def _seq_canonical(x):
+    """fe._seq_canonical_pass without .at[] (static stacking only)."""
+    c = jnp.zeros(x.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(fe.NLIMBS):
+        v = x[i] + c
+        lo = v & jnp.int32(fe.MASK)
+        outs.append(lo)
+        c = (v - lo) >> fe.RADIX
+    top = outs[-1] >> jnp.int32(8)
+    outs[-1] = outs[-1] & jnp.int32(0xFF)
+    outs[0] = outs[0] + top * jnp.int32(19) + c * jnp.int32(fe.WRAP)
+    return jnp.stack(outs, axis=0)
+
+
+def _freeze(x, pad_8p, p_canon):
+    """Canonical digits in [0, p) (fe.freeze with passed constants)."""
+    x = _norm_weak(x) + pad_8p
+    for _ in range(3):
+        x = _seq_canonical(x)
+    gt = jnp.zeros(x.shape[1:], dtype=bool)
+    eq_ = jnp.ones(x.shape[1:], dtype=bool)
+    for i in range(fe.NLIMBS - 1, -1, -1):
+        gt = gt | (eq_ & (x[i] > p_canon[i]))
+        eq_ = eq_ & (x[i] == p_canon[i])
+    take = (gt | eq_)[None]
+    diff = x - p_canon
+    c = jnp.zeros(diff.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(fe.NLIMBS):
+        v = diff[i] + c
+        lo = v & jnp.int32(fe.MASK)
+        outs.append(lo)
+        c = (v - lo) >> fe.RADIX
+    sub = jnp.stack(outs, axis=0)
+    return jnp.where(take, sub, x)
+
+
+def _eq(a, b, pad_8p, p_canon):
+    return jnp.all(_freeze(a, pad_8p, p_canon)
+                   == _freeze(b, pad_8p, p_canon), axis=0)
+
+
+# consts tensor rows (passed as one (5, 20, 1) ref)
+_C_D, _C_SQRT_M1, _C_ONE, _C_PAD8P, _C_PCANON = range(5)
+
+
+def _decompress_kernel(words_ref, consts_ref, pt_ref, ok_ref):
+    """words (8, BLK) int32 (bit pattern of the LE uint32 words);
+    consts (5, 20, 1); pt out (4, 20, BLK); ok out (1, BLK) int32."""
+    words = words_ref[...]
+    consts = consts_ref[...]
+    d = consts[_C_D]
+    sqrt_m1 = consts[_C_SQRT_M1]
+    one = consts[_C_ONE]
+    pad_8p = consts[_C_PAD8P]
+    p_canon = consts[_C_PCANON]
+
+    # sign bit 255, via logical shift on the int32 bit pattern
+    w7u = words[7].astype(jnp.uint32)
+    sign = (w7u >> jnp.uint32(31)).astype(jnp.int32)
+
+    # words -> limbs (fe.words32_to_limbs, value form): limb i takes 13
+    # bits at offset 13*i; the sign bit is excluded from limb 19
+    wu = words.astype(jnp.uint32)
+    limbs = []
+    for i in range(fe.NLIMBS):
+        bit = fe.RADIX * i
+        j, r = bit // 32, bit % 32
+        v = wu[j] >> jnp.uint32(r)
+        if r + fe.RADIX > 32 and j + 1 < 8:
+            v = v | (wu[j + 1] << jnp.uint32(32 - r))
+        mask = fe.MASK if i < fe.NLIMBS - 1 else 0xFF
+        limbs.append((v & jnp.uint32(mask)).astype(jnp.int32))
+    y = jnp.stack(limbs, axis=0)                       # (20, BLK)
+
+    y2 = _sqr(y)
+    u = _sub(y2, one)
+    v = _add(_mul(y2, jnp.broadcast_to(d, y2.shape)), one)
+
+    # sqrt(u/v): r = u v^3 (u v^7)^((p-5)/8)
+    v3 = _mul(_sqr(v), v)
+    v7 = _mul(_sqr(v3), v)
+    r = _mul(_mul(u, v3), _pow_p58(_mul(u, v7)))
+    check = _mul(v, _sqr(r))
+    correct = _eq(check, u, pad_8p, p_canon)
+    flipped = _eq(check, _neg(u), pad_8p, p_canon)
+    x = jnp.where(flipped[None],
+                  _mul(r, jnp.broadcast_to(sqrt_m1, r.shape)), r)
+    ok = correct | flipped
+
+    xf = _freeze(x, pad_8p, p_canon)
+    x_zero = jnp.all(xf == 0, axis=0)
+    ok = ok & ~(x_zero & (sign == 1))
+    flip = (xf[0] & jnp.int32(1)) != sign
+    x = jnp.where(flip[None], _neg(x), x)
+    t = _mul(x, y)
+    one_b = jnp.broadcast_to(one, y.shape)
+    pt_ref[...] = jnp.stack([x, y, one_b, t], axis=0)
+    ok_ref[...] = ok.astype(jnp.int32)[None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decompress(enc_words, interpret=False):
+    """(8, W) uint32 encodings -> ((4, 20, W) extended point, (W,) ok).
+    W must be a multiple of BLK; the caller guards."""
+    w = enc_words.shape[-1]
+    assert w % BLK == 0, w
+    nblk = w // BLK
+    consts = jnp.stack([
+        jnp.asarray(fe.D_LIMBS), jnp.asarray(fe.SQRT_M1_LIMBS),
+        jnp.asarray(fe.ONE_LIMBS), jnp.asarray(fe._PAD_8P),
+        jnp.asarray(fe._P_CANON)], axis=0).reshape(5, fe.NLIMBS, 1)
+    pt, ok = pl.pallas_call(
+        _decompress_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((4, fe.NLIMBS, w), jnp.int32),
+            jax.ShapeDtypeStruct((1, w), jnp.int32),
+        ),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((8, BLK), lambda i: (0, i)),
+            pl.BlockSpec((5, fe.NLIMBS, 1), lambda i: (0, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((4, fe.NLIMBS, BLK), lambda i: (0, 0, i)),
+            pl.BlockSpec((1, BLK), lambda i: (0, i)),
+        ),
+        interpret=interpret,
+    )(enc_words.astype(jnp.uint32).view(jnp.int32), consts)
+    return pt, ok[0] != 0

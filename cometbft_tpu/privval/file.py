@@ -160,8 +160,12 @@ class FilePVKey:
     def load(path: str) -> "FilePVKey":
         with open(path, "rb") as f:
             obj = json.loads(f.read())
-        import base64
-        priv = ed25519.PrivKey(base64.b64decode(obj["priv_key"]["value"]))
+        from ..libs import tmjson
+        priv = tmjson.from_obj(obj["priv_key"])
+        if isinstance(priv, dict):      # untyped legacy file: ed25519
+            import base64
+            priv = ed25519.PrivKey(
+                base64.b64decode(obj["priv_key"]["value"]))
         pub = priv.pub_key()
         return FilePVKey(address=pub.address(), pub_key=pub, priv_key=priv,
                          file_path=path)
@@ -186,9 +190,22 @@ class FilePV:
     # -- constructors ------------------------------------------------------
     @staticmethod
     def generate(key_file_path: str = "", state_file_path: str = "",
-                 seed: bytes | None = None) -> "FilePV":
-        return FilePV(ed25519.PrivKey.generate(seed), key_file_path,
-                      state_file_path)
+                 seed: bytes | None = None,
+                 key_type: str = "ed25519") -> "FilePV":
+        """privval/file.go GenFilePV; key_type mirrors the reference's
+        `cometbft init --key-type` (ed25519 | secp256k1 | sr25519 —
+        validator params additionally restrict which may validate)."""
+        if key_type == "ed25519":
+            priv = ed25519.PrivKey.generate(seed)
+        elif key_type == "secp256k1":
+            from ..crypto import secp256k1
+            priv = secp256k1.PrivKey.generate(seed)
+        elif key_type == "sr25519":
+            from ..crypto import sr25519
+            priv = sr25519.PrivKey.generate(seed)
+        else:
+            raise ValueError(f"unsupported key type {key_type!r}")
+        return FilePV(priv, key_file_path, state_file_path)
 
     @staticmethod
     def load(key_file_path: str, state_file_path: str) -> "FilePV":
@@ -200,11 +217,12 @@ class FilePV:
         return pv
 
     @staticmethod
-    def load_or_generate(key_file_path: str,
-                         state_file_path: str) -> "FilePV":
+    def load_or_generate(key_file_path: str, state_file_path: str,
+                         key_type: str = "ed25519") -> "FilePV":
         if os.path.exists(key_file_path):
             return FilePV.load(key_file_path, state_file_path)
-        pv = FilePV.generate(key_file_path, state_file_path)
+        pv = FilePV.generate(key_file_path, state_file_path,
+                             key_type=key_type)
         pv.save()
         return pv
 

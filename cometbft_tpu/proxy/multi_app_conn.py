@@ -55,14 +55,60 @@ def default_client_creator(addr: str, app: Application | None = None
     return socket_client_creator(addr)
 
 
+class MeteredAppConn:
+    """Per-connection ABCI method timing (the reference wraps each
+    AppConn method and observes proxy/metrics.go
+    MethodTimingSeconds{method, type}).  Metering is off until a
+    ProxyMetrics is installed; the wrapper always exists so references
+    taken at node build time stay metered once the node wires
+    metrics."""
+
+    def __init__(self, client, conn_name: str):
+        self._client = client
+        self._conn_name = conn_name
+        self.metrics = None          # ProxyMetrics when the node meters
+
+    def start(self) -> None:
+        self._client.start()
+
+    def stop(self) -> None:
+        self._client.stop()
+
+    def __getattr__(self, name):
+        attr = getattr(self._client, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+        import time
+
+        def timed(*args, **kwargs):
+            m = self.metrics       # read dynamically: set_metrics may
+            if m is None:          # install metrics after first use
+                return attr(*args, **kwargs)
+            t0 = time.monotonic()
+            try:
+                return attr(*args, **kwargs)
+            finally:
+                m.method_timing_seconds.labels(
+                    name, self._conn_name).observe(time.monotonic() - t0)
+
+        # cache on the instance: __getattr__ only fires on misses, so
+        # the per-call closure allocation happens once per method
+        self.__dict__[name] = timed
+        return timed
+
+
 class AppConns:
     """proxy.AppConns: start/stop the 4 clients as one service."""
 
     def __init__(self, creator: ClientCreator):
-        self.consensus = creator()
-        self.mempool = creator()
-        self.query = creator()
-        self.snapshot = creator()
+        self.consensus = MeteredAppConn(creator(), "consensus")
+        self.mempool = MeteredAppConn(creator(), "mempool")
+        self.query = MeteredAppConn(creator(), "query")
+        self.snapshot = MeteredAppConn(creator(), "snapshot")
+
+    def set_metrics(self, pm) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            c.metrics = pm
 
     def start(self) -> None:
         for c in (self.consensus, self.mempool, self.query, self.snapshot):

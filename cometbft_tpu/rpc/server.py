@@ -106,23 +106,51 @@ def make_json_handler(dispatch, route_names, env=None):
             return dispatch(method, params, req_id)
 
         # -- JSON-RPC over POST -------------------------------------------
+        def _call_envelope(self, req) -> dict:
+            """One envelope -> one response; malformed shapes get
+            -32600 instead of dropping the connection (the reference's
+            jsonrpc server maps every decode failure to an error
+            response, rpc/jsonrpc/server/http_json_handler.go)."""
+            if not isinstance(req, dict):
+                return _err(None, -32600,
+                            f"invalid request: expected object, got "
+                            f"{type(req).__name__}")
+            method = req.get("method", "")
+            if not isinstance(method, str):
+                return _err(req.get("id"), -32600,
+                            "invalid request: method must be a string")
+            params = req.get("params") or {}
+            if not isinstance(params, dict):
+                return _err(req.get("id"), -32602,
+                            "invalid params: expected object")
+            return self._call(method, params, req.get("id"))
+
         def do_POST(self) -> None:  # noqa: N802
-            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                self._reply(400, _err(None, -32700,
+                                      "invalid Content-Length"))
+                return
             if length > MAX_BODY_BYTES:
                 self._reply(413, {"error": "body too large"})
                 return
+            if length < 0:
+                self._reply(400, _err(None, -32700,
+                                      "invalid Content-Length"))
+                return
             try:
                 req = json.loads(self.rfile.read(length) or b"{}")
-            except json.JSONDecodeError:
+            except (json.JSONDecodeError, UnicodeDecodeError):
                 self._reply(400, _err(None, -32700, "parse error"))
                 return
             if isinstance(req, list):  # batch
-                resp = [self._call(r.get("method", ""),
-                                   r.get("params") or {}, r.get("id"))
-                        for r in req]
+                if not req:            # JSON-RPC 2.0 §6: empty batch
+                    self._reply(200, _err(None, -32600, "empty batch"))
+                    return
+                resp = [self._call_envelope(r) for r in req]
             else:
-                resp = self._call(req.get("method", ""),
-                                  req.get("params") or {}, req.get("id"))
+                resp = self._call_envelope(req)
             self._reply(200, resp)
 
         # -- WebSocket upgrade (reference ws_handler.go) -------------------

@@ -96,6 +96,7 @@ class BlockExecutor:
         self.block_store = block_store
         self.event_bus = event_bus or ev.NopEventBus()
         self.pruner = pruner
+        self.metrics = None          # StateMetrics when the node meters
         self._last_validated_hash: bytes | None = None
 
     def set_event_bus(self, event_bus) -> None:
@@ -181,8 +182,11 @@ class BlockExecutor:
 
     def _apply_block(self, state: State, block_id: BlockID, block: Block,
                      syncing_to_height: int) -> State:
+        import time as _time
+
         from ..libs.fail import fail_point
 
+        t0 = _time.monotonic()
         abci_response = self.proxy_app.finalize_block(
             at.FinalizeBlockRequest(
                 hash=block.hash(),
@@ -200,6 +204,15 @@ class BlockExecutor:
             raise InvalidBlockError(
                 f"expected {len(block.data.txs)} tx results, got "
                 f"{len(abci_response.tx_results)}")
+
+        if self.metrics is not None:
+            # state/metrics.go BlockProcessingTime is in ms
+            self.metrics.block_processing_time.observe(
+                (_time.monotonic() - t0) * 1000.0)
+            if abci_response.consensus_param_updates is not None:
+                self.metrics.consensus_param_updates.inc()
+            if abci_response.validator_updates:
+                self.metrics.validator_set_updates.inc()
 
         fail_point("exec-after-finalize")
 

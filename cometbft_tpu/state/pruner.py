@@ -40,6 +40,7 @@ class Pruner(BaseService):
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.metrics = None          # StateMetrics when the node meters
 
     # -- retain heights (persisted) ----------------------------------------
 
@@ -60,6 +61,8 @@ class Pruner(BaseService):
         if height == current:
             return True          # idempotent re-set (pruner.go semantics)
         self._set(_K_APP_RETAIN, height)
+        if self.metrics is not None:
+            self.metrics.application_block_retain_height.set(height)
         self._wake.set()
         return True
 
@@ -70,6 +73,8 @@ class Pruner(BaseService):
         if height == current:
             return True
         self._set(_K_COMPANION_RETAIN, height)
+        if self.metrics is not None:
+            self.metrics.pruning_service_block_retain_height.set(height)
         self._wake.set()
         return True
 
@@ -80,6 +85,9 @@ class Pruner(BaseService):
         if height == current:
             return True
         self._set(_K_ABCI_RES_RETAIN, height)
+        if self.metrics is not None:
+            self.metrics.pruning_service_block_results_retain_height.set(
+                height)
         self._wake.set()
         return True
 
@@ -99,6 +107,9 @@ class Pruner(BaseService):
         if height == current:
             return True
         self._set(_K_TX_IDX_RETAIN, height)
+        if self.metrics is not None:
+            self.metrics.pruning_service_tx_indexer_retain_height.set(
+                height)
         self._wake.set()
         return True
 
@@ -112,6 +123,9 @@ class Pruner(BaseService):
         if height == current:
             return True
         self._set(_K_BLOCK_IDX_RETAIN, height)
+        if self.metrics is not None:
+            self.metrics.pruning_service_block_indexer_retain_height.set(
+                height)
         self._wake.set()
         return True
 
@@ -175,4 +189,13 @@ class Pruner(BaseService):
         blk_target = self._get(_K_BLOCK_IDX_RETAIN)
         if blk_target and self.block_indexer is not None:
             self.block_indexer.prune(blk_target)
-        return self.block_store.base(), pruned
+        base = self.block_store.base()
+        if self.metrics is not None:
+            self.metrics.block_store_base_height.set(base)
+            if abci_target:
+                self.metrics.abci_results_base_height.set(abci_target)
+            if tx_target:
+                self.metrics.tx_indexer_base_height.set(tx_target)
+            if blk_target:
+                self.metrics.block_indexer_base_height.set(blk_target)
+        return base, pruned

@@ -1,0 +1,124 @@
+"""Round-3 on-TPU A/B driver: run the queued experiments the moment the
+relay is healthy, ONE process, serial order, results to a JSON lines
+file so a mid-run wedge keeps everything measured so far.
+
+Experiments (VERDICT round-2 items 2-4):
+  1. RLC throughput at batch 4095 (baseline recheck), 8191, 16383.
+  2. A-table-cached RLC at the same widths (repeated-valset workload).
+  3. Pallas select+tree ON vs OFF at width 4096/8192.
+  4. Pallas fused decompress ON vs OFF.
+  5. Light-client headers/s at 24 and 48 commits/dispatch (cached).
+
+Usage:  env PYTHONPATH=/root/repo:/root/.axon_site \
+            python scripts/ab_round3.py [results.jsonl]
+
+Every measurement uses pipelined dispatches with an np.asarray readback
+fence (axon discipline: block_until_ready lies; single dispatches carry
+~65 ms latency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/ab_round3.jsonl"
+
+
+def log(name, **kv):
+    rec = {"name": name, **kv}
+    print(json.dumps(rec), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def bench_rlc_width(batch, iters=8, use_cache=False):
+    import bench
+    return bench.bench_rlc(batch, iters, use_cache=use_cache)
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/cometbft_tpu_jax_cache")
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/cometbft_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    t0 = time.time()
+    log("devices", devices=str(jax.devices()), t=0)
+
+    import bench
+    from cometbft_tpu.ops import ed25519 as dev
+
+    # 1+2: width scaling, fused vs cached
+    for batch in (4095, 8191, 16383):
+        try:
+            r = bench_rlc_width(batch)
+            log("rlc_fused", batch=batch, sigs_per_sec=round(r, 1),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("rlc_fused", batch=batch, error=repr(e)[:200])
+        try:
+            r = bench_rlc_width(batch, use_cache=True)
+            log("rlc_cached", batch=batch, sigs_per_sec=round(r, 1),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("rlc_cached", batch=batch, error=repr(e)[:200])
+
+    # 3: pallas tree A/B.  The flag is read at TRACE time, so the
+    # jitted wrappers must be rebuilt per arm or the cached trace from
+    # the other arm silently wins.
+    def refresh_jits():
+        dev._rlc_jitted = jax.jit(dev.rlc_verify_kernel)
+        dev._rlc_cached_jitted = jax.jit(dev.rlc_verify_kernel_cached_a)
+        dev._a_tables_jitted = jax.jit(dev._msm_tables)
+
+    for flag in (True, False):
+        dev.USE_PALLAS_TREE = flag
+        refresh_jits()
+        for batch in (4095, 8191):
+            try:
+                r = bench_rlc_width(batch)
+                log("pallas_tree_ab", pallas=flag, batch=batch,
+                    sigs_per_sec=round(r, 1),
+                    t=round(time.time() - t0, 1))
+            except Exception as e:
+                log("pallas_tree_ab", pallas=flag, batch=batch,
+                    error=repr(e)[:200])
+    dev.USE_PALLAS_TREE = False
+    refresh_jits()
+
+    # 4: pallas decompress A/B
+    for flag in (True, False):
+        dev.USE_PALLAS_DECOMPRESS = flag
+        refresh_jits()
+        try:
+            r = bench_rlc_width(4095)
+            log("pallas_decompress_ab", pallas=flag, batch=4095,
+                sigs_per_sec=round(r, 1), t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("pallas_decompress_ab", pallas=flag, error=repr(e)[:200])
+    dev.USE_PALLAS_DECOMPRESS = False
+    refresh_jits()
+
+    # 5: light-client depth
+    for commits in (24, 48):
+        try:
+            r = bench.bench_light_headers(150, 8, commits)
+            log("light_headers", commits_per_dispatch=commits,
+                headers_per_sec=round(r, 1),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("light_headers", commits_per_dispatch=commits,
+                error=repr(e)[:200])
+
+    log("done", t=round(time.time() - t0, 1))
+
+
+if __name__ == "__main__":
+    main()

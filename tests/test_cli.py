@@ -209,7 +209,7 @@ class TestReindexAndDebug:
             assert wait_for_height(n.consensus_state, 2, timeout=60)
             outdir = str(tmp_path / "dump")
             rc = cli_main([
-                "--home", home, "debug",
+                "--home", home, "debug", "dump",
                 "--rpc-laddr", n.rpc_addr,
                 "--output-directory", outdir])
             assert rc == 0
@@ -219,5 +219,27 @@ class TestReindexAndDebug:
                 dump = json.load(f)
             assert dump["status"]["sync_info"]["latest_block_height"]
             assert "round_state" in dump["dump_consensus_state"]
+
+            # debug kill: archives state then SIGABRTs the target —
+            # aim it at a sacrificial child process, with the node's
+            # RPC as the data source (commands/debug/kill.go)
+            import signal
+            import subprocess
+            import sys as _sys
+            import zipfile
+            victim = subprocess.Popen(
+                [_sys.executable, "-c", "import time; time.sleep(600)"])
+            out_zip = str(tmp_path / "debug.zip")
+            rc = cli_main([
+                "--home", home, "debug", "kill",
+                str(victim.pid), out_zip,
+                "--rpc-laddr", n.rpc_addr])
+            assert rc == 0
+            assert victim.wait(timeout=10) == -signal.SIGABRT
+            with zipfile.ZipFile(out_zip) as zf:
+                names = zf.namelist()
+            assert "status.json" in names
+            assert "consensus_state.json" in names
+            assert any(nm.startswith("config/") for nm in names)
         finally:
             n.stop()

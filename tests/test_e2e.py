@@ -58,3 +58,69 @@ def test_manifest_parsing():
         Manifest.parse("[node.x]\nmode = 'weird'")
     with pytest.raises(ValueError):
         Manifest.parse("")
+    # round-3 fields
+    m = Manifest.parse("[node.v0]\n[node.s0]\nmode = \"full\"\n"
+                       "state_sync = true\nstart_at = 3\n"
+                       "[node.v1]\nkey_type = \"secp256k1\"\n")
+    assert m.nodes[1].state_sync and m.nodes[1].start_at == 3
+    assert m.nodes[2].key_type == "secp256k1"
+    with pytest.raises(ValueError):   # validators don't state-sync
+        Manifest.parse("[node.v0]\nstate_sync = true\nstart_at = 3\n")
+    with pytest.raises(ValueError):   # state-sync requires a late start
+        Manifest.parse("[node.v0]\n[node.s0]\nmode = \"full\"\n"
+                       "state_sync = true\n")
+    with pytest.raises(ValueError):   # sr25519 can't validate (params)
+        Manifest.parse("[node.v0]\nkey_type = \"sr25519\"\n")
+
+
+def test_generator_deterministic_and_roundtrip():
+    from cometbft_tpu.e2e import generator
+
+    m1, m2 = generator.generate(8), generator.generate(8)
+    assert generator.to_toml(m1) == generator.to_toml(m2)
+    # seed 8 exercises the round-3 surface: a mixed-keytype valset and
+    # a state-sync joiner
+    assert any(n.key_type == "secp256k1" and n.mode == "validator"
+               for n in m1.nodes)
+    assert any(n.state_sync for n in m1.nodes)
+    # TOML round-trip preserves the manifest
+    reparsed = Manifest.parse(generator.to_toml(m1))
+    assert generator.to_toml(reparsed) == generator.to_toml(m1)
+    # a spread of seeds all validate (generate() calls validate())
+    for seed in range(25):
+        generator.generate(seed)
+
+
+@pytest.mark.slow
+def test_e2e_generated_statesync_and_mixed_keys(tmp_path):
+    """Generated manifest (seed 8): a 2-validator chain where one
+    validator signs with secp256k1 (mixed-keytype commits — the
+    capability BASELINE.md headlines), a late full node, and a node
+    that bootstraps by STATE SYNC from a snapshot, then blocksyncs.
+    """
+    from cometbft_tpu.e2e import generator
+
+    manifest = generator.generate(8)
+    net = Testnet(manifest, str(tmp_path / "gen8"), chain_id="e2e-gen8")
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(3, timeout=180)
+        txs = net.load(8)
+        # every node — including the statesync joiner — reaches target
+        net.wait_for_height(manifest.run_blocks, timeout=300,
+                            nodes=net.nodes)
+        ss = net.node("statesync0")
+        # proof the node snapshot-bootstrapped instead of replaying
+        # from genesis: its earliest stored block is past height 1
+        info = ss.rpc("status")["sync_info"]
+        earliest = int(info["earliest_block_height"])
+        assert earliest > 1, info
+        # identity can only be compared on heights every node stores:
+        # run the chain a little past the snapshot height first
+        net.wait_for_height(earliest + 3, timeout=120, nodes=net.nodes)
+        compared = net.check_block_identity()
+        assert compared >= 2
+        assert net.check_txs_committed(txs) == len(txs)
+    finally:
+        net.stop()

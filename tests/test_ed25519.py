@@ -213,3 +213,42 @@ def test_rlc_batch_equation():
     # structural reject (s >= L) never reaches the RLC path
     sigs[7] = sigs[7][:32] + (ref.L + 1).to_bytes(32, "little")
     assert ed.pack_rlc(pks, msgs, sigs) is None
+
+
+def test_rlc_a_table_cache():
+    """The device A-table cache: cached dispatches agree with the
+    uncached kernel, repeated validator sets hit the cache, and a
+    tampered signature still fails through the cached path."""
+    cache = ed._A_TABLE_CACHE
+    h0, m0 = cache.hits, cache.misses
+
+    privs = [ed.PrivKey.generate(bytes([0x40 + i]) * 32)
+             for i in range(6)]
+    pks = [p.pub_key().bytes() for p in privs]
+
+    # same 6 signers, three different "commits" (messages) — one table
+    # build then hits, same verdicts as the uncached kernel
+    for round_ in range(3):
+        ms = [b"commit %d vote %d" % (round_, i) for i in range(6)]
+        ss = [privs[i].sign(ms[i]) for i in range(6)]
+        packed = ed.pack_rlc(pks, ms, ss)
+        assert ed.rlc_verify(packed, use_cache=True)
+        assert ed.rlc_verify(packed, use_cache=False)
+    assert cache.misses == m0 + 1, "same valset must build tables once"
+    assert cache.hits >= h0 + 2
+
+    # tampered sig rejected through the cached path (cache hit)
+    ms = [b"commit 9 vote %d" % i for i in range(6)]
+    ss = [privs[i].sign(ms[i]) for i in range(6)]
+    bad = bytearray(ss[2]); bad[4] ^= 1; ss[2] = bytes(bad)
+    packed = ed.pack_rlc(pks, ms, ss)
+    assert not ed.rlc_verify(packed, use_cache=True)
+    assert cache.misses == m0 + 1
+
+    # a DIFFERENT valset (reversed order) is a different cache entry
+    order = list(reversed(range(6)))
+    packed = ed.pack_rlc([pks[i] for i in order],
+                         [ms[i] for i in order],
+                         [privs[i].sign(ms[i]) for i in order])
+    assert ed.rlc_verify(packed, use_cache=True)
+    assert cache.misses == m0 + 2

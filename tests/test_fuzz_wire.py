@@ -1,0 +1,320 @@
+"""Wire-surface fuzzing: malformed bytes against the JSON-RPC server,
+the SecretConnection handshake/frames, and mempool CheckTx.
+
+The reference fuzzes exactly these three surfaces
+(/root/reference/test/fuzz/tests/rpc_jsonrpc_server_test.go,
+p2p_secretconnection_test.go, mempool_test.go); here the corpora are
+deterministic (seeded PRNG) and run in the suite.  The invariant in
+every case is "no crash, no hang": every input gets a clean error or a
+clean reply, the serving thread survives, and a well-formed request
+afterwards still succeeds.  Unhandled thread exceptions are test
+failures (pytest.ini threadexception filter).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import struct
+import threading
+import urllib.request
+
+import pytest
+
+N_JSONRPC = 10_000
+N_CHECKTX = 10_000
+N_HANDSHAKE = 1_500
+N_FRAMES = 8_500
+
+
+# ---------------------------------------------------------------------------
+# JSON-RPC server
+# ---------------------------------------------------------------------------
+
+class _FuzzEnv:
+    """Tiny route environment: enough surface to exercise dispatch,
+    param coercion, and handler error mapping."""
+
+    def health(self):
+        return {"ok": True}
+
+    def echo(self, s: str = ""):
+        return {"s": s}
+
+    def add(self, a: int = 0, b: int = 0):
+        return {"sum": int(a) + int(b)}
+
+
+_FUZZ_ROUTES = {"health": "health", "echo": "echo", "add": "add"}
+
+
+@pytest.fixture(scope="module")
+def rpc_addr():
+    from cometbft_tpu.rpc.server import RPCServer
+
+    srv = RPCServer(_FuzzEnv(), "127.0.0.1:0", routes=_FUZZ_ROUTES,
+                    with_websocket=False)
+    srv.start()
+    yield srv.bound_addr
+    srv.stop()
+
+
+def _raw_request(addr: str, payload: bytes, timeout=5.0) -> bytes:
+    host, port = addr.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall(payload)
+        s.shutdown(socket.SHUT_WR)
+        out = b""
+        try:
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                out += chunk
+        except (socket.timeout, ConnectionResetError):
+            pass
+        return out
+
+
+def _http_post(addr: str, body: bytes, headers=()) -> bytes:
+    head = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n")
+    for k, v in headers:
+        head += k + b": " + v + b"\r\n"
+    if not any(k.lower() == b"content-length" for k, _ in headers):
+        head += b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+    return _raw_request(addr, head + b"\r\n" + body)
+
+
+def _sanity(addr: str) -> None:
+    """The server must still answer a well-formed request correctly."""
+    with urllib.request.urlopen(
+            f"http://{addr}/", timeout=10) as resp:
+        assert resp.status == 200
+    req = urllib.request.Request(
+        f"http://{addr}/",
+        data=json.dumps({"jsonrpc": "2.0", "id": 7, "method": "add",
+                         "params": {"a": 2, "b": 3}}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        out = json.loads(resp.read())
+    assert out["result"]["sum"] == 5 and out["id"] == 7
+
+
+def test_fuzz_jsonrpc_server(rpc_addr):
+    rng = random.Random(0xC0DE)
+    # structured garbage: JSON values that are valid JSON but not valid
+    # JSON-RPC envelopes, plus mutated field types
+    json_values = [
+        42, -1, 3.14, True, False, None, "x", "", [], {}, [1, 2, 3],
+        ["a", {"method": "health"}], {"method": 5}, {"method": None},
+        {"method": "health", "params": 7},
+        {"method": "health", "params": "str"},
+        {"method": "health", "params": [1, 2]},
+        {"method": "echo", "params": {"s": ["nested", {"deep": 1}]}},
+        {"method": "add", "params": {"a": "NaN", "b": {}}},
+        {"method": "add", "params": {"unexpected": 1}},
+        {"method": "\x00\xff", "id": {"object": "id"}},
+        [{"method": "health"}, 17, None, "x"],
+        [[]], [[{"method": "health"}]],
+    ]
+    n_done = 0
+    for v in json_values:
+        body = json.dumps(v).encode()
+        resp = _http_post(rpc_addr, body)
+        # valid JSON (however malformed as an envelope) must get a
+        # JSON-RPC reply, not a dropped connection
+        assert b'"jsonrpc"' in resp or b'"error"' in resp, (v, resp[:200])
+        n_done += 1
+    _sanity(rpc_addr)
+
+    while n_done < N_JSONRPC:
+        mode = rng.randrange(6)
+        if mode == 0:          # raw bytes, not HTTP at all
+            _raw_request(rpc_addr, rng.randbytes(rng.randrange(1, 200)))
+        elif mode == 1:        # HTTP with binary garbage body
+            _http_post(rpc_addr, rng.randbytes(rng.randrange(0, 300)))
+        elif mode == 2:        # wrong/absurd Content-Length
+            body = b'{"method": "health"}'
+            cl = rng.choice([b"-1", b"abc", b"999999999999", b"",
+                             b"18", b"3"])
+            _http_post(rpc_addr, body, headers=((b"Content-Length", cl),))
+        elif mode == 3:        # mutated valid envelope
+            env = {"jsonrpc": "2.0", "id": rng.randrange(100),
+                   "method": rng.choice(["health", "echo", "add",
+                                         "nope", ""]),
+                   "params": rng.choice([{}, {"s": "v"}, {"a": 1},
+                                         [1], "p", 9, None])}
+            body = json.dumps(env).encode()
+            if rng.random() < 0.3:   # bit-flip into the JSON text
+                i = rng.randrange(len(body))
+                body = body[:i] + bytes([body[i] ^ (1 << rng.randrange(8))]) \
+                    + body[i + 1:]
+            _http_post(rpc_addr, body)
+        elif mode == 4:        # URI-style GET with garbage
+            path = "/" + "".join(rng.choice(
+                "abz%/?=&\x01") for _ in range(rng.randrange(1, 30)))
+            _raw_request(rpc_addr,
+                         b"GET " + path.encode(errors="replace") +
+                         b" HTTP/1.1\r\nHost: x\r\n\r\n")
+        else:                  # truncated request
+            full = (b"POST / HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 50\r\n\r\n" + b"{" * 10)
+            _raw_request(rpc_addr, full[:rng.randrange(5, len(full))])
+        n_done += 1
+        if n_done % 2500 == 0:
+            _sanity(rpc_addr)
+    _sanity(rpc_addr)
+
+
+# ---------------------------------------------------------------------------
+# SecretConnection
+# ---------------------------------------------------------------------------
+
+def _handshake_victim(sock, errors):
+    from cometbft_tpu.crypto.ed25519 import PrivKey
+    from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+
+    try:
+        SecretConnection.make(sock, PrivKey.generate(b"\x55" * 32))
+    except Exception as e:
+        errors.append(e)
+    finally:
+        sock.close()
+
+
+def test_fuzz_secretconnection_handshake():
+    """Garbage on the wire during MakeSecretConnection must produce a
+    clean exception on the honest side — never a hang or a crash that
+    escapes the thread."""
+    rng = random.Random(0x5EC12E7)
+    for i in range(N_HANDSHAKE):
+        a, b = socket.socketpair()
+        a.settimeout(10.0)
+        errors: list = []
+        t = threading.Thread(target=_handshake_victim, args=(a, errors))
+        t.start()
+        try:
+            mode = rng.randrange(4)
+            if mode == 0:      # pure garbage ephemeral + garbage stream
+                b.sendall(rng.randbytes(32))
+                b.sendall(rng.randbytes(rng.randrange(0, 2000)))
+            elif mode == 1:    # short write then close
+                b.sendall(rng.randbytes(rng.randrange(0, 31)))
+            elif mode == 2:    # valid-length ephemeral, then garbage
+                               # sealed frames of plausible size
+                b.sendall(rng.randbytes(32))
+                for _ in range(rng.randrange(1, 3)):
+                    b.sendall(rng.randbytes(1044))
+            else:              # immediate close
+                pass
+        except OSError:
+            pass               # victim may already have torn down
+        finally:
+            b.close()
+        t.join(timeout=15)
+        assert not t.is_alive(), f"handshake hung on input {i}"
+        assert errors, "victim must fail (peer never authenticates)"
+
+
+def test_fuzz_secretconnection_frames():
+    """Corrupted sealed frames on an ESTABLISHED connection: every read
+    raises SecretConnectionError (MAC failure or length violation) and
+    nothing crashes or hangs."""
+    from cometbft_tpu.crypto.ed25519 import PrivKey
+    from cometbft_tpu.p2p.conn.secret_connection import (
+        SEALED_FRAME_SIZE, SecretConnection, SecretConnectionError)
+
+    rng = random.Random(0xF8A3E5)
+    k1 = PrivKey.generate(b"\x66" * 32)
+    k2 = PrivKey.generate(b"\x77" * 32)
+
+    done = 0
+    while done < N_FRAMES:
+        a, b = socket.socketpair()
+        a.settimeout(10.0)
+        b.settimeout(10.0)
+        out: dict = {}
+
+        def _mk(sock, key, slot):
+            try:
+                out[slot] = SecretConnection.make(sock, key)
+            except Exception as e:     # pragma: no cover
+                out[slot] = e
+
+        t1 = threading.Thread(target=_mk, args=(a, k1, "a"))
+        t2 = threading.Thread(target=_mk, args=(b, k2, "b"))
+        t1.start(); t2.start(); t1.join(15); t2.join(15)
+        ca, cb = out["a"], out["b"]
+        assert isinstance(ca, SecretConnection), ca
+        assert isinstance(cb, SecretConnection), cb
+
+        # one honest frame, then a burst of corrupted/garbage frames
+        cb.write(b"hello")
+        assert ca.read() == b"hello"
+        burst = min(100, N_FRAMES - done)
+        for _ in range(burst):
+            kind = rng.randrange(3)
+            if kind == 0:      # bit-flipped genuine sealed frame
+                raw = cb._send_aead.encrypt(
+                    cb._send_nonce.next(),
+                    struct.pack("<I", 4) + b"data" +
+                    b"\x00" * (1024 - 4), None)
+                i = rng.randrange(len(raw))
+                raw = raw[:i] + bytes([raw[i] ^ 0x01]) + raw[i + 1:]
+            elif kind == 1:    # random bytes of exact frame size
+                raw = rng.randbytes(SEALED_FRAME_SIZE)
+            else:              # replayed earlier frame (nonce reuse)
+                raw = cb._send_aead.encrypt(
+                    b"\x00" * 12,
+                    struct.pack("<I", 3) + b"old" +
+                    b"\x00" * (1024 - 3), None)
+            b.sendall(raw)
+            with pytest.raises(SecretConnectionError):
+                ca.read()
+            done += 1
+        ca.close()
+        cb.close()
+
+
+# ---------------------------------------------------------------------------
+# Mempool CheckTx
+# ---------------------------------------------------------------------------
+
+def test_fuzz_mempool_checktx():
+    """Random transaction bytes through the full CheckTx gate (size
+    checks, cache, app CheckTx, insertion).  Typed MempoolError
+    rejections are fine; anything else is a bug."""
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.apps.kvstore import KVStoreApplication
+    from cometbft_tpu.mempool.clist_mempool import (CListMempool,
+                                                    MempoolError)
+
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    mp = CListMempool(client, max_tx_bytes=1024 * 1024,
+                      size=5000, max_txs_bytes=64 * 1024 * 1024)
+    rng = random.Random(0xFEED)
+    accepted = rejected = 0
+    corpora = [
+        b"", b"=", b"k=", b"=v", b"k=v", b"\x00" * 64,
+        b"a" * 1_048_577,            # one over max_tx_bytes
+        b"=" * 1000, "κλειδί=τιμή".encode(), b"\xff" * 512,
+    ]
+    for i in range(N_CHECKTX):
+        tx = corpora[i % len(corpora)] if i < len(corpora) else \
+            rng.randbytes(rng.choice([1, 2, 7, 33, 199, 1024, 9999]))
+        try:
+            res = mp.check_tx(tx, sender=f"peer{i % 7}")
+            accepted += 1
+            assert res is not None
+        except MempoolError:
+            rejected += 1
+    assert accepted + rejected == N_CHECKTX
+    assert accepted > 0 and rejected > 0
+    # the pool survived and stays usable
+    assert mp.size() <= 5000
+    tail = mp.reap_max_bytes_max_gas(-1, -1)
+    assert isinstance(tail, list)
+    client.stop()

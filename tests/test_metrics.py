@@ -68,5 +68,32 @@ class TestNodeMetrics:
             assert height_line and float(height_line[0].split()[-1]) >= 2
             assert "cometbft_tpu_consensus_block_interval_seconds_count" \
                 in text
+
+            # round-3 breadth: state / blocksync / statesync / proxy /
+            # store metric sets (reference per-package metrics.go)
+            bpt = [ln for ln in text.splitlines() if ln.startswith(
+                "cometbft_tpu_state_block_processing_time_count")]
+            assert bpt and float(bpt[0].split()[-1]) >= 2, \
+                "FinalizeBlock timings must accumulate during a run"
+            assert "cometbft_tpu_blocksync_syncing" in text
+            assert "cometbft_tpu_statesync_syncing" in text
+            assert ("cometbft_tpu_abci_connection_method_timing_seconds"
+                    "_count") in text
+            assert 'method="finalize_block"' in text
+            assert 'type="consensus"' in text
+            assert ("cometbft_tpu_state_store_access_duration_seconds"
+                    "_count") in text
+            assert 'method="save"' in text
+            assert ("cometbft_tpu_store_block_store_access_duration_"
+                    "seconds_count") in text
+            assert 'method="save_block"' in text
+
+            # accelerator-seam metrics exist (the consensus hot path
+            # flushes through the streaming verifier)
+            assert "cometbft_tpu_device_flushes" in text
+            assert "cometbft_tpu_device_batch_size" in text
+            assert "cometbft_tpu_device_a_table_cache_hits" in text
         finally:
             n.stop()
+            from cometbft_tpu.libs import metrics as libmetrics
+            libmetrics.set_device_metrics(None)

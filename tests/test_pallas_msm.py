@@ -66,6 +66,71 @@ def test_select_tree_identity_pads():
     assert bool(dev.point_is_identity(total)[0])
 
 
+def test_pallas_decompress_matches_xla():
+    """Fused decompress vs ops/ed25519.decompress on valid encodings,
+    torsion/low-order points, and invalid (non-square) encodings."""
+    from cometbft_tpu.ops import pallas_decompress as pd
+
+    w = pd.BLK
+    encs = []
+    for i in range(w - 3):
+        pt = ref.point_mul(6151 * i + 11, ref.B)
+        encs.append(ref.point_compress(pt))
+    # identity, an 8-torsion point, and a junk non-point encoding
+    encs.append(ref.point_compress((0, 1, 1, 0)))
+    encs.append(bytes.fromhex(
+        "26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05"))
+    encs.append(b"\x13" * 31 + b"\x80")     # x==0 with sign bit: reject
+    words = jnp.asarray(np.stack(
+        [np.frombuffer(e, dtype=np.uint32) for e in encs], axis=1))
+
+    want_pt, want_ok = dev.decompress(words)
+    got_pt, got_ok = pd.decompress(words, interpret=True)
+    assert np.array_equal(np.asarray(want_ok), np.asarray(got_ok))
+    ok = np.asarray(want_ok)
+    for i in range(w):
+        if ok[i]:
+            assert _pt_eq(jnp.asarray(np.asarray(want_pt)[..., i:i + 1]),
+                          jnp.asarray(np.asarray(got_pt)[..., i:i + 1])), i
+
+
+def test_rlc_kernel_with_pallas_decompress(monkeypatch):
+    """End-to-end RLC verify with the fused decompress enabled for the
+    R side (interpret mode on CPU)."""
+    import cometbft_tpu.ops.pallas_decompress as pdmod
+
+    orig = pdmod.decompress
+
+    def interp(enc_words, interpret=False):
+        return orig(enc_words, interpret=True)
+
+    monkeypatch.setattr(pdmod, "decompress", interp)
+    monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", True)
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
+
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    pks, msgs, sigs = [], [], []
+    for i in range(pdmod.BLK):
+        seed = bytes([i % 250 + 1]) * 32
+        k = Ed25519PrivateKey.from_private_bytes(seed)
+        m = i.to_bytes(4, "little") * 8
+        pks.append(k.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw))
+        msgs.append(m)
+        sigs.append(k.sign(m))
+    packed = ed.pack_rlc(pks, msgs, sigs)
+    fn = jax.jit(dev.rlc_verify_kernel)
+    assert bool(np.asarray(fn(*packed)))
+    sigs[7] = sigs[7][:20] + bytes([sigs[7][20] ^ 1]) + sigs[7][21:]
+    packed = ed.pack_rlc(pks, msgs, sigs)
+    assert not bool(np.asarray(fn(*packed)))
+
+
 def test_msm_kernel_with_pallas_flag(monkeypatch):
     """rlc_verify_kernel agrees end-to-end with the Pallas tree enabled
     (interpret mode on CPU)."""
