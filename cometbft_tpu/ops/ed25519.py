@@ -288,6 +288,13 @@ NPART_MAX = 192      # max lane-resident partial accumulators
 # opt-in until validated on every deployment target
 USE_PALLAS_TREE = os.environ.get("COMETBFT_TPU_PALLAS_TREE", "0") == "1"
 
+# Whole-window-loop Pallas kernel (ops/pallas_msm.msm_window_loop):
+# the entire Straus scan — select, negate, tree, 5 shared doublings —
+# in ONE program with per-block accumulators.  Strictly supersedes
+# USE_PALLAS_TREE when on.
+USE_PALLAS_MSM_LOOP = os.environ.get(
+    "COMETBFT_TPU_PALLAS_MSM_LOOP", "0") == "1"
+
 
 def _pallas_blk() -> int:
     from . import pallas_msm
@@ -387,6 +394,10 @@ def _msm_scan(tab, mags, negs):
     <= NPART_MAX lane-resident partials.  Returns a (4, 20, 1) point.
     """
     w = tab.shape[-1]
+    if USE_PALLAS_MSM_LOOP and w % _pallas_blk() == 0:
+        from . import pallas_msm
+        partials = pallas_msm.msm_window_loop(tab, mags, negs)
+        return _tree_reduce(partials, 1)
     use_pallas = USE_PALLAS_TREE and w % _pallas_blk() == 0
     if use_pallas:
         from . import pallas_msm

@@ -121,6 +121,98 @@ def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     out_ref[:] = pts
 
 
+def _point_double(p, with_t: bool):
+    """dbl-2008-hwcd for a=-1 on values (ops/ed25519.point_double)."""
+    x, y, z = p[0], p[1], p[2]
+    a = _mul(x, x)
+    b = _mul(y, y)
+    c = _mul_word(_mul(z, z), 2)
+    h = _add(a, b)
+    xy = _add(x, y)
+    e = _sub(h, _mul(xy, xy))
+    g = _sub(a, b)
+    f = _add(c, g)
+    t = _mul(e, h) if with_t else jnp.zeros_like(x)
+    return jnp.stack([_mul(e, f), _mul(g, h), _mul(f, g), t], axis=0)
+
+
+def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
+    """One grid step = (block i, window j), j fastest: the ENTIRE
+    Straus window loop runs fused, with per-block accumulators.
+
+    Correctness of per-block doubling: the shared-doubling recurrence
+    acc <- 32*acc + contrib is linear in the contributions, so each
+    block maintaining its own accumulator (with its own 5 doublings
+    per window) and summing the block accumulators at the end equals
+    the single global accumulator — while keeping every op inside one
+    Pallas program, which is the point: profiling showed per-window
+    XLA dispatch overhead (~5x the tree's pure mul time) dominating.
+
+    tab block is revisited for every j (index map ignores j), so the
+    pipeline keeps it VMEM-resident rather than re-fetching.
+    """
+    j = pl.program_id(1)
+    mag = mag_ref[0, :]
+    neg = neg_ref[0, :]
+    d2 = d2_ref[:, :]
+    sel = tab_ref[0]
+    for k in range(1, 17):
+        cond = (mag == jnp.int32(k))[None, None]
+        sel = jnp.where(cond, tab_ref[k], sel)
+    flip = (neg != 0)[None]
+    x = jnp.where(flip, -sel[0], sel[0])
+    t = jnp.where(flip, -sel[3], sel[3])
+    pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
+    w = BLK
+    while w > OUT_PER_BLK:
+        half = w // 2
+        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
+        w = half
+
+    @pl.when(j == 0)
+    def _first():
+        out_ref[:] = pts
+
+    @pl.when(j != 0)
+    def _step():
+        acc = out_ref[:]
+        acc = _point_double(acc, with_t=False)
+        acc = _point_double(acc, with_t=False)
+        acc = _point_double(acc, with_t=False)
+        acc = _point_double(acc, with_t=False)
+        acc = _point_double(acc, with_t=True)
+        out_ref[:] = _point_add(acc, pts, d2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def msm_window_loop(tab, mags, negs, interpret=False):
+    """(17,4,20,W) table + (nwin,W) MSB-first signed digits ->
+    (4,20,W//BLK*OUT_PER_BLK) per-block accumulators whose SUM is the
+    full MSM over all windows.  Replaces the per-window XLA scan."""
+    w = tab.shape[-1]
+    assert w % BLK == 0, w
+    nblk = w // BLK
+    nwin = mags.shape[0]
+    out = pl.pallas_call(
+        _window_loop_kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (4, fe.NLIMBS, nblk * OUT_PER_BLK), jnp.int32),
+        grid=(nblk, nwin),
+        in_specs=[
+            pl.BlockSpec((17, 4, fe.NLIMBS, BLK),
+                         lambda i, j: (0, 0, 0, i)),
+            pl.BlockSpec((1, BLK), lambda i, j: (j, i)),
+            pl.BlockSpec((1, BLK), lambda i, j: (j, i)),
+            pl.BlockSpec((fe.NLIMBS, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((4, fe.NLIMBS, OUT_PER_BLK),
+                               lambda i, j: (0, 0, i)),
+        interpret=interpret,
+    )(tab, mags, negs.astype(jnp.int32),
+      jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def select_tree(tab, mag, neg, interpret=False):
     """(17,4,20,W) table + (W,) digits -> (4,20,W//BLK*OUT_PER_BLK)

@@ -93,6 +93,22 @@ def main():
     dev.USE_PALLAS_TREE = False
     refresh_jits()
 
+    # 3b: whole-window-loop kernel (supersedes the tree kernel)
+    for flag in (True, False):
+        dev.USE_PALLAS_MSM_LOOP = flag
+        refresh_jits()
+        for batch in (4095, 8191):
+            try:
+                r = bench_rlc_width(batch)
+                log("pallas_msm_loop_ab", pallas=flag, batch=batch,
+                    sigs_per_sec=round(r, 1),
+                    t=round(time.time() - t0, 1))
+            except Exception as e:
+                log("pallas_msm_loop_ab", pallas=flag, batch=batch,
+                    error=repr(e)[:200])
+    dev.USE_PALLAS_MSM_LOOP = False
+    refresh_jits()
+
     # 4: pallas decompress A/B
     for flag in (True, False):
         dev.USE_PALLAS_DECOMPRESS = flag
