@@ -179,13 +179,11 @@ def _decompress_kernel(words_ref, consts_ref, pt_ref, ok_ref):
     ok_ref[...] = ok.astype(jnp.int32)[None]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def decompress(enc_words, interpret=False):
-    """(8, W) uint32 encodings -> ((4, 20, W) extended point, (W,) ok).
-    W must be a multiple of BLK; the caller guards."""
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _decompress_jit(enc_words, interpret, blk):
     w = enc_words.shape[-1]
-    assert w % BLK == 0, w
-    nblk = w // BLK
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
     consts = jnp.stack([
         jnp.asarray(fe.D_LIMBS), jnp.asarray(fe.SQRT_M1_LIMBS),
         jnp.asarray(fe.ONE_LIMBS), jnp.asarray(fe._PAD_8P),
@@ -198,13 +196,20 @@ def decompress(enc_words, interpret=False):
         ),
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((8, BLK), lambda i: (0, i)),
+            pl.BlockSpec((8, blk), lambda i: (0, i)),
             pl.BlockSpec((5, fe.NLIMBS, 1), lambda i: (0, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((4, fe.NLIMBS, BLK), lambda i: (0, 0, i)),
-            pl.BlockSpec((1, BLK), lambda i: (0, i)),
+            pl.BlockSpec((4, fe.NLIMBS, blk), lambda i: (0, 0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
         ),
         interpret=interpret,
     )(enc_words.astype(jnp.uint32).view(jnp.int32), consts)
     return pt, ok[0] != 0
+
+
+def decompress(enc_words, interpret=False, blk=None):
+    """(8, W) uint32 encodings -> ((4, 20, W) extended point, (W,) ok).
+    W must be a multiple of blk (default module BLK); the caller
+    guards."""
+    return _decompress_jit(enc_words, interpret, blk or BLK)

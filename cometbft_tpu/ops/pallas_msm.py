@@ -101,7 +101,10 @@ def _point_add(p, q, d2):
 
 def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     """tab (17, 4, 20, BLK) VMEM; mag/neg (1, BLK); d2 (20, 1);
-    out (4, 20, OUT)."""
+    out (1, 4, 20, OUT) — the block index rides a LEADING output dim
+    so stores stay tile-aligned (an 8-lane slice at lane offset 8*i
+    is not a legal Mosaic store; a full block at leading index i is).
+    """
     mag = mag_ref[0, :]                  # (BLK,)
     neg = neg_ref[0, :]
     d2 = d2_ref[:, :]                    # (20, 1)
@@ -113,12 +116,12 @@ def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     x = jnp.where(flip, -sel[0], sel[0])
     t = jnp.where(flip, -sel[3], sel[3])
     pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
-    w = BLK
+    w = pts.shape[-1]
     while w > OUT_PER_BLK:
         half = w // 2
         pts = _point_add(pts[..., :half], pts[..., half:w], d2)
         w = half
-    out_ref[:] = pts
+    out_ref[0] = pts
 
 
 def _point_double(p, with_t: bool):
@@ -163,7 +166,7 @@ def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     x = jnp.where(flip, -sel[0], sel[0])
     t = jnp.where(flip, -sel[3], sel[3])
     pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
-    w = BLK
+    w = pts.shape[-1]
     while w > OUT_PER_BLK:
         half = w // 2
         pts = _point_add(pts[..., :half], pts[..., half:w], d2)
@@ -171,71 +174,84 @@ def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
 
     @pl.when(j == 0)
     def _first():
-        out_ref[:] = pts
+        out_ref[0] = pts
 
     @pl.when(j != 0)
     def _step():
-        acc = out_ref[:]
+        acc = out_ref[0]
         acc = _point_double(acc, with_t=False)
         acc = _point_double(acc, with_t=False)
         acc = _point_double(acc, with_t=False)
         acc = _point_double(acc, with_t=False)
         acc = _point_double(acc, with_t=True)
-        out_ref[:] = _point_add(acc, pts, d2)
+        out_ref[0] = _point_add(acc, pts, d2)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def msm_window_loop(tab, mags, negs, interpret=False):
-    """(17,4,20,W) table + (nwin,W) MSB-first signed digits ->
-    (4,20,W//BLK*OUT_PER_BLK) per-block accumulators whose SUM is the
-    full MSM over all windows.  Replaces the per-window XLA scan."""
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _msm_window_loop_jit(tab, mags, negs, interpret, blk):
     w = tab.shape[-1]
-    assert w % BLK == 0, w
-    nblk = w // BLK
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
     nwin = mags.shape[0]
     out = pl.pallas_call(
         _window_loop_kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (4, fe.NLIMBS, nblk * OUT_PER_BLK), jnp.int32),
+            (nblk, 4, fe.NLIMBS, OUT_PER_BLK), jnp.int32),
         grid=(nblk, nwin),
         in_specs=[
-            pl.BlockSpec((17, 4, fe.NLIMBS, BLK),
+            pl.BlockSpec((17, 4, fe.NLIMBS, blk),
                          lambda i, j: (0, 0, 0, i)),
-            pl.BlockSpec((1, BLK), lambda i, j: (j, i)),
-            pl.BlockSpec((1, BLK), lambda i, j: (j, i)),
+            pl.BlockSpec((1, blk), lambda i, j: (j, i)),
+            pl.BlockSpec((1, blk), lambda i, j: (j, i)),
             pl.BlockSpec((fe.NLIMBS, 1), lambda i, j: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((4, fe.NLIMBS, OUT_PER_BLK),
-                               lambda i, j: (0, 0, i)),
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, OUT_PER_BLK),
+                               lambda i, j: (i, 0, 0, 0)),
         interpret=interpret,
     )(tab, mags, negs.astype(jnp.int32),
       jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
-    return out
+    return out.transpose(1, 2, 0, 3).reshape(
+        4, fe.NLIMBS, nblk * OUT_PER_BLK)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def select_tree(tab, mag, neg, interpret=False):
-    """(17,4,20,W) table + (W,) digits -> (4,20,W//BLK*OUT_PER_BLK)
-    partial points, one fused Pallas program per BLK lanes."""
+def msm_window_loop(tab, mags, negs, interpret=False, blk=None):
+    """(17,4,20,W) table + (nwin,W) MSB-first signed digits ->
+    (4,20,W//blk*OUT_PER_BLK) per-block accumulators whose SUM is the
+    full MSM over all windows.  Replaces the per-window XLA scan.
+
+    blk (lanes per program) defaults to module BLK; the correctness
+    argument is width-independent, so tests run narrow blocks."""
+    return _msm_window_loop_jit(tab, mags, negs, interpret, blk or BLK)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _select_tree_jit(tab, mag, neg, interpret, blk):
     w = tab.shape[-1]
-    assert w % BLK == 0, w
-    nblk = w // BLK
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
     grid = (nblk,)
     out = pl.pallas_call(
         _select_tree_kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (4, fe.NLIMBS, nblk * OUT_PER_BLK), jnp.int32),
+            (nblk, 4, fe.NLIMBS, OUT_PER_BLK), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((17, 4, fe.NLIMBS, BLK),
+            pl.BlockSpec((17, 4, fe.NLIMBS, blk),
                          lambda i: (0, 0, 0, i)),
-            pl.BlockSpec((1, BLK), lambda i: (0, i)),
-            pl.BlockSpec((1, BLK), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
+            pl.BlockSpec((1, blk), lambda i: (0, i)),
             pl.BlockSpec((fe.NLIMBS, 1), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((4, fe.NLIMBS, OUT_PER_BLK),
-                               lambda i: (0, 0, i)),
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, OUT_PER_BLK),
+                               lambda i: (i, 0, 0, 0)),
         interpret=interpret,
     )(tab, mag.reshape(1, -1), neg.astype(jnp.int32).reshape(1, -1),
       jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
-    return out
+    return out.transpose(1, 2, 0, 3).reshape(
+        4, fe.NLIMBS, nblk * OUT_PER_BLK)
+
+
+def select_tree(tab, mag, neg, interpret=False, blk=None):
+    """(17,4,20,W) table + (W,) digits -> (4,20,W//blk*OUT_PER_BLK)
+    partial points, one fused Pallas program per blk lanes."""
+    return _select_tree_jit(tab, mag, neg, interpret, blk or BLK)

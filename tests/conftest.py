@@ -23,3 +23,36 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir", "/tmp/cometbft_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import pytest  # noqa: E402
+
+
+def _rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1])
+    return 0
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _module_memory_hygiene(request):
+    """Drop live jit executables between modules: a full-suite run
+    accumulates every compiled kernel otherwise (15+ GB by the tail of
+    the suite, enough to destabilize late compiles), and the
+    persistent compile cache makes re-tracing cheap.  Set
+    COMETBFT_TPU_RSS_LOG=<path> to record per-module peak RSS."""
+    yield
+    jax.clear_caches()
+    try:
+        # glibc holds freed compile arenas forever otherwise; RSS
+        # observed 15+ GB without this pair, ~8 GB with clear_caches
+        # alone
+        import ctypes
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except OSError:
+        pass
+    log = os.environ.get("COMETBFT_TPU_RSS_LOG")
+    if log:
+        with open(log, "a") as f:
+            f.write(f"{_rss_kb()}\t{request.module.__name__}\n")
