@@ -334,6 +334,10 @@ def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
             a_mag, a_neg, r_mag, r_neg)
 
 
+# one cached A-table slot: 17 rows x 4 coords x 20 int32 limbs
+BYTES_PER_A_SLOT = 17 * 4 * 20 * 4
+
+
 class ATableCache:
     """Device cache of decompressed A-side window tables.
 
@@ -347,19 +351,45 @@ class ATableCache:
     object is the device-resident table, so a 10k-header light-client
     sync pays the valset decompression once, not 10k times.
 
-    Keyed by the raw a_words bytes; LRU-bounded.  Thread-safe.
+    Keyed by the raw a_words bytes; LRU-bounded primarily by a BYTE
+    budget: one table is 17*4*20*4 = 5440 bytes per padded A slot, so
+    a 10k-validator set pins ~56 MB of HBM — round 3's entry-count cap
+    of 8 could silently hold ~0.45 GB.  The budget
+    (COMETBFT_TPU_A_CACHE_BYTES, default 128 MiB) is accounted per
+    admission and exported via DeviceMetrics; a generous entry cap
+    remains as a secondary bound so a flood of tiny valsets cannot
+    grow the dict without limit.  Thread-safe.
     """
 
-    def __init__(self, capacity: int = 8):
+    def __init__(self, capacity: int = 128, max_bytes: int | None = None):
         import collections
         import threading
 
         self._cap = capacity
-        self._entries = collections.OrderedDict()
+        self._max_bytes = (max_bytes if max_bytes is not None else
+                           int(os.environ.get(
+                               "COMETBFT_TPU_A_CACHE_BYTES",
+                               str(128 << 20))))
+        self._entries = collections.OrderedDict()   # key -> (entry, nbytes)
+        self._bytes = 0
         self._seen: collections.OrderedDict = collections.OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    @property
+    def bytes_resident(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def _entry_bytes(entry) -> int:
+        a_tab, _ = entry
+        return int(a_tab.size) * a_tab.dtype.itemsize
+
+    def _gauge_bytes(self, dm) -> None:
+        if dm is not None:
+            dm.a_table_cache_bytes.set(self._bytes)
 
     def get(self, a_words: np.ndarray):
         """(8, K) packed encodings -> (device table, device ok-flag)."""
@@ -373,17 +403,33 @@ class ATableCache:
                 self.hits += 1
                 if dm is not None:
                     dm.a_table_cache_hits.inc()
-                return self._entries[key]
+                return self._entries[key][0]
         from ..ops import ed25519 as dev
 
         entry = dev.build_a_tables_device(a_words)
+        nbytes = self._entry_bytes(entry)
         with self._lock:
             self.misses += 1
             if dm is not None:
                 dm.a_table_cache_misses.inc()
-            self._entries[key] = entry
-            while len(self._entries) > self._cap:
-                self._entries.popitem(last=False)
+            if nbytes > self._max_bytes:
+                # a table larger than the whole budget would evict
+                # everything and then be evicted itself: serve it
+                # un-admitted
+                self._gauge_bytes(dm)
+                return entry
+            if key not in self._entries:
+                # a concurrent miss may have admitted this key while we
+                # built outside the lock: admitting again would count
+                # nbytes twice against the budget forever
+                self._entries[key] = (entry, nbytes)
+                self._bytes += nbytes
+                while (self._bytes > self._max_bytes
+                       or len(self._entries) > self._cap):
+                    _, (_, freed) = self._entries.popitem(last=False)
+                    self._bytes -= freed
+                    self.evictions += 1
+            self._gauge_bytes(dm)
         return entry
 
     # Below this many A slots the cached kernel can't win: the saved
@@ -403,6 +449,11 @@ class ATableCache:
         import hashlib
 
         if a_words.shape[-1] < self.MIN_K:
+            return None
+        # a table the budget can never admit must stay on the fused
+        # kernel: routing it through get() would rebuild the table on
+        # EVERY sighting and still pay the split-dispatch overhead
+        if a_words.shape[-1] * BYTES_PER_A_SLOT > self._max_bytes:
             return None
         key = a_words.tobytes()
         with self._lock:

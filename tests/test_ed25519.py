@@ -252,3 +252,82 @@ def test_rlc_a_table_cache():
                          [privs[i].sign(ms[i]) for i in order])
     assert ed.rlc_verify(packed, use_cache=True)
     assert cache.misses == m0 + 2
+
+
+def _valset_words(tag, n=6):
+    privs = [ed.PrivKey.generate(bytes([tag]) * 31 + bytes([i + 1]))
+             for i in range(n)]
+    pks = [p.pub_key().bytes() for p in privs]
+    ms = [b"byte bound %d" % i for i in range(n)]
+    ss = [privs[i].sign(ms[i]) for i in range(n)]
+    return np.asarray(ed.pack_rlc(pks, ms, ss)[0])
+
+
+def test_a_table_cache_byte_bound():
+    """The LRU is bounded by BYTES, not entries: admitting past the
+    budget evicts oldest-first, the accounting tracks exactly, and a
+    single table larger than the whole budget is served un-admitted
+    (reference bounds the analogous expanded-pubkey cache the same
+    way, crypto/ed25519/ed25519.go:64-70)."""
+    words = [_valset_words(0x50 + t) for t in range(3)]
+    per_entry = 17 * 4 * 20 * words[0].shape[-1] * 4
+
+    cache = ed.ATableCache(capacity=100, max_bytes=2 * per_entry)
+    cache.get(words[0])
+    cache.get(words[1])
+    assert cache.bytes_resident == 2 * per_entry
+    assert cache.evictions == 0
+    cache.get(words[2])                    # over budget: evict oldest
+    assert cache.bytes_resident == 2 * per_entry
+    assert cache.evictions == 1
+    h = cache.hits
+    cache.get(words[0])                    # evicted -> rebuild
+    assert cache.hits == h and cache.misses == 4
+
+    # two threads missing on the SAME key must count its bytes once
+    # (the build runs outside the lock; the insert re-checks)
+    import threading
+
+    cache2 = ed.ATableCache(capacity=8, max_bytes=10 * per_entry)
+    from cometbft_tpu.ops import ed25519 as devk
+    barrier = threading.Barrier(2, timeout=20)
+    orig_build = devk.build_a_tables_device
+
+    def synced_build(a_words):
+        barrier.wait()                  # both threads inside the miss
+        return orig_build(a_words)
+
+    devk.build_a_tables_device = synced_build
+    try:
+        ts = [threading.Thread(target=cache2.get, args=(words[0],))
+              for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+    finally:
+        devk.build_a_tables_device = orig_build
+    assert cache2.misses == 2
+    assert cache2.bytes_resident == per_entry
+
+    # oversize single table: served by get(), never admitted — and the
+    # default policy refuses to route it through the cached kernel at
+    # all (rebuilding per sighting would be slower than staying fused)
+    tiny = ed.ATableCache(capacity=100, max_bytes=per_entry - 1)
+    tiny.MIN_K = 4
+    assert tiny.get_if_worthwhile(words[0]) is None
+    assert tiny.get_if_worthwhile(words[0]) is None   # every sighting
+    tab, ok = tiny.get(words[0])
+    assert tab.shape[-1] == words[0].shape[-1]
+    assert tiny.bytes_resident == 0 and len(tiny._entries) == 0
+    # and verification through an un-admitted entry still works
+    from cometbft_tpu.ops import ed25519 as devk
+    privs = [ed.PrivKey.generate(bytes([0x50]) * 31 + bytes([i + 1]))
+             for i in range(6)]
+    pks = [p.pub_key().bytes() for p in privs]
+    ms = [b"byte bound %d" % i for i in range(6)]
+    ss = [privs[i].sign(ms[i]) for i in range(6)]
+    packed = ed.pack_rlc(pks, ms, ss)
+    out = devk.rlc_verify_device_cached_a(
+        tab, ok, packed[1], packed[2], packed[3], packed[4], packed[5])
+    assert bool(np.asarray(out))
