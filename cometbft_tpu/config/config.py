@@ -76,6 +76,11 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
+    # testnet WAN emulation: one-way delivery delay added to every
+    # peer frame this node sends (the reference's e2e runner injects
+    # per-zone latency with tc netem, test/e2e/pkg/latency/; a
+    # subprocess testnet has no containers, so the transport does it)
+    emulate_latency_ms: float = 0.0
 
 
 @dataclass

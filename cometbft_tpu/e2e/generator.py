@@ -54,6 +54,14 @@ def generate(seed: int) -> Manifest:
     m = Manifest(nodes=nodes,
                  load_tx_rate=rng.choice([5, 10, 20]),
                  run_blocks=rng.randint(6, 10))
+
+    # WAN-shaped per-node latency (reference test/e2e/pkg/latency/
+    # zone matrices).  Drawn LAST so earlier seeds' topologies are
+    # byte-stable across generator versions.
+    if rng.random() < 0.3:
+        for n in nodes:
+            n.latency_ms = rng.choice((0.0, 25.0, 50.0, 100.0))
+
     m.validate()
     return m
 
@@ -73,6 +81,8 @@ def to_toml(m: Manifest) -> str:
             lines.append(f'key_type = "{n.key_type}"')
         if n.state_sync:
             lines.append("state_sync = true")
+        if n.latency_ms:
+            lines.append(f"latency_ms = {n.latency_ms}")
         if n.perturb:
             lines.append("perturb = ["
                          + ", ".join(f'"{p}"' for p in n.perturb) + "]")

@@ -33,10 +33,14 @@ class NodeManifest:
     perturb: list[str] = field(default_factory=list)
     key_type: str = "ed25519"        # validator key (generator mixes)
     state_sync: bool = False         # bootstrap from a snapshot on join
+    latency_ms: float = 0.0          # one-way WAN delay on sent frames
+                                     # (reference test/e2e/pkg/latency/)
 
     def validate(self) -> None:
         if self.mode not in ("validator", "full"):
             raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+        if not 0 <= self.latency_ms <= 2000:
+            raise ValueError(f"{self.name}: latency_ms out of range")
         for p in self.perturb:
             if p not in PERTURBATIONS:
                 raise ValueError(f"{self.name}: unknown perturbation {p!r}")
@@ -75,7 +79,8 @@ class Manifest:
                 start_at=int(spec.get("start_at", 0)),
                 perturb=list(spec.get("perturb", [])),
                 key_type=spec.get("key_type", "ed25519"),
-                state_sync=bool(spec.get("state_sync", False))))
+                state_sync=bool(spec.get("state_sync", False)),
+                latency_ms=float(spec.get("latency_ms", 0.0))))
         m.validate()
         return m
 

@@ -162,6 +162,11 @@ class Testnet:
         genesis.consensus_params.validator.pub_key_types = sorted(
             key_types | {"ed25519"})
 
+        # worst-case RTT between any pair: both endpoints delay their
+        # sends, so timeouts must absorb the SUM of two one-way delays
+        worst_rtt = 2 * max(
+            (n.latency_ms / 1000.0 for n in self.manifest.nodes),
+            default=0.0)
         for node in self.nodes:
             cfg = load_config(node.home)
             cfg.base.root_dir = node.home
@@ -170,14 +175,18 @@ class Testnet:
             cfg.rpc.laddr = f"tcp://127.0.0.1:{node.rpc_port}"
             cfg.p2p.persistent_peers = ",".join(
                 p.p2p_addr for p in self.nodes if p is not node)
+            cfg.p2p.emulate_latency_ms = node.manifest.latency_ms
             if self.fast:
-                cfg.consensus.timeout_propose = 0.3
+                # a proposal needs ~3 one-way hops (proposal + parts +
+                # votes) before the propose timeout may fire without
+                # stalling the round
+                cfg.consensus.timeout_propose = 0.3 + 3 * worst_rtt
                 cfg.consensus.timeout_propose_delta = 0.05
-                cfg.consensus.timeout_prevote = 0.1
+                cfg.consensus.timeout_prevote = 0.1 + worst_rtt
                 cfg.consensus.timeout_prevote_delta = 0.05
-                cfg.consensus.timeout_precommit = 0.1
+                cfg.consensus.timeout_precommit = 0.1 + worst_rtt
                 cfg.consensus.timeout_precommit_delta = 0.05
-                cfg.consensus.timeout_commit = 0.2
+                cfg.consensus.timeout_commit = 0.2 + worst_rtt
             genesis.save_as(cfg.genesis_file())
             write_config_file(
                 os.path.join(node.home, "config", "config.toml"), cfg)

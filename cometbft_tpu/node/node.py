@@ -277,6 +277,11 @@ class Node(BaseService):
         self.switch = Switch(self.transport, listen_addr=listen)
         self.switch.max_inbound = config.p2p.max_num_inbound_peers
         self.switch.max_outbound = config.p2p.max_num_outbound_peers
+        if config.p2p.emulate_latency_ms > 0:
+            from ..p2p.fuzz import LatencyConnection
+            delay = config.p2p.emulate_latency_ms / 1000.0
+            self.switch.conn_wrap = (
+                lambda conn: LatencyConnection(conn, delay))
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("MEMPOOL",
                                 MempoolReactor(self.mempool,

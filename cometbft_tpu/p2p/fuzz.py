@@ -18,6 +18,7 @@ handshakes always complete; mirrored here.
 
 from __future__ import annotations
 
+import queue
 import random
 import threading
 import time
@@ -73,6 +74,75 @@ class FuzzedConnection:
         return self._conn.read()
 
     def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+class LatencyConnection:
+    """WAN latency emulation: every frame is DELIVERED one-way-delay
+    late, without throttling the sender (the reference injects per-zone
+    latency with tc netem in its e2e containers,
+    test/e2e/pkg/latency/; here the switch's conn_wrap seam applies the
+    same shape to a subprocess testnet).
+
+    Writes enqueue (due-time, frame); a pump thread releases them in
+    order once due — so a burst of block parts stays a burst, merely
+    shifted, unlike a sleep-in-write() model whose link would have a
+    one-frame bandwidth-delay product.  A delivery failure is surfaced
+    on the NEXT write, matching how a real socket reports asynchronous
+    resets."""
+
+    # bounded so a stalled link still exerts backpressure on the
+    # sender (MConnection's flow control relies on write() blocking);
+    # sized to keep a 100 ms pipe full at far more frames than the
+    # send-rate limiter can produce
+    MAX_QUEUED = 1024
+
+    def __init__(self, conn, delay_s: float):
+        self._conn = conn
+        self._delay = delay_s
+        self._q: queue.Queue = queue.Queue(maxsize=self.MAX_QUEUED)
+        self._err: Exception | None = None
+        threading.Thread(target=self._pump, daemon=True,
+                         name="latency-pump").start()
+
+    def _pump(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            due, data = item
+            wait = due - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                self._conn.write(data)
+            except Exception as e:          # surfaced on the next write
+                self._err = e
+                return
+
+    def write(self, data: bytes) -> int:
+        due = time.monotonic() + self._delay
+        while True:
+            if self._err is not None:   # incl. after the pump died: a
+                raise self._err         # full queue must not deadlock
+            try:
+                self._q.put((due, data), timeout=1.0)
+                return len(data)
+            except queue.Full:
+                continue
+
+    def read(self) -> bytes:
+        return self._conn.read()
+
+    def close(self) -> None:
+        self._err = self._err or OSError("connection closed")
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass                        # pump dies on the closed socket
         self._conn.close()
 
     def __getattr__(self, name):

@@ -43,6 +43,9 @@ class Switch(BaseService):
         self.dialing: set[str] = set()
         # optional P2PMetrics (libs/metrics.py), assigned by the node
         self.metrics = None
+        # optional conn wrapper applied to every peer connection before
+        # the MConnection is built (latency emulation, fault injection)
+        self.conn_wrap = None
         self.reconnecting: set[str] = set()
         self.persistent_peers: set[str] = set()  # addresses 'id@host:port'
         self._mtx = threading.Lock()
@@ -143,6 +146,8 @@ class Switch(BaseService):
             if peer_ref[0] is not None:
                 self.stop_peer_for_error(peer_ref[0], e)
 
+        if self.conn_wrap is not None:
+            conn = self.conn_wrap(conn)
         mconn = MConnection(conn, self.channel_descs, on_receive,
                             on_error)
         peer = Peer(node_info, mconn, outbound, persistent, socket_addr)

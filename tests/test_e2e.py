@@ -92,6 +92,72 @@ def test_generator_deterministic_and_roundtrip():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 18, 39])
+def test_e2e_generated_seed_sweep(tmp_path, seed):
+    """Generated-topology sweep (reference test/e2e/generator/main.go
+    exists to SWEEP, not to pin one topology).  The four seeds jointly
+    cover: every perturbation kind (kill=2, pause=2/39, restart=18/39,
+    disconnect=1/18), statesync joiners (1, 39), mixed ed25519+
+    secp256k1 valsets (2, 18, 39), a late full node (18), and per-node
+    WAN latency (18: 50 ms validator + 25 ms full node)."""
+    from cometbft_tpu.e2e import generator
+
+    manifest = generator.generate(seed)
+    net = Testnet(manifest, str(tmp_path / f"gen{seed}"),
+                  chain_id=f"e2e-gen{seed}")
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(3, timeout=180)
+        txs = net.load(6)
+        assert len(txs) >= 3
+        target = min(manifest.run_blocks, 6)
+        net.wait_for_height(target, timeout=300, nodes=net.nodes)
+        net.run_perturbations()
+        tip = max(n.height() for n in net.nodes if n.running())
+        net.wait_for_height(tip + 2, timeout=180, nodes=net.nodes)
+        assert net.check_block_identity() >= 2
+        assert net.check_txs_committed(txs) == len(txs)
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_e2e_wan_latency(tmp_path):
+    """Liveness at ~100 ms RTT: every node delays its sent frames by
+    50 ms one-way (reference injects the same shape with tc netem,
+    test/e2e/pkg/latency/).  Consensus must keep committing with the
+    latency-scaled timeouts runner.setup() derives."""
+    manifest = Manifest.parse("""
+load_tx_rate = 10
+run_blocks = 5
+
+[node.validator0]
+latency_ms = 50
+[node.validator1]
+latency_ms = 50
+[node.validator2]
+latency_ms = 50
+""")
+    net = Testnet(manifest, str(tmp_path / "wan"), chain_id="e2e-wan")
+    net.setup()
+    # the knob must land in every node's on-disk config
+    from cometbft_tpu.config import load_config
+    for node in net.nodes:
+        assert load_config(node.home).p2p.emulate_latency_ms == 50.0
+    net.start()
+    try:
+        net.wait_for_height(manifest.run_blocks, timeout=240)
+        txs = net.load(5)
+        tip = max(n.height() for n in net.nodes)
+        net.wait_for_height(tip + 2, timeout=120)
+        assert net.check_block_identity() >= manifest.run_blocks
+        assert net.check_txs_committed(txs) == len(txs)
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
 def test_e2e_generated_statesync_and_mixed_keys(tmp_path):
     """Generated manifest (seed 8): a 2-validator chain where one
     validator signs with secp256k1 (mixed-keytype commits — the

@@ -363,3 +363,63 @@ class TestNodeKey:
         assert (pid, host, port) == ("ab12", "10.0.0.1", 26656)
         pid, host, port = parse_addr("tcp://1.2.3.4:80")
         assert (pid, host, port) == ("", "1.2.3.4", 80)
+
+
+class TestLatencyConnection:
+    """p2p/fuzz.LatencyConnection: delivery-delayed, order-preserving,
+    non-throttling (the e2e WAN emulation seam)."""
+
+    class _Sink:
+        def __init__(self, fail_after=None):
+            self.writes = []
+            self.fail_after = fail_after
+            self.closed = False
+
+        def write(self, data):
+            if (self.fail_after is not None
+                    and len(self.writes) >= self.fail_after):
+                raise OSError("link down")
+            self.writes.append((time.monotonic(), data))
+            return len(data)
+
+        def read(self):
+            return b"pong"
+
+        def close(self):
+            self.closed = True
+
+    def test_delay_order_and_no_throttle(self):
+        from cometbft_tpu.p2p.fuzz import LatencyConnection
+
+        sink = self._Sink()
+        conn = LatencyConnection(sink, delay_s=0.15)
+        t0 = time.monotonic()
+        for i in range(5):
+            conn.write(b"%d" % i)
+        enqueue_time = time.monotonic() - t0
+        # the sender is NOT throttled: 5 writes return immediately
+        assert enqueue_time < 0.1
+        deadline = time.monotonic() + 3
+        while len(sink.writes) < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert [d for _, d in sink.writes] == [b"0", b"1", b"2", b"3", b"4"]
+        # every frame arrived >= one-way delay after enqueue, and the
+        # burst stayed a burst (all 5 within a small window after)
+        assert sink.writes[0][0] - t0 >= 0.14
+        assert sink.writes[-1][0] - sink.writes[0][0] < 0.1
+        assert conn.read() == b"pong"
+        conn.close()
+        assert sink.closed
+
+    def test_delivery_error_surfaces_on_next_write(self):
+        from cometbft_tpu.p2p.fuzz import LatencyConnection
+
+        sink = self._Sink(fail_after=1)
+        conn = LatencyConnection(sink, delay_s=0.02)
+        conn.write(b"ok")
+        conn.write(b"dropped")          # pump dies delivering this one
+        deadline = time.monotonic() + 3
+        while conn._err is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(OSError):
+            conn.write(b"after")
