@@ -133,6 +133,18 @@ def main():
             log("light_headers", commits_per_dispatch=commits,
                 error=repr(e)[:200])
 
+    # 6: blocksync at 10k validators, cached-A (consecutive blocks
+    # share the valset — the cache's ideal case; VERDICT r3 item 5)
+    for bpd in (3, 6):
+        try:
+            r = bench.bench_blocksync(10_000, bpd, 4)
+            log("blocksync", n_vals=10_000, blocks_per_dispatch=bpd,
+                blocks_per_sec=round(r, 2),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("blocksync", n_vals=10_000, blocks_per_dispatch=bpd,
+                error=repr(e)[:200])
+
     log("done", t=round(time.time() - t0, 1))
 
 

@@ -1,0 +1,120 @@
+"""Fold on-TPU capture artifacts into docs/PERF.md.
+
+Reads ab_round4_results.jsonl (scripts/ab_round3.py output) and
+BENCH_live.json (bench.py output) and rewrites the round-4 measured
+section of docs/PERF.md between the AUTO markers, so every healthy
+relay window the watch loop finds (scripts/relay_watch.sh) lands the
+freshest numbers in-tree without hand-editing.
+
+Usage: python scripts/perf_report.py   (run from the repo root)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AB = os.path.join(ROOT, "ab_round4_results.jsonl")
+BENCH = os.path.join(ROOT, "BENCH_live.json")
+PERF = os.path.join(ROOT, "docs", "PERF.md")
+
+BEGIN = "<!-- AUTO-R4-BEGIN (scripts/perf_report.py) -->"
+END = "<!-- AUTO-R4-END -->"
+
+
+def load_ab() -> list[dict]:
+    recs = []
+    if os.path.exists(AB):
+        with open(AB) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        recs.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    return recs
+
+
+def fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:,.1f}"
+    return str(v)
+
+
+def build_section() -> str:
+    lines = [BEGIN, "",
+             "## Round-4 on-hardware capture (auto-generated)",
+             "",
+             f"Last updated {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())} "
+             "by scripts/perf_report.py from ab_round4_results.jsonl / "
+             "BENCH_live.json.", ""]
+
+    if os.path.exists(BENCH):
+        try:
+            with open(BENCH) as f:
+                b = json.load(f)
+            lines += [
+                f"**Headline: {fmt(b['value'])} {b['unit']} = "
+                f"{b['vs_baseline']}x the Go-CPU baseline** "
+                f"(bench.py, batch {b['extra'].get('rlc_batch', '?')}).",
+                ""]
+            extra = b.get("extra", {})
+            rows = [(k, v) for k, v in extra.items()
+                    if isinstance(v, (int, float))]
+            if rows:
+                lines += ["| extra metric | value |", "|---|---|"]
+                lines += [f"| {k} | {fmt(v)} |" for k, v in rows]
+                lines.append("")
+        except (json.JSONDecodeError, KeyError) as e:
+            lines += [f"(BENCH_live.json unreadable: {e})", ""]
+
+    recs = load_ab()
+    if recs:
+        lines += ["### A/B queue (scripts/ab_round3.py)", ""]
+        by_name: dict[str, list[dict]] = {}
+        for r in recs:
+            by_name.setdefault(r.get("name", "?"), []).append(r)
+        for name, rs in by_name.items():
+            if name in ("devices", "done"):
+                continue
+            lines += [f"**{name}**", "",
+                      "| config | result |", "|---|---|"]
+            for r in rs:
+                cfg = ", ".join(f"{k}={v}" for k, v in r.items()
+                                if k not in ("name", "t",
+                                             "sigs_per_sec",
+                                             "headers_per_sec",
+                                             "blocks_per_sec", "error"))
+                val = r.get("error") or next(
+                    (f"{fmt(r[k])} {k.replace('_per_sec', '/s')}"
+                     for k in ("sigs_per_sec", "headers_per_sec",
+                               "blocks_per_sec") if k in r), "?")
+                lines.append(f"| {cfg} | {val} |")
+            lines.append("")
+    else:
+        lines += ["No A/B results captured yet (relay wedged so far "
+                  "this round; the watch loop keeps trying).", ""]
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def main() -> None:
+    with open(PERF) as f:
+        text = f.read()
+    section = build_section()
+    if BEGIN in text:
+        pre = text[:text.index(BEGIN)]
+        post = text[text.index(END) + len(END):]
+        text = pre + section + post
+    else:
+        text = text.rstrip() + "\n\n" + section + "\n"
+    with open(PERF, "w") as f:
+        f.write(text)
+    print("PERF.md updated")
+
+
+if __name__ == "__main__":
+    main()
