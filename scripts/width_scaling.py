@@ -1,0 +1,138 @@
+"""Width-scaling + dispatch-latency decomposition on the live TPU.
+
+Round-4 finding: at batch 4095 the per-sig kernel and the cached-A RLC
+kernel measure IDENTICAL throughput (74.9 ms/dispatch) — the signature
+of a fixed per-dispatch relay cost dominating execution.  This script
+separates the two:
+
+  1. relay latency: round-trip of a trivial jitted op, 16 reps;
+  2. per-dispatch wall time for each kernel at widths 4k/8k/16k/32k
+     (serial dispatches, np.asarray fence per dispatch);
+  3. pipelined (async) time for 8 dispatches, to see whether the relay
+     overlaps execution with dispatch at all.
+
+Results to a JSONL file (arg 1, default /tmp/width_scaling.jsonl).
+
+Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
+       flock /tmp/tpu.lock python scripts/width_scaling.py out.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/width_scaling.jsonl"
+
+
+def log(name, **kv):
+    rec = {"name": name, **kv}
+    print(json.dumps(rec), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _serial(fn, args, iters):
+    """Mean wall per dispatch with a hard readback fence per dispatch."""
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sum(ts) / len(ts)
+
+
+def _pipelined(fn, args, iters):
+    """Issue iters dispatches back-to-back, fence once at the end."""
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(iters)]
+    np.asarray(outs[-1])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    log("devices", devices=str(jax.devices()))
+    t_start = time.time()
+
+    # 1. relay round-trip floor
+    tiny = jax.jit(lambda x: x + 1)
+    x = jax.device_put(jnp.ones((8, 128), jnp.int32))
+    np.asarray(tiny(x))
+    best, mean = _serial(tiny, (x,), 16)
+    pipe = _pipelined(tiny, (x,), 16)
+    log("relay_floor", serial_best_ms=round(best * 1e3, 2),
+        serial_mean_ms=round(mean * 1e3, 2),
+        pipelined_ms=round(pipe * 1e3, 2))
+
+    import bench
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    for batch in (4095, 8191, 16383, 32767):
+        pks, msgs, sigs = bench._make_sigs(batch)
+        packed = [jax.device_put(x) for x in ed.pack_rlc(pks, msgs, sigs)]
+
+        # fused RLC
+        try:
+            t0 = time.time()
+            assert bool(np.asarray(dev.rlc_verify_device(*packed)))
+            compile_s = round(time.time() - t0, 1)
+            best, mean = _serial(dev.rlc_verify_device, packed, 6)
+            pipe = _pipelined(dev.rlc_verify_device, packed, 6)
+            log("rlc_fused", batch=batch, compile_s=compile_s,
+                serial_best_ms=round(best * 1e3, 1),
+                serial_mean_ms=round(mean * 1e3, 1),
+                pipelined_ms=round(pipe * 1e3, 1),
+                sigs_per_sec_pipelined=round(batch / pipe, 1),
+                t=round(time.time() - t_start, 1))
+        except Exception as e:
+            log("rlc_fused", batch=batch, error=repr(e)[:300])
+
+        # cached-A RLC
+        try:
+            assert ed.rlc_verify(packed, use_cache=True)
+            a_tab, a_ok = ed._A_TABLE_CACHE.get(np.asarray(packed[0]))
+            cargs = (a_tab, a_ok) + tuple(packed[1:])
+            best, mean = _serial(dev.rlc_verify_device_cached_a, cargs, 6)
+            pipe = _pipelined(dev.rlc_verify_device_cached_a, cargs, 6)
+            log("rlc_cached", batch=batch,
+                serial_best_ms=round(best * 1e3, 1),
+                serial_mean_ms=round(mean * 1e3, 1),
+                pipelined_ms=round(pipe * 1e3, 1),
+                sigs_per_sec_pipelined=round(batch / pipe, 1),
+                t=round(time.time() - t_start, 1))
+        except Exception as e:
+            log("rlc_cached", batch=batch, error=repr(e)[:300])
+
+        # per-sig kernel
+        try:
+            bucket = dev.bucket_size(batch)
+            a, r, s, h, valid = ed.pack_batch(pks, msgs, sigs, bucket)
+            args = [jax.device_put(v) for v in (a, r, s, h)]
+            t0 = time.time()
+            verdict = np.asarray(dev.verify_batch_device(*args))
+            compile_s = round(time.time() - t0, 1)
+            assert verdict[:batch].all()
+            best, mean = _serial(dev.verify_batch_device, args, 6)
+            pipe = _pipelined(dev.verify_batch_device, args, 6)
+            log("per_sig", batch=batch, bucket=bucket, compile_s=compile_s,
+                serial_best_ms=round(best * 1e3, 1),
+                serial_mean_ms=round(mean * 1e3, 1),
+                pipelined_ms=round(pipe * 1e3, 1),
+                sigs_per_sec_pipelined=round(batch / pipe, 1),
+                t=round(time.time() - t_start, 1))
+        except Exception as e:
+            log("per_sig", batch=batch, error=repr(e)[:300])
+
+    log("done", t=round(time.time() - t_start, 1))
+
+
+if __name__ == "__main__":
+    main()
