@@ -264,7 +264,10 @@ def main() -> None:
     # secondary metrics yield to the budget so the headline ALWAYS
     # prints before any driver timeout
     budget = float(os.environ.get("BENCH_TIME_BUDGET", "1500"))
-    extra_timeout = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "420"))
+    # cold compiles of the big light-client/blocksync shapes measured
+    # >420 s over the relay in the round-4 capture; 600 keeps the
+    # worst-case watchdog deadline (budget + 2x this) under 45 min
+    extra_timeout = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "600"))
     t0 = time.perf_counter()
 
     rlc = bench_rlc(batch, iters)                 # distinct keys: one

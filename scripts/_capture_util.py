@@ -1,0 +1,33 @@
+"""Shared helpers for the on-TPU capture scripts (mosaic_smoke,
+ab_round3, width_scaling): JSONL append-logging and resume-skip of
+already-captured arms, so a run killed mid-way by the watch-loop
+timeout (scripts/relay_watch.sh) resumes instead of re-paying every
+compile from scratch."""
+
+from __future__ import annotations
+
+import json
+
+
+def append_log(out_path: str, rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def already_done(out_path: str, key_fn) -> set:
+    """Keys (via key_fn(record)) of every SUCCESSFUL record in
+    out_path; error records don't count so failed arms are retried."""
+    done = set()
+    try:
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "error" not in rec and "err" not in rec:
+                    done.add(key_fn(rec))
+    except OSError:
+        pass
+    return done

@@ -19,21 +19,36 @@ fence (axon discipline: block_until_ready lies; single dispatches carry
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, "/root/repo/scripts")
+from _capture_util import already_done, append_log  # noqa: E402
+
 OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/ab_round3.jsonl"
 
 
 def log(name, **kv):
-    rec = {"name": name, **kv}
-    print(json.dumps(rec), flush=True)
-    with open(OUT, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    append_log(OUT, {"name": name, **kv})
+
+
+def _arm_key(rec: dict) -> tuple:
+    return (rec.get("name"), rec.get("batch"), rec.get("pallas"),
+            rec.get("commits_per_dispatch"),
+            rec.get("blocks_per_dispatch"))
+
+
+def _already_done() -> set:
+    """Arms with a SUCCESSFUL record in OUT: a queue killed mid-way by
+    the watch-loop timeout resumes instead of re-paying every compile."""
+    return already_done(OUT, _arm_key)
+
+
+def _skip(done, name, **kv) -> bool:
+    return _arm_key({"name": name, **kv}) in done
 
 
 def bench_rlc_width(batch, iters=8, use_cache=False):
@@ -50,6 +65,7 @@ def main():
                       "/tmp/cometbft_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     t0 = time.time()
+    done = _already_done()
     log("devices", devices=str(jax.devices()), t=0)
 
     import bench
@@ -57,18 +73,20 @@ def main():
 
     # 1+2: width scaling, fused vs cached
     for batch in (4095, 8191, 16383):
-        try:
-            r = bench_rlc_width(batch)
-            log("rlc_fused", batch=batch, sigs_per_sec=round(r, 1),
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("rlc_fused", batch=batch, error=repr(e)[:200])
-        try:
-            r = bench_rlc_width(batch, use_cache=True)
-            log("rlc_cached", batch=batch, sigs_per_sec=round(r, 1),
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("rlc_cached", batch=batch, error=repr(e)[:200])
+        if not _skip(done, "rlc_fused", batch=batch):
+            try:
+                r = bench_rlc_width(batch)
+                log("rlc_fused", batch=batch, sigs_per_sec=round(r, 1),
+                    t=round(time.time() - t0, 1))
+            except Exception as e:
+                log("rlc_fused", batch=batch, error=repr(e)[:200])
+        if not _skip(done, "rlc_cached", batch=batch):
+            try:
+                r = bench_rlc_width(batch, use_cache=True)
+                log("rlc_cached", batch=batch, sigs_per_sec=round(r, 1),
+                    t=round(time.time() - t0, 1))
+            except Exception as e:
+                log("rlc_cached", batch=batch, error=repr(e)[:200])
 
     # 3: pallas tree A/B.  The flag is read at TRACE time, so the
     # jitted wrappers must be rebuilt per arm or the cached trace from
@@ -79,9 +97,14 @@ def main():
         dev._a_tables_jitted = jax.jit(dev._msm_tables)
 
     for flag in (True, False):
+        if all(_skip(done, "pallas_tree_ab", pallas=flag, batch=b)
+               for b in (4095, 8191)):
+            continue
         dev.USE_PALLAS_TREE = flag
         refresh_jits()
         for batch in (4095, 8191):
+            if _skip(done, "pallas_tree_ab", pallas=flag, batch=batch):
+                continue
             try:
                 r = bench_rlc_width(batch)
                 log("pallas_tree_ab", pallas=flag, batch=batch,
@@ -95,9 +118,15 @@ def main():
 
     # 3b: whole-window-loop kernel (supersedes the tree kernel)
     for flag in (True, False):
+        if all(_skip(done, "pallas_msm_loop_ab", pallas=flag, batch=b)
+               for b in (4095, 8191)):
+            continue
         dev.USE_PALLAS_MSM_LOOP = flag
         refresh_jits()
         for batch in (4095, 8191):
+            if _skip(done, "pallas_msm_loop_ab", pallas=flag,
+                     batch=batch):
+                continue
             try:
                 r = bench_rlc_width(batch)
                 log("pallas_msm_loop_ab", pallas=flag, batch=batch,
@@ -111,6 +140,8 @@ def main():
 
     # 4: pallas decompress A/B
     for flag in (True, False):
+        if _skip(done, "pallas_decompress_ab", pallas=flag, batch=4095):
+            continue
         dev.USE_PALLAS_DECOMPRESS = flag
         refresh_jits()
         try:
@@ -122,8 +153,11 @@ def main():
     dev.USE_PALLAS_DECOMPRESS = False
     refresh_jits()
 
-    # 5: light-client depth
-    for commits in (24, 48):
+    # 5: light-client depth (96 added round 4: the dispatch-latency
+    # floor rewards deeper batching — docs/PERF.md round-4 capture)
+    for commits in (24, 48, 96):
+        if _skip(done, "light_headers", commits_per_dispatch=commits):
+            continue
         try:
             r = bench.bench_light_headers(150, 8, commits)
             log("light_headers", commits_per_dispatch=commits,
@@ -136,6 +170,8 @@ def main():
     # 6: blocksync at 10k validators, cached-A (consecutive blocks
     # share the valset — the cache's ideal case; VERDICT r3 item 5)
     for bpd in (3, 6):
+        if _skip(done, "blocksync", blocks_per_dispatch=bpd):
+            continue
         try:
             r = bench.bench_blocksync(10_000, bpd, 4)
             log("blocksync", n_vals=10_000, blocks_per_dispatch=bpd,

@@ -5,121 +5,178 @@ For each kernel, compile + run on the REAL TPU backend at a small
 width, oracle against the XLA path, and print one JSON line per probe:
   {"kernel": ..., "blk": ..., "ok": bool, "match": bool, "err": ...}
 
+Every oracle is JITTED: an eager jnp chain dispatches one relay
+round-trip (~65 ms, docs/PERF.md) per primitive, which would turn the
+W=512 oracle into hours.  Probes already captured in the output file
+are skipped on re-entry (the watch loop re-runs this script until the
+"done" record lands).
+
 Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
-       flock /tmp/tpu.lock python scripts/mosaic_smoke.py
+       flock /tmp/tpu.lock python scripts/mosaic_smoke.py [out.jsonl]
 """
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 
 import numpy as np
 
 sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/scripts")
+from _capture_util import already_done, append_log  # noqa: E402
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mosaic_smoke.jsonl"
+
+
+ALL_PROBES = [(k, b) for k in ("decompress", "select_tree",
+                               "msm_window_loop") for b in (256, 512)]
+MAX_ATTEMPTS = 2      # error records per probe before it counts as
+                      # settled (a kernel Mosaic rejects fails every
+                      # time; the gate must not re-run it forever)
 
 
 def log(**kv):
-    print(json.dumps(kv), flush=True)
+    append_log(OUT, kv)
+
+
+def _settled() -> set:
+    """Probes with a successful record OR >= MAX_ATTEMPTS failures."""
+    import collections
+    import json
+
+    key = lambda r: (r.get("kernel"), r.get("blk"))  # noqa: E731
+    settled = already_done(OUT, key)
+    fails: collections.Counter = collections.Counter()
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "err" in rec:
+                    fails[key(rec)] += 1
+    except OSError:
+        pass
+    settled |= {k for k, n in fails.items() if n >= MAX_ATTEMPTS}
+    return settled
+
+
+def _finish():
+    """Emit the watch-loop gate record once every probe is settled
+    (succeeded, or failed MAX_ATTEMPTS times)."""
+    if all(p in _settled() for p in ALL_PROBES):
+        log(done=True)
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
+    done = _settled()
     log(devices=str(jax.devices()))
 
+    import bench
     from cometbft_tpu.crypto import ed25519 as ed
-    from cometbft_tpu.crypto import ed25519_ref as ref
     from cometbft_tpu.ops import ed25519 as dev
+    from cometbft_tpu.ops import fe as _fe
     from cometbft_tpu.ops import pallas_msm as pm
     from cometbft_tpu.ops import pallas_decompress as pd
 
     # -- a real batch of W signatures ------------------------------------
     W = 512
-    seeds = [bytes([i & 0xFF, i >> 8] + [5] * 30) for i in range(W)]
-    keys = [ref.keygen(s) for s in seeds]
-    msgs = [i.to_bytes(8, "little") * 8 for i in range(W)]
-    sigs = [ref.sign(seeds[i], msgs[i]) for i in range(W)]
-    pks = [k[1] for k in keys]
-
+    pks, msgs, sigs = bench._make_sigs(W)
     packed = ed.pack_rlc(pks, msgs, sigs)
     a_words, r_words, a_mag, a_neg, r_mag, r_neg = [
         jax.device_put(np.asarray(x)) for x in packed]
 
+    # jitted oracles (never run the XLA reference eagerly on the relay)
+    dec_j = jax.jit(dev.decompress)
+    tr1_j = jax.jit(lambda p: dev._tree_reduce(p, 1))
+    scan_j = jax.jit(dev._msm_scan)
+    win0_j = jax.jit(lambda tab, m, n: dev._tree_reduce(
+        dev._cond_neg_point(dev._select17(tab, m), n), 1))
+    freeze_j = jax.jit(_fe.freeze)
+
+    def _toint(limbs):
+        """(20, 1) limb column -> canonical python int mod p."""
+        x = np.asarray(freeze_j(jnp.asarray(limbs))).astype(object)
+        return sum(int(x[i, 0]) << (13 * i)
+                   for i in range(x.shape[0])) % _fe.P
+
+    def _proj_eq(got, want):
+        """Projective point equality via python-int cross-mul mod p."""
+        gx, gy, gz = _toint(got[0]), _toint(got[1]), _toint(got[2])
+        wx, wy, wz = _toint(want[0]), _toint(want[1]), _toint(want[2])
+        return ((gx * wz - wx * gz) % _fe.P == 0
+                and (gy * wz - wy * gz) % _fe.P == 0)
+
+    # all-lane frozen-coordinate equality in ONE dispatch (X, Y, T;
+    # both paths fix Z=1)
+    pts_eq_j = jax.jit(lambda p, q: jnp.all(
+        _fe.eq(p[0], q[0]) & _fe.eq(p[1], q[1]) & _fe.eq(p[3], q[3])))
+
     # -- 1. pallas decompress vs XLA decompress --------------------------
     for blk in (256, 512):
+        if ("decompress", blk) in done:
+            continue
         t0 = time.time()
         try:
             pt, ok = pd.decompress(r_words, blk=blk)
-            pt, ok = np.asarray(pt), np.asarray(ok)
-            pt_x, ok_x = dev.decompress(r_words)
-            pt_x, ok_x = np.asarray(pt_x), np.asarray(ok_x)
-            # compare frozen coordinates via the XLA freeze
-            from cometbft_tpu.ops import fe
-            same = bool(np.asarray(
-                jnp.all(fe.eq(jnp.asarray(pt[0]), jnp.asarray(pt_x[0])) &
-                        fe.eq(jnp.asarray(pt[1]), jnp.asarray(pt_x[1])) &
-                        fe.eq(jnp.asarray(pt[3]), jnp.asarray(pt_x[3])))))
+            ok = np.asarray(ok)
+            pt_x, ok_x = dec_j(r_words)
+            ok_x = np.asarray(ok_x)
+            coords_match = bool(np.asarray(pts_eq_j(pt, pt_x)))
             log(kernel="decompress", blk=blk, ok=True,
-                match=bool((ok == ok_x).all()) and same,
+                match=bool((ok == ok_x).all()) and coords_match,
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="decompress", blk=blk, ok=False,
                 err=repr(e)[:400], dt=round(time.time() - t0, 1))
 
     # -- 2. select_tree + 3. window loop vs XLA MSM ----------------------
-    tab, tab_ok = dev._msm_tables(r_words)
+    msm_probes = [("select_tree", b) for b in (256, 512)] + \
+                 [("msm_window_loop", b) for b in (256, 512)]
+    if all(p in done for p in msm_probes):
+        _finish()           # skip the table build + scan oracle
+        return
+    tab, _tab_ok = dev.build_a_tables_device(r_words)
     tab = jax.device_put(np.asarray(tab))
 
     # XLA oracle: full R-side MSM accumulator
-    acc_ref = np.asarray(dev._msm_scan(tab, r_mag, r_neg))
+    acc_ref = np.asarray(scan_j(tab, r_mag, r_neg))
 
     for blk in (256, 512):
+        if ("select_tree", blk) in done:
+            continue
         t0 = time.time()
         try:
             part = pm.select_tree(tab, r_mag[0], r_neg[0], blk=blk)
-            part = np.asarray(part)
-            # oracle: XLA select + tree for window 0
-            contrib = dev._cond_neg_point(
-                dev._select17(tab, r_mag[0]), r_neg[0])
-            want = np.asarray(dev._tree_reduce(contrib, 1))
-            got = np.asarray(dev._tree_reduce(jnp.asarray(part), 1))
-            from cometbft_tpu.ops import fe as _fe
-            eqp = bool(np.asarray(jnp.all(
-                _fe.eq(jnp.asarray(got[0] * want[2]),
-                       jnp.asarray(want[0] * got[2])))))
-            log(kernel="select_tree", blk=blk, ok=True, match=eqp,
+            got = np.asarray(tr1_j(jnp.asarray(part)))
+            want = np.asarray(win0_j(tab, r_mag[0], r_neg[0]))
+            log(kernel="select_tree", blk=blk, ok=True,
+                match=_proj_eq(got, want),
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="select_tree", blk=blk, ok=False,
                 err=repr(e)[:400], dt=round(time.time() - t0, 1))
 
     for blk in (256, 512):
+        if ("msm_window_loop", blk) in done:
+            continue
         t0 = time.time()
         try:
             part = pm.msm_window_loop(tab, r_mag, r_neg, blk=blk)
-            got = np.asarray(dev._tree_reduce(jnp.asarray(part), 1))
-            from cometbft_tpu.ops import fe as _fe
-            # projective equality X1*Z2 == X2*Z1 (cheap cross-mul in
-            # python ints after freeze)
-            def _toint(limbs):
-                x = np.asarray(_fe.freeze(jnp.asarray(limbs))).astype(object)
-                return sum(int(x[i, 0]) << (13 * i)
-                           for i in range(x.shape[0])) % _fe.P
-            gx, gy, gz = _toint(got[0]), _toint(got[1]), _toint(got[2])
-            wx, wy, wz = (_toint(acc_ref[0]), _toint(acc_ref[1]),
-                          _toint(acc_ref[2]))
-            match = (gx * wz - wx * gz) % _fe.P == 0 and \
-                    (gy * wz - wy * gz) % _fe.P == 0
-            log(kernel="msm_window_loop", blk=blk, ok=True, match=match,
+            got = np.asarray(tr1_j(jnp.asarray(part)))
+            log(kernel="msm_window_loop", blk=blk, ok=True,
+                match=_proj_eq(got, acc_ref),
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="msm_window_loop", blk=blk, ok=False,
                 err=repr(e)[:400], dt=round(time.time() - t0, 1))
 
-    log(done=True)
+    _finish()
 
 
 if __name__ == "__main__":

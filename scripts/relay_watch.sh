@@ -15,7 +15,9 @@ export JAX_COMPILATION_CACHE_DIR=/tmp/cometbft_tpu_jax_cache
 
 LOCK=/tmp/tpu.lock
 LOG=/tmp/relay_watch.log
+SMOKE_OUT=/root/repo/mosaic_smoke_r4.jsonl
 AB_OUT=/root/repo/ab_round4_results.jsonl
+WS_OUT=/root/repo/width_scaling_r4.jsonl
 BENCH_OUT=/root/repo/BENCH_live.json
 STAMP=/tmp/last_bench_capture
 
@@ -23,8 +25,14 @@ log() { echo "$(date +%F' '%T) $*" >>"$LOG"; }
 
 commit_results() {
     # Best-effort: never wedge the loop on a transient index lock.
+    # Files are added one at a time: git add aborts WHOLESALE (rc 128,
+    # nothing staged) if any single pathspec doesn't exist yet, and
+    # early phases run before later phases' outputs exist.
     for _ in 1 2 3; do
-        git add -A "$AB_OUT" "$BENCH_OUT" docs/PERF.md 2>/dev/null
+        for f in "$SMOKE_OUT" "$AB_OUT" "$WS_OUT" "$BENCH_OUT" \
+                 docs/PERF.md; do
+            [ -e "$f" ] && git add -A "$f" 2>/dev/null
+        done
         if git diff --cached --quiet; then return 0; fi
         if git commit -q -m "$1"; then
             log "committed: $1"
@@ -40,6 +48,16 @@ while true; do
     if flock -w 10 "$LOCK" timeout 90 python -c \
         "import jax; assert jax.devices()" >/dev/null 2>&1; then
         log "probe healthy"
+        # order: smoke (minutes — does Mosaic even lower the Pallas
+        # kernels?), then the round's A/B queue, then width scaling;
+        # the latter two resume/skip completed arms on re-entry.
+        if [ ! -s "$SMOKE_OUT" ] || ! grep -q '"done"' "$SMOKE_OUT"; then
+            log "running mosaic_smoke -> $SMOKE_OUT"
+            flock "$LOCK" timeout 2700 python scripts/mosaic_smoke.py \
+                "$SMOKE_OUT" >>"$LOG" 2>&1
+            log "mosaic_smoke rc=$?"
+            commit_results "on-TPU Mosaic smoke: Pallas kernel lowering + parity probes"
+        fi
         if [ ! -s "$AB_OUT" ] || ! grep -q '"done"' "$AB_OUT"; then
             log "running ab_round3 queue -> $AB_OUT"
             flock "$LOCK" timeout 10800 python scripts/ab_round3.py \
@@ -47,6 +65,13 @@ while true; do
             log "ab queue rc=$?"
             python scripts/perf_report.py >>"$LOG" 2>&1
             commit_results "on-TPU A/B results: RLC widths, cached-A, Pallas kernels, light client"
+        fi
+        if [ ! -s "$WS_OUT" ] || ! grep -q '"done"' "$WS_OUT"; then
+            log "running width_scaling -> $WS_OUT"
+            flock "$LOCK" timeout 7200 python scripts/width_scaling.py \
+                "$WS_OUT" >>"$LOG" 2>&1
+            log "width_scaling rc=$?"
+            commit_results "on-TPU width-scaling/latency decomposition"
         fi
         now=$(date +%s)
         last=$(cat "$STAMP" 2>/dev/null || echo 0)
