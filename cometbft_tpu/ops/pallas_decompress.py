@@ -38,7 +38,9 @@ def _sqr(a):
 
 
 def _sq_n(x, n: int):
-    return jax.lax.fori_loop(0, n, lambda i, v: _sqr(v), x, unroll=4)
+    # Mosaic's fori_loop lowering supports only unroll=1 (or full
+    # unroll at num_steps=2); the r4 smoke run rejected unroll=4.
+    return jax.lax.fori_loop(0, n, lambda i, v: _sqr(v), x, unroll=1)
 
 
 def _pow_p58(z):

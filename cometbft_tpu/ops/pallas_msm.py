@@ -155,8 +155,8 @@ def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     pipeline keeps it VMEM-resident rather than re-fetching.
     """
     j = pl.program_id(1)
-    mag = mag_ref[0, :]
-    neg = neg_ref[0, :]
+    mag = mag_ref[0, 0, :]
+    neg = neg_ref[0, 0, :]
     d2 = d2_ref[:, :]
     sel = tab_ref[0]
     for k in range(1, 17):
@@ -201,14 +201,19 @@ def _msm_window_loop_jit(tab, mags, negs, interpret, blk):
         in_specs=[
             pl.BlockSpec((17, 4, fe.NLIMBS, blk),
                          lambda i, j: (0, 0, 0, i)),
-            pl.BlockSpec((1, blk), lambda i, j: (j, i)),
-            pl.BlockSpec((1, blk), lambda i, j: (j, i)),
+            # digits ride a (nwin, 1, W) layout so the BLOCK's last two
+            # dims are (1, blk) against ARRAY dims (1, W) — Mosaic
+            # requires the last two block dims divisible by (8, 128) or
+            # equal to the array's (a (1, blk) block on (nwin, W) was
+            # rejected in the r4 smoke run)
+            pl.BlockSpec((1, 1, blk), lambda i, j: (j, 0, i)),
+            pl.BlockSpec((1, 1, blk), lambda i, j: (j, 0, i)),
             pl.BlockSpec((fe.NLIMBS, 1), lambda i, j: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, OUT_PER_BLK),
                                lambda i, j: (i, 0, 0, 0)),
         interpret=interpret,
-    )(tab, mags, negs.astype(jnp.int32),
+    )(tab, mags.reshape(nwin, 1, w), negs.astype(jnp.int32).reshape(nwin, 1, w),
       jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
     return out.transpose(1, 2, 0, 3).reshape(
         4, fe.NLIMBS, nblk * OUT_PER_BLK)

@@ -17,7 +17,8 @@ def append_log(out_path: str, rec: dict) -> None:
 
 def already_done(out_path: str, key_fn) -> set:
     """Keys (via key_fn(record)) of every SUCCESSFUL record in
-    out_path; error records don't count so failed arms are retried."""
+    out_path; error records and start markers don't count so failed
+    arms are retried."""
     done = set()
     try:
         with open(out_path) as f:
@@ -26,8 +27,34 @@ def already_done(out_path: str, key_fn) -> set:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if "error" not in rec and "err" not in rec:
+                if "error" not in rec and "err" not in rec \
+                        and "start" not in rec:
                     done.add(key_fn(rec))
     except OSError:
         pass
     return done
+
+
+def wedged(out_path: str, key_fn, max_attempts: int = 2) -> set:
+    """Keys whose arm STARTED >= max_attempts times without ever
+    succeeding.  An arm that wedges in a native call dies with the
+    whole process (watch-loop timeout) and leaves no error record —
+    without this, resume re-runs it forever (the BENCH_live
+    light-client wedge).  Callers log {..., "start": True} before
+    each arm."""
+    starts: dict = {}
+    done = already_done(out_path, key_fn)
+    try:
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("start"):
+                    k = key_fn(rec)
+                    starts[k] = starts.get(k, 0) + 1
+    except OSError:
+        pass
+    return {k for k, n in starts.items()
+            if n >= max_attempts and k not in done}

@@ -26,7 +26,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, "/root/repo/scripts")
-from _capture_util import already_done, append_log  # noqa: E402
+from _capture_util import already_done, append_log, wedged  # noqa: E402
 
 OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/ab_round3.jsonl"
 
@@ -42,9 +42,12 @@ def _arm_key(rec: dict) -> tuple:
 
 
 def _already_done() -> set:
-    """Arms with a SUCCESSFUL record in OUT: a queue killed mid-way by
-    the watch-loop timeout resumes instead of re-paying every compile."""
-    return already_done(OUT, _arm_key)
+    """Arms with a SUCCESSFUL record in OUT — plus arms that STARTED
+    twice without finishing (a native-call wedge kills the process and
+    leaves no error record; retrying such an arm forever would eat
+    every capture window).  A queue killed mid-way by the watch-loop
+    timeout resumes instead of re-paying every compile."""
+    return already_done(OUT, _arm_key) | wedged(OUT, _arm_key)
 
 
 def _skip(done, name, **kv) -> bool:
@@ -74,6 +77,7 @@ def main():
     # 1+2: width scaling, fused vs cached
     for batch in (4095, 8191, 16383):
         if not _skip(done, "rlc_fused", batch=batch):
+            log("rlc_fused", batch=batch, start=True)
             try:
                 r = bench_rlc_width(batch)
                 log("rlc_fused", batch=batch, sigs_per_sec=round(r, 1),
@@ -81,6 +85,7 @@ def main():
             except Exception as e:
                 log("rlc_fused", batch=batch, error=repr(e)[:200])
         if not _skip(done, "rlc_cached", batch=batch):
+            log("rlc_cached", batch=batch, start=True)
             try:
                 r = bench_rlc_width(batch, use_cache=True)
                 log("rlc_cached", batch=batch, sigs_per_sec=round(r, 1),
@@ -105,6 +110,7 @@ def main():
         for batch in (4095, 8191):
             if _skip(done, "pallas_tree_ab", pallas=flag, batch=batch):
                 continue
+            log("pallas_tree_ab", pallas=flag, batch=batch, start=True)
             try:
                 r = bench_rlc_width(batch)
                 log("pallas_tree_ab", pallas=flag, batch=batch,
@@ -127,6 +133,8 @@ def main():
             if _skip(done, "pallas_msm_loop_ab", pallas=flag,
                      batch=batch):
                 continue
+            log("pallas_msm_loop_ab", pallas=flag, batch=batch,
+                start=True)
             try:
                 r = bench_rlc_width(batch)
                 log("pallas_msm_loop_ab", pallas=flag, batch=batch,
@@ -144,6 +152,7 @@ def main():
             continue
         dev.USE_PALLAS_DECOMPRESS = flag
         refresh_jits()
+        log("pallas_decompress_ab", pallas=flag, batch=4095, start=True)
         try:
             r = bench_rlc_width(4095)
             log("pallas_decompress_ab", pallas=flag, batch=4095,
@@ -158,6 +167,7 @@ def main():
     for commits in (24, 48, 96):
         if _skip(done, "light_headers", commits_per_dispatch=commits):
             continue
+        log("light_headers", commits_per_dispatch=commits, start=True)
         try:
             r = bench.bench_light_headers(150, 8, commits)
             log("light_headers", commits_per_dispatch=commits,
@@ -172,6 +182,7 @@ def main():
     for bpd in (3, 6):
         if _skip(done, "blocksync", blocks_per_dispatch=bpd):
             continue
+        log("blocksync", blocks_per_dispatch=bpd, start=True)
         try:
             r = bench.bench_blocksync(10_000, bpd, 4)
             log("blocksync", n_vals=10_000, blocks_per_dispatch=bpd,

@@ -30,7 +30,7 @@ OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mosaic_smoke.jsonl"
 
 
 ALL_PROBES = [(k, b) for k in ("decompress", "select_tree",
-                               "msm_window_loop") for b in (256, 512)]
+                               "msm_window_loop") for b in (128, 256, 512)]
 MAX_ATTEMPTS = 2      # error records per probe before it counts as
                       # settled (a kernel Mosaic rejects fails every
                       # time; the gate must not re-run it forever)
@@ -118,7 +118,7 @@ def main():
         _fe.eq(p[0], q[0]) & _fe.eq(p[1], q[1]) & _fe.eq(p[3], q[3])))
 
     # -- 1. pallas decompress vs XLA decompress --------------------------
-    for blk in (256, 512):
+    for blk in (128, 256, 512):
         if ("decompress", blk) in done:
             continue
         t0 = time.time()
@@ -133,11 +133,11 @@ def main():
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="decompress", blk=blk, ok=False,
-                err=repr(e)[:400], dt=round(time.time() - t0, 1))
+                err=repr(e)[:3000], dt=round(time.time() - t0, 1))
 
     # -- 2. select_tree + 3. window loop vs XLA MSM ----------------------
-    msm_probes = [("select_tree", b) for b in (256, 512)] + \
-                 [("msm_window_loop", b) for b in (256, 512)]
+    msm_probes = [("select_tree", b) for b in (128, 256, 512)] + \
+                 [("msm_window_loop", b) for b in (128, 256, 512)]
     if all(p in done for p in msm_probes):
         _finish()           # skip the table build + scan oracle
         return
@@ -147,7 +147,7 @@ def main():
     # XLA oracle: full R-side MSM accumulator
     acc_ref = np.asarray(scan_j(tab, r_mag, r_neg))
 
-    for blk in (256, 512):
+    for blk in (128, 256, 512):
         if ("select_tree", blk) in done:
             continue
         t0 = time.time()
@@ -160,9 +160,9 @@ def main():
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="select_tree", blk=blk, ok=False,
-                err=repr(e)[:400], dt=round(time.time() - t0, 1))
+                err=repr(e)[:3000], dt=round(time.time() - t0, 1))
 
-    for blk in (256, 512):
+    for blk in (128, 256, 512):
         if ("msm_window_loop", blk) in done:
             continue
         t0 = time.time()
@@ -174,7 +174,7 @@ def main():
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="msm_window_loop", blk=blk, ok=False,
-                err=repr(e)[:400], dt=round(time.time() - t0, 1))
+                err=repr(e)[:3000], dt=round(time.time() - t0, 1))
 
     _finish()
 
