@@ -401,7 +401,8 @@ def _msm_scan(tab, mags, negs):
     use_pallas = USE_PALLAS_TREE and w % _pallas_blk() == 0
     if use_pallas:
         from . import pallas_msm
-        npart = (w // pallas_msm.BLK) * pallas_msm.OUT_PER_BLK
+        npart = (w // pallas_msm.BLK) * pallas_msm._out_lanes(
+            pallas_msm.BLK)
 
         def window_contrib(mag, neg):
             return pallas_msm.select_tree(tab, mag, neg)

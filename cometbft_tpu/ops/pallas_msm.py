@@ -31,7 +31,20 @@ from jax.experimental import pallas as pl
 from . import fe
 
 BLK = 512            # lanes per program
-OUT_PER_BLK = 8      # partials each program writes
+# Partials each program writes (cap).  The in-kernel pairwise tree
+# stops at 128 lanes: every level below 128 needs sub-tile lane
+# slicing/relayouts (the prime Mosaic-ICE suspect in the r4 smoke
+# run's select_tree HTTP 500), and narrowing below one (8, 128) VPU
+# tile saves nothing — a (20, 8) accumulator pads to the same vregs
+# as (20, 128).  Stopping at 128 also shrinks the unrolled body from
+# 6 point_add levels to 2 at BLK=512.  The caller's XLA _tree_reduce
+# folds the wider partial tensor once per MSM (not per window).
+OUT_PER_BLK = 128
+
+
+def _out_lanes(blk: int) -> int:
+    """Lanes each program's partial occupies for a given block size."""
+    return min(blk, OUT_PER_BLK)
 
 
 # -- field ops on VALUES (not refs); shapes (20, n) ------------------------
@@ -117,7 +130,7 @@ def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     t = jnp.where(flip, -sel[3], sel[3])
     pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
     w = pts.shape[-1]
-    while w > OUT_PER_BLK:
+    while w > out_ref.shape[-1]:
         half = w // 2
         pts = _point_add(pts[..., :half], pts[..., half:w], d2)
         w = half
@@ -167,7 +180,7 @@ def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     t = jnp.where(flip, -sel[3], sel[3])
     pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
     w = pts.shape[-1]
-    while w > OUT_PER_BLK:
+    while w > out_ref.shape[-1]:
         half = w // 2
         pts = _point_add(pts[..., :half], pts[..., half:w], d2)
         w = half
@@ -193,10 +206,11 @@ def _msm_window_loop_jit(tab, mags, negs, interpret, blk):
     assert w % blk == 0, (w, blk)
     nblk = w // blk
     nwin = mags.shape[0]
+    out_l = _out_lanes(blk)
     out = pl.pallas_call(
         _window_loop_kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (nblk, 4, fe.NLIMBS, OUT_PER_BLK), jnp.int32),
+            (nblk, 4, fe.NLIMBS, out_l), jnp.int32),
         grid=(nblk, nwin),
         in_specs=[
             pl.BlockSpec((17, 4, fe.NLIMBS, blk),
@@ -210,13 +224,13 @@ def _msm_window_loop_jit(tab, mags, negs, interpret, blk):
             pl.BlockSpec((1, 1, blk), lambda i, j: (j, 0, i)),
             pl.BlockSpec((fe.NLIMBS, 1), lambda i, j: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, OUT_PER_BLK),
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, out_l),
                                lambda i, j: (i, 0, 0, 0)),
         interpret=interpret,
     )(tab, mags.reshape(nwin, 1, w), negs.astype(jnp.int32).reshape(nwin, 1, w),
       jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
     return out.transpose(1, 2, 0, 3).reshape(
-        4, fe.NLIMBS, nblk * OUT_PER_BLK)
+        4, fe.NLIMBS, nblk * out_l)
 
 
 def msm_window_loop(tab, mags, negs, interpret=False, blk=None):
@@ -235,10 +249,11 @@ def _select_tree_jit(tab, mag, neg, interpret, blk):
     assert w % blk == 0, (w, blk)
     nblk = w // blk
     grid = (nblk,)
+    out_l = _out_lanes(blk)
     out = pl.pallas_call(
         _select_tree_kernel,
         out_shape=jax.ShapeDtypeStruct(
-            (nblk, 4, fe.NLIMBS, OUT_PER_BLK), jnp.int32),
+            (nblk, 4, fe.NLIMBS, out_l), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((17, 4, fe.NLIMBS, blk),
@@ -247,13 +262,13 @@ def _select_tree_jit(tab, mag, neg, interpret, blk):
             pl.BlockSpec((1, blk), lambda i: (0, i)),
             pl.BlockSpec((fe.NLIMBS, 1), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, OUT_PER_BLK),
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, out_l),
                                lambda i: (i, 0, 0, 0)),
         interpret=interpret,
     )(tab, mag.reshape(1, -1), neg.astype(jnp.int32).reshape(1, -1),
       jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
     return out.transpose(1, 2, 0, 3).reshape(
-        4, fe.NLIMBS, nblk * OUT_PER_BLK)
+        4, fe.NLIMBS, nblk * out_l)
 
 
 def select_tree(tab, mag, neg, interpret=False, blk=None):

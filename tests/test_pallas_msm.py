@@ -87,7 +87,7 @@ def test_select_tree_multiblock():
     sel = dev._cond_neg_point(dev._select17(tab, mag), neg)
     want = dev._tree_reduce(sel, 1)
     got_part = pm.select_tree(tab, mag, neg, interpret=True, blk=8)
-    assert got_part.shape[-1] == 2 * pm.OUT_PER_BLK
+    assert got_part.shape[-1] == 2 * pm._out_lanes(8)
     got = dev._tree_reduce(jnp.asarray(got_part), 1)
     assert _pt_eq(want, got)
 
@@ -131,7 +131,7 @@ def test_msm_window_loop_multiblock():
 
     want = dev._msm_scan(tab, mags, negs)
     partials = pm.msm_window_loop(tab, mags, negs, interpret=True, blk=8)
-    assert partials.shape[-1] == 2 * pm.OUT_PER_BLK
+    assert partials.shape[-1] == 2 * pm._out_lanes(8)
     got = dev._tree_reduce(jnp.asarray(partials), 1)
     assert _pt_eq(want, got)
 
@@ -254,7 +254,7 @@ def test_msm_scan_dispatches_select_tree(monkeypatch):
 
     def spy(tab, mag, neg, interpret=False, blk=None):
         calls.append(tab.shape)
-        npart = (tab.shape[-1] // 8) * pmod.OUT_PER_BLK
+        npart = (tab.shape[-1] // 8) * pmod._out_lanes(8)
         contrib = dev._cond_neg_point(dev._select17(tab, mag), neg)
         return dev._tree_reduce(contrib, npart)
 
