@@ -74,8 +74,10 @@ def main():
     import bench
     from cometbft_tpu.ops import ed25519 as dev
 
-    # 1+2: width scaling, fused vs cached
-    for batch in (4095, 8191, 16383):
+    # 1+2: width scaling, fused vs cached (32767 added after the
+    # r4 capture: marginal cost 8k->16k measured ~235k sigs/s —
+    # the fixed dispatch cost still dominates at 16k)
+    for batch in (4095, 8191, 16383, 32767):
         if not _skip(done, "rlc_fused", batch=batch):
             log("rlc_fused", batch=batch, start=True)
             try:
@@ -97,6 +99,13 @@ def main():
     # jitted wrappers must be rebuilt per arm or the cached trace from
     # the other arm silently wins.
     def refresh_jits():
+        # A fresh jax.jit wrapper is NOT enough: the pjit executable
+        # cache is keyed on the underlying function, so the first r4
+        # queue run served every pallas=true arm the pallas=false
+        # executable (identical numbers, ~3 s/arm — no recompile).
+        # Nuke the trace/executable caches so flag flips take effect;
+        # the persistent compilation cache keeps recompiles cheap.
+        jax.clear_caches()
         dev._rlc_jitted = jax.jit(dev.rlc_verify_kernel)
         dev._rlc_cached_jitted = jax.jit(dev.rlc_verify_kernel_cached_a)
         dev._a_tables_jitted = jax.jit(dev._msm_tables)
@@ -164,7 +173,7 @@ def main():
 
     # 5: light-client depth (96 added round 4: the dispatch-latency
     # floor rewards deeper batching — docs/PERF.md round-4 capture)
-    for commits in (24, 48, 96):
+    for commits in (24, 48, 96, 192):
         if _skip(done, "light_headers", commits_per_dispatch=commits):
             continue
         log("light_headers", commits_per_dispatch=commits, start=True)
@@ -179,7 +188,7 @@ def main():
 
     # 6: blocksync at 10k validators, cached-A (consecutive blocks
     # share the valset — the cache's ideal case; VERDICT r3 item 5)
-    for bpd in (3, 6):
+    for bpd in (3, 6, 12):
         if _skip(done, "blocksync", blocks_per_dispatch=bpd):
             continue
         log("blocksync", blocks_per_dispatch=bpd, start=True)
