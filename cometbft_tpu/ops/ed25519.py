@@ -43,6 +43,23 @@ from ..crypto import ed25519_ref as ref
 
 _X, _Y, _Z, _T = 0, 1, 2, 3
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _pallas_capable() -> bool:
+    """True when the default backend lowers Pallas/Mosaic for real —
+    the TPU chip (incl. the axon relay, whose devices report a TPU
+    device_kind).  On cpu/gpu hosts (tests, the driver's virtual-mesh
+    dryrun, CPU-only light clients) the XLA path is the product path:
+    interpret-mode Pallas would be orders of magnitude slower."""
+    try:
+        d = jax.devices()[0]
+        return ("tpu" in getattr(d, "device_kind", "").lower()
+                or d.platform == "tpu")
+    except Exception:
+        return False
+
 
 def _pt(x, y, z, t):
     return jnp.stack([x, y, z, t], axis=0)
@@ -110,14 +127,16 @@ def point_is_identity(p):
 # decompression (ZIP-215: no canonical-y check)
 # ---------------------------------------------------------------------------
 
-# Fused Pallas decompress (ops/pallas_decompress.py); opt-in until
-# A/B-validated on hardware, like the select+tree kernel
+# Fused Pallas decompress (ops/pallas_decompress.py).  ON by default
+# since the round-4 hardware A/B: 56.1k vs 35.7k sigs/s at batch 4095
+# (ab_round4_results.jsonl pallas_decompress_ab), parity-checked on
+# real Mosaic at blk 128/256/512 (mosaic_smoke_r4.jsonl).
 USE_PALLAS_DECOMPRESS = os.environ.get(
-    "COMETBFT_TPU_PALLAS_DECOMPRESS", "0") == "1"
+    "COMETBFT_TPU_PALLAS_DECOMPRESS", "1") == "1"
 
 def decompress(enc_words: jnp.ndarray):
     """(8, ...) uint32 LE words of a 32-byte encoding -> (point, ok)."""
-    if USE_PALLAS_DECOMPRESS and enc_words.ndim == 2:
+    if USE_PALLAS_DECOMPRESS and _pallas_capable() and enc_words.ndim == 2:
         from . import pallas_decompress as pd
         if enc_words.shape[-1] % pd.BLK == 0:
             pt, ok = pd.decompress(enc_words)
@@ -291,9 +310,13 @@ USE_PALLAS_TREE = os.environ.get("COMETBFT_TPU_PALLAS_TREE", "0") == "1"
 # Whole-window-loop Pallas kernel (ops/pallas_msm.msm_window_loop):
 # the entire Straus scan — select, negate, tree, 5 shared doublings —
 # in ONE program with per-block accumulators.  Strictly supersedes
-# USE_PALLAS_TREE when on.
+# USE_PALLAS_TREE when on.  ON by default since the round-4 hardware
+# A/B: 156.1k vs 35.7k sigs/s at batch 4095, 177.5k vs 48.9k at 8191
+# (ab_round4_results.jsonl pallas_msm_loop_ab — the per-window XLA
+# dispatch overhead this kernel removes was ~4x the useful work),
+# parity-checked on real Mosaic at blk 128/256/512.
 USE_PALLAS_MSM_LOOP = os.environ.get(
-    "COMETBFT_TPU_PALLAS_MSM_LOOP", "0") == "1"
+    "COMETBFT_TPU_PALLAS_MSM_LOOP", "1") == "1"
 
 
 def _pallas_blk() -> int:
@@ -394,11 +417,12 @@ def _msm_scan(tab, mags, negs):
     <= NPART_MAX lane-resident partials.  Returns a (4, 20, 1) point.
     """
     w = tab.shape[-1]
-    if USE_PALLAS_MSM_LOOP and w % _pallas_blk() == 0:
+    if USE_PALLAS_MSM_LOOP and _pallas_capable() and w % _pallas_blk() == 0:
         from . import pallas_msm
         partials = pallas_msm.msm_window_loop(tab, mags, negs)
         return _tree_reduce(partials, 1)
-    use_pallas = USE_PALLAS_TREE and w % _pallas_blk() == 0
+    use_pallas = (USE_PALLAS_TREE and _pallas_capable()
+                  and w % _pallas_blk() == 0)
     if use_pallas:
         from . import pallas_msm
         npart = (w // pallas_msm.BLK) * pallas_msm._out_lanes(

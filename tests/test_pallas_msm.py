@@ -228,6 +228,7 @@ def test_rlc_dispatches_pallas_kernels(monkeypatch):
         finally:
             monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", True)
 
+    monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
     monkeypatch.setattr(pmod, "msm_window_loop", msm_spy)
     monkeypatch.setattr(pmod, "BLK", 8)
     monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
@@ -265,9 +266,11 @@ def test_msm_scan_dispatches_select_tree(monkeypatch):
     negs = jnp.asarray(rng.integers(0, 2, (nwin, W)) != 0)
     want = dev._msm_scan(tab, mags, negs)
 
+    monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
     monkeypatch.setattr(pmod, "select_tree", spy)
     monkeypatch.setattr(pmod, "BLK", 8)
     monkeypatch.setattr(dev, "USE_PALLAS_TREE", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", False)
     got = dev._msm_scan(tab, mags, negs)
     # the window body is TRACED once inside lax.scan and reused for
     # every window; one recorded call proves the routing
