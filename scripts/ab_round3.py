@@ -201,6 +201,57 @@ def main():
             log("blocksync", n_vals=10_000, blocks_per_dispatch=bpd,
                 error=repr(e)[:200])
 
+    # 7: product-defaults pass (round 4, after flipping the Pallas
+    # window-loop + fused decompress on): re-measure every workload
+    # under the SHIPPING configuration — distinct names so the
+    # XLA-era records above stay as the A/B contrast.  Depth arms
+    # extended (384-commit light, 24-block blocksync): every sweep so
+    # far rewarded deeper batching.
+    for batch in (8191, 16383, 32767):
+        if _skip(done, "prod_rlc_fused", batch=batch):
+            continue
+        log("prod_rlc_fused", batch=batch, start=True)
+        try:
+            r = bench_rlc_width(batch)
+            log("prod_rlc_fused", batch=batch, sigs_per_sec=round(r, 1),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("prod_rlc_fused", batch=batch, error=repr(e)[:200])
+    for batch in (8191, 16383, 32767):
+        if _skip(done, "prod_rlc_cached", batch=batch):
+            continue
+        log("prod_rlc_cached", batch=batch, start=True)
+        try:
+            r = bench_rlc_width(batch, use_cache=True)
+            log("prod_rlc_cached", batch=batch,
+                sigs_per_sec=round(r, 1), t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("prod_rlc_cached", batch=batch, error=repr(e)[:200])
+    for commits in (96, 192, 384):
+        if _skip(done, "prod_light", commits_per_dispatch=commits):
+            continue
+        log("prod_light", commits_per_dispatch=commits, start=True)
+        try:
+            r = bench.bench_light_headers(150, 8, commits)
+            log("prod_light", commits_per_dispatch=commits,
+                headers_per_sec=round(r, 1),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("prod_light", commits_per_dispatch=commits,
+                error=repr(e)[:200])
+    for bpd in (6, 12, 24):
+        if _skip(done, "prod_blocksync", blocks_per_dispatch=bpd):
+            continue
+        log("prod_blocksync", blocks_per_dispatch=bpd, start=True)
+        try:
+            r = bench.bench_blocksync(10_000, bpd, 4)
+            log("prod_blocksync", n_vals=10_000, blocks_per_dispatch=bpd,
+                blocks_per_sec=round(r, 2),
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("prod_blocksync", blocks_per_dispatch=bpd,
+                error=repr(e)[:200])
+
     log("done", t=round(time.time() - t0, 1))
 
 
