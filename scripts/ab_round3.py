@@ -74,6 +74,13 @@ def main():
     import bench
     from cometbft_tpu.ops import ed25519 as dev
 
+    # shipping defaults, restored after each A/B section (a bare
+    # `= False` here silently stripped the Pallas path from the whole
+    # product-defaults pass in the first r4 run of this section)
+    dflt_tree = dev.USE_PALLAS_TREE
+    dflt_loop = dev.USE_PALLAS_MSM_LOOP
+    dflt_dec = dev.USE_PALLAS_DECOMPRESS
+
     # 1+2: width scaling, fused vs cached (32767 added after the
     # r4 capture: marginal cost 8k->16k measured ~235k sigs/s —
     # the fixed dispatch cost still dominates at 16k)
@@ -128,7 +135,7 @@ def main():
             except Exception as e:
                 log("pallas_tree_ab", pallas=flag, batch=batch,
                     error=repr(e)[:200])
-    dev.USE_PALLAS_TREE = False
+    dev.USE_PALLAS_TREE = dflt_tree
     refresh_jits()
 
     # 3b: whole-window-loop kernel (supersedes the tree kernel)
@@ -152,7 +159,7 @@ def main():
             except Exception as e:
                 log("pallas_msm_loop_ab", pallas=flag, batch=batch,
                     error=repr(e)[:200])
-    dev.USE_PALLAS_MSM_LOOP = False
+    dev.USE_PALLAS_MSM_LOOP = dflt_loop
     refresh_jits()
 
     # 4: pallas decompress A/B
@@ -168,7 +175,7 @@ def main():
                 sigs_per_sec=round(r, 1), t=round(time.time() - t0, 1))
         except Exception as e:
             log("pallas_decompress_ab", pallas=flag, error=repr(e)[:200])
-    dev.USE_PALLAS_DECOMPRESS = False
+    dev.USE_PALLAS_DECOMPRESS = dflt_dec
     refresh_jits()
 
     # 5: light-client depth (96 added round 4: the dispatch-latency
