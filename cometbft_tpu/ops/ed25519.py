@@ -318,6 +318,13 @@ USE_PALLAS_TREE = os.environ.get("COMETBFT_TPU_PALLAS_TREE", "0") == "1"
 USE_PALLAS_MSM_LOOP = os.environ.get(
     "COMETBFT_TPU_PALLAS_MSM_LOOP", "1") == "1"
 
+# Fused 17-row table build (ops/pallas_msm.table17_neg): negation +
+# cached conversion + 15 sequential cached adds in one program.
+# Opt-in until hardware-validated (mosaic_smoke + ab queue), per the
+# same rollout the window-loop kernel followed.
+USE_PALLAS_TABLE = os.environ.get(
+    "COMETBFT_TPU_PALLAS_TABLE", "0") == "1"
+
 
 def _pallas_blk() -> int:
     from . import pallas_msm
@@ -406,6 +413,10 @@ def _msm_tables(enc_words):
     cached on device — the reference caches expanded pubkeys for the
     same reason (/root/reference/crypto/ed25519/ed25519.go:64)."""
     pt, ok = decompress(enc_words)
+    if (USE_PALLAS_TABLE and _pallas_capable()
+            and pt.shape[-1] % _pallas_blk() == 0):
+        from . import pallas_msm
+        return pallas_msm.table17_neg(pt), jnp.all(ok)
     return _table17(point_neg(pt)), jnp.all(ok)
 
 

@@ -275,3 +275,55 @@ def select_tree(tab, mag, neg, interpret=False, blk=None):
     """(17,4,20,W) table + (W,) digits -> (4,20,W//blk*OUT_PER_BLK)
     partial points, one fused Pallas program per blk lanes."""
     return _select_tree_jit(tab, mag, neg, interpret, blk or BLK)
+
+
+# -- fused 17-row table build ----------------------------------------------
+
+def _table17_neg_kernel(pt_ref, d2_ref, out_ref):
+    """(4, 20, BLK) extended P -> (17, 4, 20, BLK) rows k*(-P),
+    k=0..16 (the MSM consumes negated tables: ops/ed25519._msm_tables).
+    Fuses the negation, the cached-form conversion, and the 15
+    sequential cached adds that otherwise run as an XLA scan of ~20
+    dispatched fusions per step — the same per-op fixed-cost tax the
+    window-loop kernel removes from the scan side."""
+    p = pt_ref[...]
+    d2 = d2_ref[:, :]
+    p = jnp.stack([fe.neg(p[0]), p[1], p[2], fe.neg(p[3])], axis=0)
+    one = (jax.lax.broadcasted_iota(jnp.int32, p.shape[1:], 0)
+           == 0).astype(jnp.int32)
+    zero = jnp.zeros_like(one)
+    ident = jnp.stack([zero, one, one, zero], axis=0)
+    rows = [ident, p]
+    pc = _to_cached(p, d2)
+    cur = p
+    for _ in range(15):
+        cur = _add_cached(cur, pc)
+        rows.append(cur)
+    out_ref[...] = jnp.stack(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _table17_neg_jit(pt, interpret, blk):
+    w = pt.shape[-1]
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
+    out = pl.pallas_call(
+        _table17_neg_kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (17, 4, fe.NLIMBS, w), jnp.int32),
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((4, fe.NLIMBS, blk), lambda i: (0, 0, i)),
+            pl.BlockSpec((fe.NLIMBS, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((17, 4, fe.NLIMBS, blk),
+                               lambda i: (0, 0, 0, i)),
+        interpret=interpret,
+    )(pt, jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
+    return out
+
+
+def table17_neg(pt, interpret=False, blk=None):
+    """(4,20,W) extended points -> (17,4,20,W) negated window tables,
+    one fused Pallas program per blk lanes."""
+    return _table17_neg_jit(pt, interpret, blk or BLK)

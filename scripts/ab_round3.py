@@ -80,6 +80,7 @@ def main():
     dflt_tree = dev.USE_PALLAS_TREE
     dflt_loop = dev.USE_PALLAS_MSM_LOOP
     dflt_dec = dev.USE_PALLAS_DECOMPRESS
+    dflt_table = dev.USE_PALLAS_TABLE
 
     # 1+2: width scaling, fused vs cached (32767 added after the
     # r4 capture: marginal cost 8k->16k measured ~235k sigs/s —
@@ -176,6 +177,23 @@ def main():
         except Exception as e:
             log("pallas_decompress_ab", pallas=flag, error=repr(e)[:200])
     dev.USE_PALLAS_DECOMPRESS = dflt_dec
+    refresh_jits()
+
+    # 4b: pallas table-build A/B (round 4: the table build is the
+    # residual XLA chunk after the window-loop + decompress flip)
+    for flag in (True, False):
+        if _skip(done, "pallas_table_ab", pallas=flag, batch=16383):
+            continue
+        dev.USE_PALLAS_TABLE = flag
+        refresh_jits()
+        log("pallas_table_ab", pallas=flag, batch=16383, start=True)
+        try:
+            r = bench_rlc_width(16383)
+            log("pallas_table_ab", pallas=flag, batch=16383,
+                sigs_per_sec=round(r, 1), t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("pallas_table_ab", pallas=flag, error=repr(e)[:200])
+    dev.USE_PALLAS_TABLE = dflt_table
     refresh_jits()
 
     # 5: light-client depth (96 added round 4: the dispatch-latency

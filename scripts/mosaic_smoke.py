@@ -30,7 +30,8 @@ OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mosaic_smoke.jsonl"
 
 
 ALL_PROBES = [(k, b) for k in ("decompress", "select_tree",
-                               "msm_window_loop") for b in (128, 256, 512)]
+                               "msm_window_loop", "table17_neg")
+              for b in (128, 256, 512)]
 MAX_ATTEMPTS = 2      # error records per probe before it counts as
                       # settled (a kernel Mosaic rejects fails every
                       # time; the gate must not re-run it forever)
@@ -133,6 +134,26 @@ def main():
                 dt=round(time.time() - t0, 1))
         except Exception as e:
             log(kernel="decompress", blk=blk, ok=False,
+                err=repr(e)[:3000], dt=round(time.time() - t0, 1))
+
+    # -- 1b. fused table build vs XLA table build ------------------------
+    tab_eq_j = jax.jit(lambda a, b: jnp.all(
+        _fe.freeze(a.transpose(2, 0, 1, 3))
+        == _fe.freeze(b.transpose(2, 0, 1, 3))))
+    for blk in (128, 256, 512):
+        if ("table17_neg", blk) in done:
+            continue
+        t0 = time.time()
+        try:
+            pt_x, _ok = dec_j(r_words)
+            want_tab = jax.jit(lambda p: dev._table17(dev.point_neg(p)))(
+                pt_x)
+            got_tab = pm.table17_neg(pt_x, blk=blk)
+            log(kernel="table17_neg", blk=blk, ok=True,
+                match=bool(np.asarray(tab_eq_j(got_tab, want_tab))),
+                dt=round(time.time() - t0, 1))
+        except Exception as e:
+            log(kernel="table17_neg", blk=blk, ok=False,
                 err=repr(e)[:3000], dt=round(time.time() - t0, 1))
 
     # -- 2. select_tree + 3. window loop vs XLA MSM ----------------------

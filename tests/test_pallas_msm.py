@@ -276,3 +276,43 @@ def test_msm_scan_dispatches_select_tree(monkeypatch):
     # every window; one recorded call proves the routing
     assert calls == [(17, 4, 20, W)]
     assert _pt_eq(want, got)
+
+
+def test_pallas_table17_neg_matches_xla():
+    """Fused table-build kernel vs _table17(point_neg(p)): every row
+    k*(-P) for k=0..16, both blocks of a 2-block grid."""
+    w = 16
+    pts = _points(w)
+    want = dev._table17(dev.point_neg(pts))
+    got = pm.table17_neg(pts, interpret=True, blk=8)
+    assert got.shape == want.shape
+    for k in range(17):
+        for lane in (0, 7, 8, 15):
+            assert _pt_eq(
+                jnp.asarray(np.asarray(got)[k][..., lane:lane + 1]),
+                jnp.asarray(np.asarray(want)[k][..., lane:lane + 1])), (
+                k, lane)
+
+
+def test_msm_tables_dispatches_pallas_table(monkeypatch):
+    """USE_PALLAS_TABLE routes _msm_tables through table17_neg."""
+    import cometbft_tpu.ops.pallas_msm as pmod
+
+    calls = []
+
+    def spy(pt, interpret=False, blk=None):
+        calls.append(pt.shape)
+        return dev._table17(dev.point_neg(pt))
+
+    monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
+    monkeypatch.setattr(pmod, "table17_neg", spy)
+    monkeypatch.setattr(pmod, "BLK", 8)
+    monkeypatch.setattr(dev, "USE_PALLAS_TABLE", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", False)
+
+    pks, _, _ = _sign_batch(8)
+    words = np.stack([np.frombuffer(pk, dtype="<u4") for pk in pks],
+                     axis=1)                        # (8, 8) LE words
+    tab, ok = dev._msm_tables(jnp.asarray(words))
+    assert calls and calls[0] == (4, 20, 8)
+    assert bool(ok)
