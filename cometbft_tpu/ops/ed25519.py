@@ -319,11 +319,13 @@ USE_PALLAS_MSM_LOOP = os.environ.get(
     "COMETBFT_TPU_PALLAS_MSM_LOOP", "1") == "1"
 
 # Fused 17-row table build (ops/pallas_msm.table17_neg): negation +
-# cached conversion + 15 sequential cached adds in one program.
-# Opt-in until hardware-validated (mosaic_smoke + ab queue), per the
-# same rollout the window-loop kernel followed.
+# cached conversion + 15 sequential cached adds in one program.  ON by
+# default since the round-4 hardware A/B: 278.8k vs 238.0k sigs/s at
+# batch 16383 with the other kernels already on (+17%,
+# ab_round4_results.jsonl pallas_table_ab), parity-checked on real
+# Mosaic at blk 128/256/512 (mosaic_smoke_r4.jsonl).
 USE_PALLAS_TABLE = os.environ.get(
-    "COMETBFT_TPU_PALLAS_TABLE", "0") == "1"
+    "COMETBFT_TPU_PALLAS_TABLE", "1") == "1"
 
 
 def _pallas_blk() -> int:

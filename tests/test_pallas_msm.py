@@ -228,10 +228,18 @@ def test_rlc_dispatches_pallas_kernels(monkeypatch):
         finally:
             monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", True)
 
+    tab_calls = []
+
+    def tab_spy(pt, interpret=False, blk=None):
+        tab_calls.append(pt.shape)
+        return dev._table17(dev.point_neg(pt))
+
     monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
     monkeypatch.setattr(pmod, "msm_window_loop", msm_spy)
+    monkeypatch.setattr(pmod, "table17_neg", tab_spy)
     monkeypatch.setattr(pmod, "BLK", 8)
     monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_TABLE", True)
     monkeypatch.setattr(pdmod, "decompress", dec_spy)
     monkeypatch.setattr(pdmod, "BLK", 8)
     monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", True)
@@ -242,6 +250,7 @@ def test_rlc_dispatches_pallas_kernels(monkeypatch):
     assert ((17, 4, 20, 16), (52, 16)) in msm_calls
     assert ((17, 4, 20, 8), (26, 8)) in msm_calls
     assert (8, 16) in dec_calls and (8, 8) in dec_calls
+    assert (4, 20, 16) in tab_calls and (4, 20, 8) in tab_calls
 
 
 def test_msm_scan_dispatches_select_tree(monkeypatch):
