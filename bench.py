@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import threading
 import time
 
@@ -251,7 +252,35 @@ def _alarm_handler(signum, frame):
     raise _ExtraTimeout()
 
 
+def _acquire_tpu_lock():
+    """Serialize against the continuous-capture watch loop
+    (scripts/relay_watch.sh): axon discipline is ONE TPU process at a
+    time, and a driver-invoked bench racing a mid-capture loop wedges
+    BOTH.  The loop already holds /tmp/tpu.lock around its own bench
+    runs and sets COMETBFT_TPU_HAVE_LOCK=1 (taking it again here
+    would deadlock against our own parent).  Returns the held fd, or
+    None.  On timeout we proceed anyway — a bounded-risk attempt
+    beats certain failure."""
+    if os.environ.get("COMETBFT_TPU_HAVE_LOCK") == "1":
+        return None
+    import fcntl
+    deadline = time.perf_counter() + float(
+        os.environ.get("BENCH_LOCK_TIMEOUT", "1800"))
+    fd = open("/tmp/tpu.lock", "w")
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fd
+        except OSError:
+            if time.perf_counter() > deadline:
+                print("warning: TPU lock busy past timeout; "
+                      "proceeding unlocked", file=sys.stderr)
+                return None
+            time.sleep(5)
+
+
 def main() -> None:
+    _acquire_tpu_lock()
     # 16383 after the round-4 width sweep (ab_round4_results.jsonl):
     # the relay's fixed per-dispatch cost dominates narrow batches —
     # 4095 measured 35.1k sigs/s where 16383 measured 81.1k on the

@@ -77,7 +77,8 @@ while true; do
         last=$(cat "$STAMP" 2>/dev/null || echo 0)
         if [ $((now - last)) -ge 5400 ]; then
             log "running bench.py -> $BENCH_OUT"
-            flock "$LOCK" timeout 3600 python bench.py \
+            COMETBFT_TPU_HAVE_LOCK=1 \
+                flock "$LOCK" timeout 3600 python bench.py \
                 >"$BENCH_OUT.tmp" 2>>"$LOG"
             rc=$?
             log "bench rc=$rc"
