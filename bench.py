@@ -284,9 +284,11 @@ def main() -> None:
     # 16383 after the round-4 width sweep (ab_round4_results.jsonl):
     # the relay's fixed per-dispatch cost dominates narrow batches —
     # 4095 measured 35.1k sigs/s where 16383 measured 81.1k on the
-    # same kernel; commit verification feeds widths like this via
-    # cross-commit deferred batching (types/validation.py)
-    batch = int(os.environ.get("BENCH_BATCH", "16383"))
+    # same kernel (32767 re-measured best once the Pallas stack
+    # landed: 292.8k vs 278.7k, prod_rlc_fused arms); commit
+    # verification feeds widths like this via cross-commit deferred
+    # batching (types/validation.py)
+    batch = int(os.environ.get("BENCH_BATCH", "32767"))
     iters = int(os.environ.get("BENCH_ITERS", "8"))
     try:                         # a stale partial from a previous round
         os.unlink(PARTIAL_PATH)  # must never masquerade as this one's
@@ -414,10 +416,11 @@ def main() -> None:
               " higher still but its cold compile risks the extra"
               " timeout)")
     run_extra("blocksync_blocks_per_sec",
-              lambda: round(bench_blocksync(10_000, 12, 4), 2),
+              lambda: round(bench_blocksync(10_000, 24, 4), 2),
               "blocksync_config",
-              "10k validators, 6667+1 sigs/commit, 12 blocks/dispatch"
-              " (depth sweep peak; 24 rolls off)")
+              "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch"
+              " (monotone through 24 once the Pallas table build"
+              " landed: 89.8/98.4/118.7 at 6/12/24)")
     run_extra("secp256k1_sigs_per_sec",
               lambda: round(bench_secp(1024, 6), 1))
 
