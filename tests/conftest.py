@@ -41,7 +41,15 @@ def _module_memory_hygiene(request):
     accumulates every compiled kernel otherwise (15+ GB by the tail of
     the suite, enough to destabilize late compiles), and the
     persistent compile cache makes re-tracing cheap.  Set
-    COMETBFT_TPU_RSS_LOG=<path> to record per-module peak RSS."""
+    COMETBFT_TPU_RSS_LOG=<path> to record per-module peak RSS.
+
+    Measured footprint (r4): steady-state ~0.6 GB between modules; the
+    peak is transient XLA-CPU *compile* memory — each RLC-kernel
+    compile allocates 2-5 GB regardless of lane width (78-window scan
+    graph), so test_ed25519 peaks ~8 GB and test_pallas_msm ~9.6 GB
+    when several shapes compile in one file.  Per-TEST clearing would
+    cap this but forces minutes of recompiles per file; the full-suite
+    peak is bounded by the heaviest single file, not suite length."""
     yield
     jax.clear_caches()
     try:
