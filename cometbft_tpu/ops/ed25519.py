@@ -138,8 +138,10 @@ def decompress(enc_words: jnp.ndarray):
     """(8, ...) uint32 LE words of a 32-byte encoding -> (point, ok)."""
     if USE_PALLAS_DECOMPRESS and _pallas_capable() and enc_words.ndim == 2:
         from . import pallas_decompress as pd
-        if enc_words.shape[-1] % pd.BLK == 0:
-            pt, ok = pd.decompress(enc_words)
+        from . import pallas_msm
+        blk = pallas_msm.blk_for(enc_words.shape[-1], cap=pd.BLK)
+        if blk is not None:
+            pt, ok = pd.decompress(enc_words, blk=blk)
             return pt, ok
     y = fe.words32_to_limbs(enc_words)
     sign = ((enc_words[7] >> 31) & jnp.uint32(1)).astype(jnp.int32)
@@ -327,10 +329,25 @@ USE_PALLAS_MSM_LOOP = os.environ.get(
 USE_PALLAS_TABLE = os.environ.get(
     "COMETBFT_TPU_PALLAS_TABLE", "1") == "1"
 
+# Fused fold/verify epilogue (ops/pallas_msm.fold_verify): the
+# partial-tensor tree reduction + combine + cofactor + identity check
+# in one program.  ON by default since the round-4b hardware A/B:
+# 363.2k vs 293.5k sigs/s at batch 16383 (+23.7%,
+# ab_round4b_results.jsonl pallas_fold_ab) — the ~24 narrow XLA
+# point_add levels it replaces were the largest post-window-loop
+# dispatch-overhead tax; accept/reject parity on real Mosaic in
+# mosaic_smoke4b.jsonl.
+USE_PALLAS_FOLD = os.environ.get(
+    "COMETBFT_TPU_PALLAS_FOLD", "1") == "1"
 
-def _pallas_blk() -> int:
-    from . import pallas_msm
-    return pallas_msm.BLK
+# Window-major whole-MSM kernel (ops/pallas_msm.msm_window_major):
+# blocks iterate INSIDE each window so the 5 shared doublings run once
+# per window on one global accumulator instead of once per block —
+# the largest line item of the r4 latency decomposition.  Supersedes
+# USE_PALLAS_MSM_LOOP when on; opt-in until A/B'd on hardware.
+USE_PALLAS_MSM_MAJOR = os.environ.get(
+    "COMETBFT_TPU_PALLAS_MSM_MAJOR", "0") == "1"
+
 
 _SMALL_WIDTHS = (8, 16, 32, 64, 96, 128, 160, 192)
 _BASE_WIDTHS = (128, 160, 192)
@@ -415,10 +432,11 @@ def _msm_tables(enc_words):
     cached on device — the reference caches expanded pubkeys for the
     same reason (/root/reference/crypto/ed25519/ed25519.go:64)."""
     pt, ok = decompress(enc_words)
-    if (USE_PALLAS_TABLE and _pallas_capable()
-            and pt.shape[-1] % _pallas_blk() == 0):
+    if USE_PALLAS_TABLE and _pallas_capable():
         from . import pallas_msm
-        return pallas_msm.table17_neg(pt), jnp.all(ok)
+        blk = pallas_msm.blk_for(pt.shape[-1])
+        if blk is not None:
+            return pallas_msm.table17_neg(pt, blk=blk), jnp.all(ok)
     return _table17(point_neg(pt)), jnp.all(ok)
 
 
@@ -430,19 +448,29 @@ def _msm_scan(tab, mags, negs):
     <= NPART_MAX lane-resident partials.  Returns a (4, 20, 1) point.
     """
     w = tab.shape[-1]
-    if USE_PALLAS_MSM_LOOP and _pallas_capable() and w % _pallas_blk() == 0:
+    if USE_PALLAS_MSM_MAJOR and _pallas_capable():
         from . import pallas_msm
-        partials = pallas_msm.msm_window_loop(tab, mags, negs)
-        return _tree_reduce(partials, 1)
-    use_pallas = (USE_PALLAS_TREE and _pallas_capable()
-                  and w % _pallas_blk() == 0)
+        blk = pallas_msm.blk_for(w)
+        if blk is not None:
+            partials = pallas_msm.msm_window_major(tab, mags, negs,
+                                                   blk=blk)
+            return _tree_reduce(partials, 1)
+    if USE_PALLAS_MSM_LOOP and _pallas_capable():
+        from . import pallas_msm
+        blk = pallas_msm.blk_for(w)
+        if blk is not None:
+            partials = pallas_msm.msm_window_loop(tab, mags, negs, blk=blk)
+            return _tree_reduce(partials, 1)
+    use_pallas = False
+    if USE_PALLAS_TREE and _pallas_capable():
+        from . import pallas_msm
+        tree_blk = pallas_msm.blk_for(w)
+        use_pallas = tree_blk is not None
     if use_pallas:
-        from . import pallas_msm
-        npart = (w // pallas_msm.BLK) * pallas_msm._out_lanes(
-            pallas_msm.BLK)
+        npart = (w // tree_blk) * pallas_msm._out_lanes(tree_blk)
 
         def window_contrib(mag, neg):
-            return pallas_msm.select_tree(tab, mag, neg)
+            return pallas_msm.select_tree(tab, mag, neg, blk=tree_blk)
     else:
         npart = _npart(w)
 
@@ -478,6 +506,38 @@ def _msm(enc_words, mags, negs):
     return _msm_scan(tab, mags, negs), ok
 
 
+def _loop_partials(tab, mags, negs):
+    """Window-loop/window-major partial tensor for one MSM side if a
+    Pallas path applies (width divisible by a legal block), else None."""
+    if not ((USE_PALLAS_MSM_LOOP or USE_PALLAS_MSM_MAJOR)
+            and _pallas_capable()):
+        return None
+    from . import pallas_msm
+    blk = pallas_msm.blk_for(tab.shape[-1])
+    if blk is None:
+        return None
+    if USE_PALLAS_MSM_MAJOR:
+        return pallas_msm.msm_window_major(tab, mags, negs, blk=blk)
+    return pallas_msm.msm_window_loop(tab, mags, negs, blk=blk)
+
+
+def _prefold(partials):
+    """XLA halving of a partial tensor down to the fold kernel's VMEM
+    bound — only the wide (efficient) levels run here; alignment holds
+    because widths are m*128 with m even whenever w > MAX_FOLD_LANES."""
+    from . import pallas_msm
+    while partials.shape[-1] > pallas_msm.MAX_FOLD_LANES:
+        half = partials.shape[-1] // 2
+        assert half % 128 == 0, partials.shape
+        partials = point_add(partials[..., :half], partials[..., half:])
+    return partials
+
+
+def _fold_verdict(pa, pr):
+    from . import pallas_msm
+    return pallas_msm.fold_verify(_prefold(pa), _prefold(pr))
+
+
 def rlc_verify_kernel(a_words, r_words, a_mag, a_neg, r_mag, r_neg):
     """Whole-batch RLC verify: one bool verdict.
 
@@ -487,8 +547,15 @@ def rlc_verify_kernel(a_words, r_words, a_mag, a_neg, r_mag, r_neg):
     a_mag/a_neg: (52, K) signed-window digits of the aggregated z*h
     mod L; r_mag/r_neg: (26, N) digits of the 128-bit z_i; MSB-first.
     """
-    acc_a, ok_a = _msm(a_words, a_mag, a_neg)   # 52 windows, width K
-    acc_r, ok_r = _msm(r_words, r_mag, r_neg)   # 26 windows, width N
+    tab_a, ok_a = _msm_tables(a_words)
+    tab_r, ok_r = _msm_tables(r_words)
+    if USE_PALLAS_FOLD:
+        pa = _loop_partials(tab_a, a_mag, a_neg)
+        pr = _loop_partials(tab_r, r_mag, r_neg)
+        if pa is not None and pr is not None:
+            return ok_a & ok_r & _fold_verdict(pa, pr)
+    acc_a = _msm_scan(tab_a, a_mag, a_neg)      # 52 windows, width K
+    acc_r = _msm_scan(tab_r, r_mag, r_neg)      # 26 windows, width N
     total = point_add(acc_a, acc_r)
     for _ in range(3):               # cofactor 8
         total = point_double(total, with_t=False)
@@ -509,8 +576,13 @@ def rlc_verify_kernel_cached_a(a_tab, a_ok, r_words,
     key — the measured per-point floor) and the 16 sequential table
     adds, the dominant A-side cost when the same validator set verifies
     a stream of commits (light-client sync, blocksync replay)."""
-    acc_a = _msm_scan(a_tab, a_mag, a_neg)
     r_tab, ok_r = _msm_tables(r_words)
+    if USE_PALLAS_FOLD:
+        pa = _loop_partials(a_tab, a_mag, a_neg)
+        pr = _loop_partials(r_tab, r_mag, r_neg)
+        if pa is not None and pr is not None:
+            return a_ok & ok_r & _fold_verdict(pa, pr)
+    acc_a = _msm_scan(a_tab, a_mag, a_neg)
     acc_r = _msm_scan(r_tab, r_mag, r_neg)
     total = point_add(acc_a, acc_r)
     for _ in range(3):               # cofactor 8

@@ -27,6 +27,8 @@ an original TPU design, not a translation.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -133,20 +135,15 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(-a)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """20 shifted multiply-accumulates -> (39, B) columns -> carry ->
-    608-fold -> two carry passes.  Inputs: |limb| <= 10300.
+def _prod_tail(acc: jnp.ndarray, batch: tuple) -> jnp.ndarray:
+    """(39, B) product columns -> weak-form (20, B): carry pass in
+    40-wide column space (no wrap: col 39 catches it), then the
+    2**260 == 608 fold, then two carry passes.
 
-    Column bound: 20 * 10300**2 = 2.12e9 < 2**31.  After the first
-    column-space carry pass, columns are < 2**13 + 2.12e9/2**13 ~ 267k;
-    folding multiplies the high half by 608: <= 608*267k ~ 1.63e8 < 2**31.
-    Two more passes land in weak form.
-    """
-    batch = a.shape[1:]
-    acc = jnp.zeros((2 * NLIMBS - 1,) + batch, dtype=jnp.int32)
-    for i in range(NLIMBS):
-        acc = acc.at[i:i + NLIMBS].add(a[i] * b)
-    # carry pass in 40-wide column space (no wrap: col 39 catches it)
+    Bound: columns <= 20 * 10300**2 = 2.12e9 < 2**31 on entry.  After
+    the column-space carry pass, columns are < 2**13 + 2.12e9/2**13 ~
+    267k; folding multiplies the high half by 608: <= 608*267k ~
+    1.63e8 < 2**31.  Two more passes land in weak form."""
     acc = jnp.concatenate([acc, jnp.zeros((1,) + batch, jnp.int32)], axis=0)
     hi = acc >> RADIX
     lo = acc - (hi << RADIX)
@@ -157,8 +154,40 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return norm_weak(out)
 
 
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """20 shifted multiply-accumulates -> (39, B) columns -> _prod_tail.
+    Inputs: |limb| <= 10300 (column bound proof in _prod_tail)."""
+    batch = a.shape[1:]
+    acc = jnp.zeros((2 * NLIMBS - 1,) + batch, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        acc = acc.at[i:i + NLIMBS].add(a[i] * b)
+    return _prod_tail(acc, batch)
+
+
+# Dedicated squaring: ~210 int32 multiplies vs mul's 400.  Flag is for
+# on-hardware A/B attribution only (scripts/ab_round4b.py).
+FAST_SQR = os.environ.get("COMETBFT_TPU_FAST_SQR", "1") == "1"
+
+
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """a**2 with the doubled-cross-terms schoolbook: the i<j products
+    appear once against 2*a_i, the diagonal once — 190 + 20 = 210
+    multiplies vs mul(a, a)'s 400, on the exact same column VALUES, so
+    _prod_tail's bound proof carries over unchanged.  Per-term bound:
+    |2a_i * a_j| <= 20600 * 10300 = 2.13e8 < 2**31.
+
+    Dominates the decompression sqrt chains (~253 squarings each,
+    docs/PERF.md) and point_double (4S of 4M+4S)."""
+    if not FAST_SQR:
+        return mul(a, a)
+    batch = a.shape[1:]
+    a2 = a + a
+    acc = jnp.zeros((2 * NLIMBS - 1,) + batch, dtype=jnp.int32)
+    for i in range(NLIMBS):
+        acc = acc.at[2 * i].add(a[i] * a[i])
+        if i + 1 < NLIMBS:
+            acc = acc.at[2 * i + 1: i + NLIMBS].add(a2[i] * a[i + 1:])
+    return _prod_tail(acc, batch)
 
 
 def mul_word(a: jnp.ndarray, w: int) -> jnp.ndarray:

@@ -28,13 +28,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from . import fe
-from .pallas_msm import _mul, _norm_weak
+from .pallas_msm import (_carry, _eq, _freeze, _mul, _norm_weak,
+                         _seq_canonical, _sq as _sqr)
 
 BLK = 512            # lanes per program
-
-
-def _sqr(a):
-    return _mul(a, a)
 
 
 def _sq_n(x, n: int):
@@ -59,13 +56,6 @@ def _pow_p58(z):
     return _mul(_sq_n(z2_250_0, 2), z)
 
 
-def _carry(x):
-    hi = x >> fe.RADIX
-    lo = x - (hi << fe.RADIX)
-    wrapped = jnp.concatenate(
-        [hi[-1:] * jnp.int32(fe.WRAP), hi[:-1]], axis=0)
-    return lo + wrapped
-
 
 def _add(a, b):
     return _carry(a + b)
@@ -79,47 +69,7 @@ def _neg(a):
     return _carry(-a)
 
 
-def _seq_canonical(x):
-    """fe._seq_canonical_pass without .at[] (static stacking only)."""
-    c = jnp.zeros(x.shape[1:], dtype=jnp.int32)
-    outs = []
-    for i in range(fe.NLIMBS):
-        v = x[i] + c
-        lo = v & jnp.int32(fe.MASK)
-        outs.append(lo)
-        c = (v - lo) >> fe.RADIX
-    top = outs[-1] >> jnp.int32(8)
-    outs[-1] = outs[-1] & jnp.int32(0xFF)
-    outs[0] = outs[0] + top * jnp.int32(19) + c * jnp.int32(fe.WRAP)
-    return jnp.stack(outs, axis=0)
 
-
-def _freeze(x, pad_8p, p_canon):
-    """Canonical digits in [0, p) (fe.freeze with passed constants)."""
-    x = _norm_weak(x) + pad_8p
-    for _ in range(3):
-        x = _seq_canonical(x)
-    gt = jnp.zeros(x.shape[1:], dtype=bool)
-    eq_ = jnp.ones(x.shape[1:], dtype=bool)
-    for i in range(fe.NLIMBS - 1, -1, -1):
-        gt = gt | (eq_ & (x[i] > p_canon[i]))
-        eq_ = eq_ & (x[i] == p_canon[i])
-    take = (gt | eq_)[None]
-    diff = x - p_canon
-    c = jnp.zeros(diff.shape[1:], dtype=jnp.int32)
-    outs = []
-    for i in range(fe.NLIMBS):
-        v = diff[i] + c
-        lo = v & jnp.int32(fe.MASK)
-        outs.append(lo)
-        c = (v - lo) >> fe.RADIX
-    sub = jnp.stack(outs, axis=0)
-    return jnp.where(take, sub, x)
-
-
-def _eq(a, b, pad_8p, p_canon):
-    return jnp.all(_freeze(a, pad_8p, p_canon)
-                   == _freeze(b, pad_8p, p_canon), axis=0)
 
 
 # consts tensor rows (passed as one (5, 20, 1) ref)

@@ -23,6 +23,7 @@ crossings, matching the VPU layout the XLA kernels use.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +31,26 @@ from jax.experimental import pallas as pl
 
 from . import fe
 
-BLK = 512            # lanes per program
+# Lanes per program.  512 was the round-4 shipping default; larger
+# blocks amortize the per-window shared doublings over more lanes
+# (doubling cost scales with OUT_PER_BLK * nblk = OUT_PER_BLK * W/BLK)
+# at the price of a bigger VMEM-resident table block (17*4*20*BLK*4 B:
+# 2.8 MB at 512, 5.6 MB at 1024) — A/B'd in scripts/ab_round4b.py.
+BLK = int(os.environ.get("COMETBFT_TPU_PALLAS_BLK", "512"))
+
+
+def blk_for(w: int, cap: int | None = None):
+    """Largest block size from min(BLK, cap) halving down to 128 that
+    divides width w, or None (caller falls back to the XLA path).
+    The 128 floor is Mosaic's lane-tile width; tests that shrink BLK
+    below it keep their narrow block as the floor."""
+    b = min(BLK, cap) if cap else BLK
+    floor = min(128, b)
+    while b >= floor:
+        if w % b == 0:
+            return b
+        b //= 2
+    return None
 # Partials each program writes (cap).  The in-kernel pairwise tree
 # stops at 128 lanes: every level below 128 needs sub-tile lane
 # slicing/relayouts (the prime Mosaic-ICE suspect in the r4 smoke
@@ -57,6 +77,19 @@ _add = fe.add
 _sub = fe.sub
 
 
+def _prod_tail(cols):
+    """Product-column list (39 entries) -> weak-form limbs; the Mosaic
+    mirror of fe._prod_tail (same bound proof)."""
+    cols = cols + [jnp.zeros_like(cols[0])]
+    acc = jnp.stack(cols, axis=0)                    # (40, n)
+    hi_ = acc >> fe.RADIX
+    lo_ = acc - (hi_ << fe.RADIX)
+    acc = lo_ + jnp.concatenate(
+        [jnp.zeros_like(hi_[:1]), hi_[:-1]], axis=0)
+    out = acc[:fe.NLIMBS] + jnp.int32(fe.WRAP) * acc[fe.NLIMBS:]
+    return _norm_weak(out)
+
+
 def _mul(a, b):
     """Column-sum schoolbook product (no dynamic-update-slices: Mosaic
     wants static slicing)."""
@@ -69,18 +102,85 @@ def _mul(a, b):
         for i in range(lo + 1, hi + 1):
             t = t + a[i] * b[k - i]
         cols.append(t)
-    cols.append(jnp.zeros_like(cols[0]))
-    acc = jnp.stack(cols, axis=0)                    # (40, n)
-    hi_ = acc >> fe.RADIX
-    lo_ = acc - (hi_ << fe.RADIX)
-    acc = lo_ + jnp.concatenate(
-        [jnp.zeros_like(hi_[:1]), hi_[:-1]], axis=0)
-    out = acc[:fe.NLIMBS] + jnp.int32(fe.WRAP) * acc[fe.NLIMBS:]
-    return _norm_weak(out)
+    return _prod_tail(cols)
+
+
+def _sq(a):
+    """Dedicated squaring, Mosaic form of fe.sqr: cross terms once
+    against doubled limbs plus the diagonal — 210 multiplies vs _mul's
+    400 on identical column values (fe.sqr has the bounds argument)."""
+    if not fe.FAST_SQR:
+        return _mul(a, a)
+    nl = fe.NLIMBS
+    a2 = a + a
+    cols = []
+    for k in range(2 * nl - 1):
+        t = None
+        i = max(0, k - nl + 1)
+        while i < k - i:
+            term = a2[i] * a[k - i]
+            t = term if t is None else t + term
+            i += 1
+        if k % 2 == 0:
+            d = a[k // 2] * a[k // 2]
+            t = d if t is None else t + d
+        cols.append(t)
+    return _prod_tail(cols)
 
 
 def _mul_word(a, w: int):
     return _norm_weak(a * jnp.int32(w))
+
+
+def _carry(x):
+    hi = x >> fe.RADIX
+    lo = x - (hi << fe.RADIX)
+    wrapped = jnp.concatenate(
+        [hi[-1:] * jnp.int32(fe.WRAP), hi[:-1]], axis=0)
+    return lo + wrapped
+
+
+def _seq_canonical(x):
+    """fe._seq_canonical_pass without .at[] (static stacking only)."""
+    c = jnp.zeros(x.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(fe.NLIMBS):
+        v = x[i] + c
+        lo = v & jnp.int32(fe.MASK)
+        outs.append(lo)
+        c = (v - lo) >> fe.RADIX
+    top = outs[-1] >> jnp.int32(8)
+    outs[-1] = outs[-1] & jnp.int32(0xFF)
+    outs[0] = outs[0] + top * jnp.int32(19) + c * jnp.int32(fe.WRAP)
+    return jnp.stack(outs, axis=0)
+
+
+def _freeze(x, pad_8p, p_canon):
+    """Canonical digits in [0, p) (fe.freeze with passed constants)."""
+    x = _norm_weak(x) + pad_8p
+    for _ in range(3):
+        x = _seq_canonical(x)
+    gt = jnp.zeros(x.shape[1:], dtype=bool)
+    eq_ = jnp.ones(x.shape[1:], dtype=bool)
+    for i in range(fe.NLIMBS - 1, -1, -1):
+        gt = gt | (eq_ & (x[i] > p_canon[i]))
+        eq_ = eq_ & (x[i] == p_canon[i])
+    take = (gt | eq_)[None]
+    diff = x - p_canon
+    c = jnp.zeros(diff.shape[1:], dtype=jnp.int32)
+    outs = []
+    for i in range(fe.NLIMBS):
+        v = diff[i] + c
+        lo = v & jnp.int32(fe.MASK)
+        outs.append(lo)
+        c = (v - lo) >> fe.RADIX
+    sub = jnp.stack(outs, axis=0)
+    return jnp.where(take, sub, x)
+
+
+def _eq(a, b, pad_8p, p_canon):
+    return jnp.all(_freeze(a, pad_8p, p_canon)
+                   == _freeze(b, pad_8p, p_canon), axis=0)
 
 
 # -- point ops; points are (4, 20, n) --------------------------------------
@@ -140,12 +240,12 @@ def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
 def _point_double(p, with_t: bool):
     """dbl-2008-hwcd for a=-1 on values (ops/ed25519.point_double)."""
     x, y, z = p[0], p[1], p[2]
-    a = _mul(x, x)
-    b = _mul(y, y)
-    c = _mul_word(_mul(z, z), 2)
+    a = _sq(x)
+    b = _sq(y)
+    c = _mul_word(_sq(z), 2)
     h = _add(a, b)
     xy = _add(x, y)
-    e = _sub(h, _mul(xy, xy))
+    e = _sub(h, _sq(xy))
     g = _sub(a, b)
     f = _add(c, g)
     t = _mul(e, h) if with_t else jnp.zeros_like(x)
@@ -327,3 +427,209 @@ def table17_neg(pt, interpret=False, blk=None):
     """(4,20,W) extended points -> (17,4,20,W) negated window tables,
     one fused Pallas program per blk lanes."""
     return _table17_neg_jit(pt, interpret, blk or BLK)
+
+
+# -- window-major whole-MSM kernel -----------------------------------------
+#
+# The window-loop kernel (grid (nblk, nwin), window fastest) keeps each
+# table block VMEM-resident but pays the 5 shared doublings PER BLOCK
+# per window — doubling cost scales with OUT_PER_BLK * nblk lanes, the
+# largest line item of the round-4 latency decomposition (~19 ms of the
+# 58.8 ms dispatch at batch 16383 pre-fast-sqr).  This variant flips
+# the grid to (nwin, nblk), block fastest: per window, the blocks'
+# select+tree contributions accumulate into a VMEM scratch, and the
+# doubling chain runs ONCE per window on the single global accumulator
+# (the output block, whose constant index map keeps it VMEM-resident
+# across the whole grid).  The table block now changes every step and
+# is re-streamed from HBM each window (~5440 B/lane/window), but the
+# per-step fetch (2.8 MB at blk 512, ~3.4 us at v5e HBM bandwidth)
+# hides under the ~30 us of per-step compute in the pipeline.
+
+def _window_major_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref,
+                         wacc_ref, *, nblk):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    mag = mag_ref[0, 0, :]
+    neg = neg_ref[0, 0, :]
+    d2 = d2_ref[:, :]
+    sel = tab_ref[0]
+    for k in range(1, 17):
+        cond = (mag == jnp.int32(k))[None, None]
+        sel = jnp.where(cond, tab_ref[k], sel)
+    flip = (neg != 0)[None]
+    x = jnp.where(flip, -sel[0], sel[0])
+    t = jnp.where(flip, -sel[3], sel[3])
+    pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
+    w = pts.shape[-1]
+    while w > wacc_ref.shape[-1]:
+        half = w // 2
+        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
+        w = half
+
+    @pl.when(i == 0)
+    def _win_first():
+        wacc_ref[...] = pts
+
+    @pl.when(i != 0)
+    def _win_accum():
+        wacc_ref[...] = _point_add(wacc_ref[...], pts, d2)
+
+    @pl.when(i == nblk - 1)
+    def _win_close():
+        @pl.when(j == 0)
+        def _first_window():
+            out_ref[0] = wacc_ref[...]
+
+        @pl.when(j != 0)
+        def _later_window():
+            acc = out_ref[0]
+            acc = _point_double(acc, with_t=False)
+            acc = _point_double(acc, with_t=False)
+            acc = _point_double(acc, with_t=False)
+            acc = _point_double(acc, with_t=False)
+            acc = _point_double(acc, with_t=True)
+            out_ref[0] = _point_add(acc, wacc_ref[...], d2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk"))
+def _msm_window_major_jit(tab, mags, negs, interpret, blk):
+    from jax.experimental.pallas import tpu as pltpu
+
+    w = tab.shape[-1]
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
+    nwin = mags.shape[0]
+    out_l = _out_lanes(blk)
+    kernel = functools.partial(_window_major_kernel, nblk=nblk)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 4, fe.NLIMBS, out_l),
+                                       jnp.int32),
+        grid=(nwin, nblk),            # last dim fastest: blocks inner
+        in_specs=[
+            pl.BlockSpec((17, 4, fe.NLIMBS, blk),
+                         lambda j, i: (0, 0, 0, i)),
+            pl.BlockSpec((1, 1, blk), lambda j, i: (j, 0, i)),
+            pl.BlockSpec((1, 1, blk), lambda j, i: (j, 0, i)),
+            pl.BlockSpec((fe.NLIMBS, 1), lambda j, i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, out_l),
+                               lambda j, i: (0, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((4, fe.NLIMBS, out_l), jnp.int32)],
+        interpret=interpret,
+    )(tab, mags.reshape(nwin, 1, w),
+      negs.astype(jnp.int32).reshape(nwin, 1, w),
+      jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
+    return out[0]
+
+
+def msm_window_major(tab, mags, negs, interpret=False, blk=None):
+    """(17,4,20,W) table + (nwin,W) MSB-first signed digits ->
+    (4,20,out_lanes) accumulator holding the FULL MSM (its lane-sum):
+    the exact Straus recurrence with one global accumulator — no
+    per-block doubling chains to pay for, no cross-block linearity
+    argument needed."""
+    return _msm_window_major_jit(tab, mags, negs, interpret, blk or BLK)
+
+
+# -- fused fold/verify epilogue --------------------------------------------
+#
+# After the window-loop kernel, each MSM side is a (4, 20, m*128)
+# partial tensor whose lane-sum is the MSM result.  The XLA epilogue
+# (_tree_reduce to 1 lane, combine, 3 cofactor doublings, identity
+# check) runs ~12 point_add levels at shrinking widths — exactly the
+# fixed-cost-dominated regime the window-loop kernel was built to
+# avoid.  This kernel runs the whole epilogue in ONE program:
+# tile-aligned halving/chunk-sum to 128 lanes, a 7-step butterfly
+# roll-fold (every op full-width — no sub-128-lane slicing, which
+# Mosaic rejected in the r4 smoke run), cofactor, frozen identity.
+
+# Partials wider than this are pre-folded by the caller in XLA (those
+# levels are wide enough to be efficient there) to bound kernel VMEM:
+# two (4, 20, 8192) inputs = 5.2 MB.
+MAX_FOLD_LANES = 8192
+
+
+def _tree_to_tile(pts, d2, tile):
+    """(4, 20, m*tile) -> (4, 20, tile) using tile-aligned ops only:
+    halve while the half stays a multiple of tile (m even), then
+    chunk-sum the m in {3, 5} leftover tile-wide chunks."""
+    w = pts.shape[-1]
+    while w > tile and (w // 2) % tile == 0:
+        half = w // 2
+        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
+        w = half
+    if w > tile:
+        acc = pts[..., :tile]
+        for k in range(1, w // tile):
+            acc = _point_add(acc, pts[..., k * tile:(k + 1) * tile], d2)
+        pts = acc
+    return pts
+
+
+def _make_fold_kernel(interpret: bool, tile: int):
+    if interpret:
+        def _roll(x, shift):
+            return jnp.roll(x, shift, axis=-1)
+    else:
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _roll(x, shift):
+            return pltpu.roll(x, shift, axis=x.ndim - 1)
+
+    def kernel(a_ref, r_ref, consts_ref, out_ref):
+        """a (4,20,Pa), r (4,20,Pr) partials; consts (3,20,1) =
+        [d2, pad_8p, p_canon]; out (1,tile) int32 verdict broadcast."""
+        consts = consts_ref[...]
+        d2, pad_8p, p_canon = consts[0], consts[1], consts[2]
+        a = _tree_to_tile(a_ref[...], d2, tile)
+        r = _tree_to_tile(r_ref[...], d2, tile)
+        tot = _point_add(a, r, d2)
+        # butterfly: after folds at shifts tile/2..1 every lane holds
+        # the full tile-wide sum (wraparound rotate, all ops full-tile)
+        shift = tile // 2
+        while shift >= 1:
+            rolled = _roll(tot, shift)
+            tot = _point_add(tot, rolled, d2)
+            shift //= 2
+        for _ in range(3):               # cofactor 8
+            tot = _point_double(tot, with_t=False)
+        x_zero = jnp.all(_freeze(tot[0], pad_8p, p_canon) == 0, axis=0)
+        yz_eq = _eq(tot[1], tot[2], pad_8p, p_canon)
+        out_ref[...] = (x_zero & yz_eq).astype(jnp.int32)[None]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "tile"))
+def _fold_verify_jit(a_part, r_part, interpret, tile):
+    assert tile & (tile - 1) == 0, tile       # butterfly needs pow2
+    assert a_part.shape[-1] % tile == 0 and r_part.shape[-1] % tile == 0
+    assert a_part.shape[-1] <= MAX_FOLD_LANES, a_part.shape
+    assert r_part.shape[-1] <= MAX_FOLD_LANES, r_part.shape
+    consts = jnp.stack([
+        jnp.asarray(fe.D2_LIMBS), jnp.asarray(fe._PAD_8P),
+        jnp.asarray(fe._P_CANON)], axis=0).reshape(3, fe.NLIMBS, 1)
+    out = pl.pallas_call(
+        _make_fold_kernel(interpret, tile),
+        out_shape=jax.ShapeDtypeStruct((1, tile), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(a_part.shape, lambda: (0, 0, 0)),
+            pl.BlockSpec(r_part.shape, lambda: (0, 0, 0)),
+            pl.BlockSpec((3, fe.NLIMBS, 1), lambda: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda: (0, 0)),
+        interpret=interpret,
+    )(a_part, r_part, consts)
+    return out[0, 0] != 0
+
+
+def fold_verify(a_part, r_part, interpret=False, tile=128):
+    """Fused RLC epilogue: two per-block partial tensors (lane counts
+    multiples of tile, <= MAX_FOLD_LANES) -> bool([8](A+R) == identity).
+    Pairs with ops/ed25519.rlc_verify_kernel's cofactor-8 check.
+
+    tile is the Mosaic lane-tile width (128 on hardware); interpret
+    tests shrink it — the halving/butterfly argument is width-
+    independent."""
+    return _fold_verify_jit(a_part, r_part, interpret, tile)

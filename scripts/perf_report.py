@@ -17,6 +17,7 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 AB = os.path.join(ROOT, "ab_round4_results.jsonl")
+AB4B = os.path.join(ROOT, "ab_round4b_results.jsonl")
 BENCH = os.path.join(ROOT, "BENCH_live.json")
 PERF = os.path.join(ROOT, "docs", "PERF.md")
 
@@ -26,15 +27,16 @@ END = "<!-- AUTO-R4-END -->"
 
 def load_ab() -> list[dict]:
     recs = []
-    if os.path.exists(AB):
-        with open(AB) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    try:
-                        recs.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        pass
+    for path in (AB, AB4B):
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            recs.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            pass
     return recs
 
 
@@ -49,8 +51,8 @@ def build_section() -> str:
              "## Round-4 on-hardware capture (auto-generated)",
              "",
              f"Last updated {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())} "
-             "by scripts/perf_report.py from ab_round4_results.jsonl / "
-             "BENCH_live.json.", ""]
+             "by scripts/perf_report.py from ab_round4_results.jsonl, "
+             "ab_round4b_results.jsonl and BENCH_live.json.", ""]
 
     if os.path.exists(BENCH):
         try:
@@ -73,7 +75,8 @@ def build_section() -> str:
 
     recs = load_ab()
     if recs:
-        lines += ["### A/B queue (scripts/ab_round3.py)", ""]
+        lines += ["### A/B queue (scripts/ab_round3.py + "
+                  "scripts/ab_round4b.py)", ""]
         by_name: dict[str, list[dict]] = {}
         for r in recs:
             by_name.setdefault(r.get("name", "?"), []).append(r)

@@ -17,6 +17,8 @@ LOCK=/tmp/tpu.lock
 LOG=/tmp/relay_watch.log
 SMOKE_OUT=/root/repo/mosaic_smoke_r4.jsonl
 AB_OUT=/root/repo/ab_round4_results.jsonl
+AB4B_OUT=/root/repo/ab_round4b_results.jsonl
+SMOKE4B_OUT=/root/repo/mosaic_smoke4b.jsonl
 WS_OUT=/root/repo/width_scaling_r4.jsonl
 BENCH_OUT=/root/repo/BENCH_live.json
 STAMP=/tmp/last_bench_capture
@@ -29,8 +31,8 @@ commit_results() {
     # nothing staged) if any single pathspec doesn't exist yet, and
     # early phases run before later phases' outputs exist.
     for _ in 1 2 3; do
-        for f in "$SMOKE_OUT" "$AB_OUT" "$WS_OUT" "$BENCH_OUT" \
-                 docs/PERF.md; do
+        for f in "$SMOKE_OUT" "$SMOKE4B_OUT" "$AB_OUT" "$AB4B_OUT" \
+                 "$WS_OUT" "$BENCH_OUT" docs/PERF.md; do
             [ -e "$f" ] && git add -A "$f" 2>/dev/null
         done
         if git diff --cached --quiet; then return 0; fi
@@ -65,6 +67,21 @@ while true; do
             log "ab queue rc=$?"
             python scripts/perf_report.py >>"$LOG" 2>&1
             commit_results "on-TPU A/B results: RLC widths, cached-A, Pallas kernels, light client"
+        fi
+        if [ ! -s "$SMOKE4B_OUT" ] || ! grep -q '"done"' "$SMOKE4B_OUT"; then
+            log "running mosaic_smoke4b -> $SMOKE4B_OUT"
+            flock "$LOCK" timeout 2700 python scripts/mosaic_smoke4b.py \
+                "$SMOKE4B_OUT" >>"$LOG" 2>&1
+            log "mosaic_smoke4b rc=$?"
+            commit_results "on-TPU Mosaic smoke: fast-sqr, blk-1024, fold-epilogue probes"
+        fi
+        if [ ! -s "$AB4B_OUT" ] || ! grep -q '"done"' "$AB4B_OUT"; then
+            log "running ab_round4b queue -> $AB4B_OUT"
+            flock "$LOCK" timeout 10800 python scripts/ab_round4b.py \
+                "$AB4B_OUT" >>"$LOG" 2>&1
+            log "ab4b queue rc=$?"
+            python scripts/perf_report.py >>"$LOG" 2>&1
+            commit_results "on-TPU A/B results: fast squaring, Pallas block size"
         fi
         if [ ! -s "$WS_OUT" ] || ! grep -q '"done"' "$WS_OUT"; then
             log "running width_scaling -> $WS_OUT"

@@ -46,6 +46,24 @@ class TestFieldOps:
             assert fe.limbs_to_int(sq[:, i]) == x * x % P
             assert fe.limbs_to_int(ng[:, i]) == (-x) % P
 
+    def test_fast_sqr_weak_form_extremes(self):
+        """fe.sqr's doubled-cross-terms path must equal mul(a, a) and
+        stay in weak form at mul's documented input bound (|limb| <=
+        10300), not just for canonical digits — the MSM feeds it
+        redundant signed limbs."""
+        r = np.random.default_rng(11)
+        a = r.integers(-10300, 10301,
+                       size=(fe.NLIMBS, 130)).astype(np.int32)
+        a[:, 0] = 10300
+        a[:, 1] = -10300
+        a[:, 2] = 0
+        aj = jnp.asarray(a)
+        sq = np.asarray(jax.jit(fe.sqr)(aj))
+        mu = np.asarray(jax.jit(fe.mul)(aj, aj))
+        for i in range(a.shape[1]):
+            assert fe.limbs_to_int(sq[:, i]) == fe.limbs_to_int(mu[:, i])
+        assert sq.min() >= -1220 and sq.max() <= 9800
+
     def test_freeze_canonical(self):
         frz = np.asarray(jax.jit(fe.freeze)(A))
         for i, x in enumerate(A_INT):

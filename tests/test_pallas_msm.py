@@ -136,6 +136,138 @@ def test_msm_window_loop_multiblock():
     assert _pt_eq(want, got)
 
 
+def _xla_epilogue_verdict(pa, pr):
+    """The XLA reference of the fold kernel: reduce, combine, cofactor
+    8, identity."""
+    total = dev.point_add(dev._tree_reduce(pa, 1), dev._tree_reduce(pr, 1))
+    for _ in range(3):
+        total = dev.point_double(total, with_t=False)
+    return bool(dev.point_is_identity(total)[0])
+
+
+def test_fold_verify_matches_xla():
+    """Fused fold/verify epilogue vs the XLA reference at tile 8 (the
+    halving/butterfly argument is width-independent; real Mosaic at
+    tile 128 is covered by scripts/mosaic_smoke4b.py): the identity
+    case (R side = negated A side) must accept, the non-identity case
+    must reject."""
+    pa = _points(16, distinct=8)     # 2*tile: exercises the halving
+    pr_neg = dev.point_neg(pa)
+    # accept: sum(A) + sum(-A) = identity
+    assert _xla_epilogue_verdict(pa, pr_neg) is True
+    got = bool(pm.fold_verify(pa, pr_neg, interpret=True, tile=8))
+    assert got is True
+    # reject: sum(A) + sum(A) = 2*sum != identity (B-multiples, no
+    # torsion), at tile-wide inputs (butterfly only)
+    pa8 = _points(8, distinct=4)
+    assert _xla_epilogue_verdict(pa8, pa8) is False
+    got = bool(pm.fold_verify(pa8, pa8, interpret=True, tile=8))
+    assert got is False
+
+
+def test_fold_verify_chunk_sum_width():
+    """A 3*tile-lane partial tensor takes the chunk-sum branch of
+    _tree_to_tile (m odd after halving)."""
+    pa = _points(24, distinct=4)
+    pr = dev.point_neg(pa)
+    assert bool(pm.fold_verify(pa, pr, interpret=True, tile=8)) is True
+
+
+def test_rlc_dispatches_fold_verify(monkeypatch):
+    """With USE_PALLAS_FOLD on, the RLC verdict routes through
+    fold_verify with both sides' partial tensors, and accept/tampered-
+    reject hold around the seam."""
+    import cometbft_tpu.ops.pallas_msm as pmod
+
+    fold_calls, msm_calls = [], []
+
+    def msm_spy(tab, mags, negs, interpret=False, blk=None):
+        msm_calls.append(tab.shape)
+        monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", False)
+        try:
+            return dev._msm_scan(tab, mags, negs)    # (4, 20, 1) partial
+        finally:
+            monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
+
+    def fold_spy(pa, pr, interpret=False):
+        fold_calls.append((pa.shape, pr.shape))
+        ta = dev._tree_reduce(pa, 1)
+        tr = dev._tree_reduce(pr, 1)
+        total = dev.point_add(ta, tr)
+        for _ in range(3):
+            total = dev.point_double(total, with_t=False)
+        return dev.point_is_identity(total)[0]
+
+    monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
+    monkeypatch.setattr(pmod, "msm_window_loop", msm_spy)
+    monkeypatch.setattr(pmod, "fold_verify", fold_spy)
+    monkeypatch.setattr(pmod, "BLK", 8)
+    monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_FOLD", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_TABLE", False)
+    monkeypatch.setattr(dev, "USE_PALLAS_DECOMPRESS", False)
+
+    good, bad = _rlc_verdicts(tamper_idx=3)
+    assert good and not bad
+    assert fold_calls                     # epilogue went through the seam
+    assert len(msm_calls) >= 2            # both MSM sides produced partials
+
+
+def test_msm_window_major_matches_scan():
+    """The window-major kernel (blocks inner, ONE global accumulator,
+    doublings once per window) equals the XLA shared-doubling scan —
+    single block (init/close coincide) and multiblock (the wacc
+    scratch accumulation across i)."""
+    nwin = 4
+    rng = np.random.default_rng(13)
+    tab = dev._table17(_points(W))
+    mags = jnp.asarray(rng.integers(0, 17, (nwin, W), dtype=np.int32))
+    negs = jnp.asarray(rng.integers(0, 2, (nwin, W)) != 0)
+    want = dev._msm_scan(tab, mags, negs)
+
+    got1 = pm.msm_window_major(tab, mags, negs, interpret=True, blk=W)
+    assert got1.shape[-1] == pm._out_lanes(W)
+    assert _pt_eq(want, dev._tree_reduce(jnp.asarray(got1), 1))
+
+    got2 = pm.msm_window_major(tab, mags, negs, interpret=True, blk=8)
+    assert got2.shape[-1] == pm._out_lanes(8)
+    assert _pt_eq(want, dev._tree_reduce(jnp.asarray(got2), 1))
+
+
+def test_msm_scan_dispatches_window_major(monkeypatch):
+    """USE_PALLAS_MSM_MAJOR routes _msm_scan through msm_window_major
+    and takes precedence over the window-loop kernel."""
+    import cometbft_tpu.ops.pallas_msm as pmod
+
+    calls = []
+
+    def spy(tab, mags, negs, interpret=False, blk=None):
+        calls.append((tab.shape, blk))
+        monkeypatch.setattr(dev, "USE_PALLAS_MSM_MAJOR", False)
+        monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", False)
+        try:
+            return dev._msm_scan(tab, mags, negs)
+        finally:
+            monkeypatch.setattr(dev, "USE_PALLAS_MSM_MAJOR", True)
+            monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
+
+    nwin = 3
+    rng = np.random.default_rng(4)
+    tab = dev._table17(_points(W))
+    mags = jnp.asarray(rng.integers(0, 17, (nwin, W), dtype=np.int32))
+    negs = jnp.asarray(rng.integers(0, 2, (nwin, W)) != 0)
+    want = dev._msm_scan(tab, mags, negs)
+
+    monkeypatch.setattr(dev, "_pallas_capable", lambda: True)
+    monkeypatch.setattr(pmod, "msm_window_major", spy)
+    monkeypatch.setattr(pmod, "BLK", 8)
+    monkeypatch.setattr(dev, "USE_PALLAS_MSM_MAJOR", True)
+    monkeypatch.setattr(dev, "USE_PALLAS_MSM_LOOP", True)
+    got = dev._msm_scan(tab, mags, negs)
+    assert calls == [((17, 4, 20, W), 8)]
+    assert _pt_eq(want, got)
+
+
 def test_pallas_decompress_matches_xla():
     """Fused decompress vs ops/ed25519.decompress on valid encodings,
     torsion/low-order points, and invalid (non-square) encodings."""
