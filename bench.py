@@ -408,19 +408,39 @@ def main() -> None:
               "rlc_cached_a_config",
               "same batch shape, A-side decompression+tables cached "
               "(repeated-valset workload)")
-    run_extra("light_client_headers_per_sec",
-              lambda: round(bench_light_headers(150, 8, 192), 1),
-              "light_client_config",
-              "150 validators/commit, 192 commits/RLC dispatch, pipelined"
-              " (depth sweep, ab_round4_results.jsonl; 384 measured"
-              " higher still but its cold compile risks the extra"
-              " timeout)")
-    run_extra("blocksync_blocks_per_sec",
-              lambda: round(bench_blocksync(10_000, 24, 4), 2),
-              "blocksync_config",
-              "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch"
-              " (monotone through 24 once the Pallas table build"
-              " landed: 89.8/98.4/118.7 at 6/12/24)")
+    def run_extra_fallbacks(key, config_key, arms):
+        """Try configs deepest-first; a timeout/error/skip falls back
+        to the next (shallower, cheaper-compile) config instead of
+        losing the metric — the round-4 driver capture lost blocksync
+        to a single 600 s cold compile."""
+        for fn, note in arms:
+            run_extra(key, fn, config_key, note)
+            if isinstance(extra.get(key), (int, float)):
+                return
+
+    run_extra_fallbacks(
+        "light_client_headers_per_sec", "light_client_config",
+        [(lambda: round(bench_light_headers(150, 8, 384), 1),
+          "150 validators/commit, 384 commits/RLC dispatch, pipelined"
+          " (depth sweep: 2898.7 at 192 vs 3830.6 at 384 with the r4b"
+          " stack, ab_round4b prod2_light)"),
+         (lambda: round(bench_light_headers(150, 8, 192), 1),
+          "150 validators/commit, 192 commits/RLC dispatch, pipelined"
+          " (fallback depth: the 384-commit compile exceeded the"
+          " extra timeout)")])
+    run_extra_fallbacks(
+        "blocksync_blocks_per_sec", "blocksync_config",
+        [(lambda: round(bench_blocksync(10_000, 48, 4), 2),
+          "10k validators, 6667+1 sigs/commit, 48 blocks/dispatch"
+          " (monotone through 48 with the r4b stack: 130.6/139.2 at"
+          " 24/48, ab_round4b prod2_blocksync)"),
+         (lambda: round(bench_blocksync(10_000, 24, 4), 2),
+          "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch"
+          " (fallback depth: the 48-block compile exceeded the extra"
+          " timeout)"),
+         (lambda: round(bench_blocksync(10_000, 12, 4), 2),
+          "10k validators, 6667+1 sigs/commit, 12 blocks/dispatch"
+          " (second fallback)")])
     run_extra("secp256k1_sigs_per_sec",
               lambda: round(bench_secp(1024, 6), 1))
 

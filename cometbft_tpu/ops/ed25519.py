@@ -344,9 +344,13 @@ USE_PALLAS_FOLD = os.environ.get(
 # blocks iterate INSIDE each window so the 5 shared doublings run once
 # per window on one global accumulator instead of once per block —
 # the largest line item of the r4 latency decomposition.  Supersedes
-# USE_PALLAS_MSM_LOOP when on; opt-in until A/B'd on hardware.
+# USE_PALLAS_MSM_LOOP when on.  ON by default since the round-4b
+# hardware A/B: 505.2k vs 376.7k sigs/s at batch 32767 (+34%, the
+# arm that crossed the 20x north star) and 402.5k vs 365.2k at 16383
+# (ab_round4b_results.jsonl pallas_major_ab); parity on real Mosaic
+# at blk 512/1024 (mosaic_smoke4b.jsonl).
 USE_PALLAS_MSM_MAJOR = os.environ.get(
-    "COMETBFT_TPU_PALLAS_MSM_MAJOR", "0") == "1"
+    "COMETBFT_TPU_PALLAS_MSM_MAJOR", "1") == "1"
 
 
 _SMALL_WIDTHS = (8, 16, 32, 64, 96, 128, 160, 192)
