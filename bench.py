@@ -408,39 +408,41 @@ def main() -> None:
               "rlc_cached_a_config",
               "same batch shape, A-side decompression+tables cached "
               "(repeated-valset workload)")
-    def run_extra_fallbacks(key, config_key, arms):
-        """Try configs deepest-first; a timeout/error/skip falls back
-        to the next (shallower, cheaper-compile) config instead of
-        losing the metric — the round-4 driver capture lost blocksync
-        to a single 600 s cold compile."""
+    def run_extra_deepening(key, config_key, arms):
+        """Bank a number at the shallow (cache-warm, cheap-compile)
+        config FIRST, then deepen while measurements keep succeeding
+        and the budget holds; each success overwrites the shallower
+        number.  Deepest-first lost whole metrics to single 600 s
+        cold compiles in the 11:49 and 13:33 round-4 captures."""
+        best = None
         for fn, note in arms:
             run_extra(key, fn, config_key, note)
-            if isinstance(extra.get(key), (int, float)):
+            got = extra.get(key)
+            if isinstance(got, (int, float)):
+                best = (got, extra.get(config_key))
+            elif best is not None:
+                # deeper arm failed: restore the banked number
+                extra[key], extra[config_key] = best
+                return
+            else:
                 return
 
-    run_extra_fallbacks(
+    run_extra_deepening(
         "light_client_headers_per_sec", "light_client_config",
-        [(lambda: round(bench_light_headers(150, 8, 384), 1),
+        [(lambda: round(bench_light_headers(150, 8, 192), 1),
+          "150 validators/commit, 192 commits/RLC dispatch, pipelined"),
+         (lambda: round(bench_light_headers(150, 8, 384), 1),
           "150 validators/commit, 384 commits/RLC dispatch, pipelined"
-          " (depth sweep: 2898.7 at 192 vs 3830.6 at 384 with the r4b"
-          " stack, ab_round4b prod2_light)"),
-         (lambda: round(bench_light_headers(150, 8, 192), 1),
-          "150 validators/commit, 192 commits/RLC dispatch, pipelined"
-          " (fallback depth: the 384-commit compile exceeded the"
-          " extra timeout)")])
-    run_extra_fallbacks(
+          " (depth sweep: 3708.7 at 192 vs 5338.6 at 384 with the r4b"
+          " stack, ab_round4b prod3_light)")])
+    run_extra_deepening(
         "blocksync_blocks_per_sec", "blocksync_config",
-        [(lambda: round(bench_blocksync(10_000, 48, 4), 2),
+        [(lambda: round(bench_blocksync(10_000, 24, 4), 2),
+          "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch"),
+         (lambda: round(bench_blocksync(10_000, 48, 4), 2),
           "10k validators, 6667+1 sigs/commit, 48 blocks/dispatch"
-          " (monotone through 48 with the r4b stack: 130.6/139.2 at"
-          " 24/48, ab_round4b prod2_blocksync)"),
-         (lambda: round(bench_blocksync(10_000, 24, 4), 2),
-          "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch"
-          " (fallback depth: the 48-block compile exceeded the extra"
-          " timeout)"),
-         (lambda: round(bench_blocksync(10_000, 12, 4), 2),
-          "10k validators, 6667+1 sigs/commit, 12 blocks/dispatch"
-          " (second fallback)")])
+          " (monotone through 48 with the r4b stack: 159.7/181.6 at"
+          " 24/48, ab_round4b prod3_blocksync)")])
     run_extra("secp256k1_sigs_per_sec",
               lambda: round(bench_secp(1024, 6), 1))
 

@@ -16,7 +16,9 @@ import time
 from ..libs.service import BaseService
 
 REQUEST_INTERVAL = 0.01          # pool.go requestInterval (10ms)
-MAX_PENDING_REQUESTS = 40        # window size
+MAX_PENDING_REQUESTS = 64        # window size: >= the 48-block
+                                 # verify window the r4b depth sweep
+                                 # rewards (reactor.VERIFY_WINDOW)
 MAX_PENDING_REQUESTS_PER_PEER = 20
 PEER_TIMEOUT = 15.0              # pool.go peerTimeout
 

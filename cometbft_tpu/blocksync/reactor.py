@@ -22,11 +22,11 @@ from .pool import BlockPool
 BLOCKSYNC_CHANNEL = 0x40
 TRY_SYNC_INTERVAL = 0.01
 # blocks whose LastCommit sigs batch into one device dispatch
-# 24 from the r4 on-TPU depth sweep (ab_round4_results.jsonl
-# prod_blocksync at 10k validators): 89.8/98.4/118.7 blocks/s at
-# 6/12/24 blocks per RLC dispatch — monotone through 24 once the fused
-# Pallas table build landed.  Bounded by MAX_PENDING_REQUESTS=40.
-VERIFY_WINDOW = 24
+# 48 from the r4b on-TPU depth sweep (ab_round4b_results.jsonl
+# prod3_blocksync at 10k validators): monotone through 48 (159.7 at
+# 24 vs 181.6 at 48 under the full kernel stack).  The pool keeps
+# MAX_PENDING_REQUESTS=64 blocks in flight so a full window can fill.
+VERIFY_WINDOW = 48
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 

@@ -70,12 +70,13 @@ class Client:
                  trust_level: Fraction = DEFAULT_TRUST_LEVEL,
                  max_clock_drift_ns: int = 10 * SECOND,
                  pruning_size: int = DEFAULT_PRUNING_SIZE,
-                 # 192 from the r4 on-TPU depth sweep (ab_round4_
-                 # results.jsonl prod_light): 447/763/1942/2840/3529
-                 # headers/s at 24/48/96/192/384 commits per RLC
-                 # dispatch — the relay's fixed dispatch cost rewards
-                 # depth; 192 keeps one dispatch under ~100 ms
-                 sequential_batch_size: int = 192,
+                 # 384 from the r4b on-TPU depth sweep (ab_round4b_
+                 # results.jsonl prod3_light under the full kernel
+                 # stack): 3708.7 headers/s at 192 vs 5338.6 at 384
+                 # commits per RLC dispatch — the relay's fixed
+                 # dispatch cost rewards depth, and the r4b kernels
+                 # keep a 384-commit dispatch well under 100 ms
+                 sequential_batch_size: int = 384,
                  now_fn=Timestamp.now):
         verifier.validate_trust_level(trust_level)
         trust_options.validate_basic()
