@@ -167,10 +167,11 @@ def test_fold_verify_matches_xla():
 
 def test_fold_verify_chunk_sum_width():
     """A 3*tile-lane partial tensor takes the chunk-sum branch of
-    _tree_to_tile (m odd after halving)."""
-    pa = _points(24, distinct=4)
+    _tree_to_tile (m odd after halving).  tile 4 keeps the interpret
+    compile small; the branch logic is tile-independent."""
+    pa = _points(12, distinct=4)
     pr = dev.point_neg(pa)
-    assert bool(pm.fold_verify(pa, pr, interpret=True, tile=8)) is True
+    assert bool(pm.fold_verify(pa, pr, interpret=True, tile=4)) is True
 
 
 def test_rlc_dispatches_fold_verify(monkeypatch):
