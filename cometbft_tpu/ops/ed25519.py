@@ -526,14 +526,29 @@ def _loop_partials(tab, mags, negs):
 
 
 def _prefold(partials):
-    """XLA halving of a partial tensor down to the fold kernel's VMEM
-    bound — only the wide (efficient) levels run here; alignment holds
-    because widths are m*128 with m even whenever w > MAX_FOLD_LANES."""
+    """XLA reduction of a partial tensor down to the fold kernel's VMEM
+    bound — only the wide (efficient) levels run here.  Widths are
+    m*128; when m is odd (window-loop partials with odd nblk > 64,
+    e.g. W=65*512) halving would break 128-alignment, so those widths
+    chunk-sum the tail into the MAX_FOLD_LANES-wide head instead of
+    asserting (r4 advisor)."""
     from . import pallas_msm
-    while partials.shape[-1] > pallas_msm.MAX_FOLD_LANES:
-        half = partials.shape[-1] // 2
-        assert half % 128 == 0, partials.shape
-        partials = point_add(partials[..., :half], partials[..., half:])
+    bound = pallas_msm.MAX_FOLD_LANES
+    while partials.shape[-1] > bound:
+        w = partials.shape[-1]
+        half = w // 2
+        if half % 128 == 0:
+            partials = point_add(partials[..., :half], partials[..., half:])
+            continue
+        acc = partials[..., :bound]
+        off = bound
+        while off < w:
+            n = min(bound, w - off)
+            acc = jnp.concatenate(
+                [point_add(acc[..., :n], partials[..., off:off + n]),
+                 acc[..., n:]], axis=-1)
+            off += bound
+        partials = acc
     return partials
 
 

@@ -45,6 +45,16 @@ def blk_for(w: int, cap: int | None = None):
     The 128 floor is Mosaic's lane-tile width; tests that shrink BLK
     below it keep their narrow block as the floor."""
     b = min(BLK, cap) if cap else BLK
+    if b <= 0:          # garbage env override: loud fallback, no hang
+        return None
+    # an exact match keeps non-pow2 blocks that are legal Mosaic tiles
+    # (multiples of 128, e.g. 384 = 3 lane-tiles) or sub-128 test
+    # blocks; only the FALLBACK walk rounds to a power of two first —
+    # halving from 384 walks 384->192->96 and never tests the pow2
+    # candidates below it (r4 advisor)
+    if w % b == 0 and (b % 128 == 0 or b < 128):
+        return b
+    b = 1 << (b.bit_length() - 1)
     floor = min(128, b)
     while b >= floor:
         if w % b == 0:

@@ -471,3 +471,32 @@ def test_msm_tables_dispatches_pallas_table(monkeypatch):
     tab, ok = dev._msm_tables(jnp.asarray(words))
     assert calls and calls[0] == (4, 20, 8)
     assert bool(ok)
+
+
+# -- r4 advisor regressions ------------------------------------------------
+
+def test_blk_for_non_pow2_override(monkeypatch):
+    """A non-pow2 BLK override (e.g. 384) must still find the pow2
+    candidates below it instead of silently losing the Pallas path
+    (r4 advisor: 384->192->96 skipped the 128 floor entirely)."""
+    monkeypatch.setattr(pm, "BLK", 384)
+    assert pm.blk_for(4096) == 256
+    assert pm.blk_for(128) == 128
+    monkeypatch.setattr(pm, "BLK", 512)
+    assert pm.blk_for(4096) == 512
+    monkeypatch.setattr(pm, "BLK", 96)   # sub-128 test override: pow2 floor
+    assert pm.blk_for(64) == 64
+
+
+def test_prefold_odd_tile_width(monkeypatch):
+    """_prefold on widths that are ODD multiples of 128 above the fold
+    bound must chunk-sum instead of asserting (r4 advisor: W=65*512
+    window-loop partials -> 8320 lanes, first halving 4160 % 128 != 0).
+    Shrunk analog: bound=8 'lanes' with tile alignment 128 replaced by
+    the real 128 via a 3*128-wide tensor and a monkeypatched bound."""
+    monkeypatch.setattr(pm, "MAX_FOLD_LANES", 256)
+    pts = _points(3 * 128, distinct=6)          # odd multiple of 128
+    want = dev._tree_reduce(pts, 1)
+    got = dev._prefold(pts)
+    assert got.shape[-1] == 256
+    assert _pt_eq(want, dev._tree_reduce(got, 1))
