@@ -189,6 +189,22 @@ class TpuSr25519BatchVerifier(_SigCollector):
 # ours is higher because the device round-trip has fixed cost).
 DEVICE_THRESHOLD = int(os.environ.get("COMETBFT_TPU_BATCH_THRESHOLD", "8"))
 
+# secp256k1 has no RLC batch equation — its device kernel verifies
+# per-signature Straus chains, so the per-sig device advantage is far
+# smaller than ed25519's and the ~70 ms dispatch floor dominates small
+# batches.  Measured: host 889 sigs/s (1.12 ms/sig, recorded in
+# docs/PERF.md), device 6651 sigs/s at batch 1024 -> fixed+marginal
+# crossover ≈ 70 sigs; 96 leaves margin for relay jitter.  Refined by
+# the r5 width sweep (scripts/ab_round5.py secp_batch_ab).
+SECP_DEVICE_THRESHOLD = int(os.environ.get(
+    "COMETBFT_TPU_SECP_THRESHOLD", "96"))
+
+
+def _device_threshold(key_type: str) -> int:
+    if key_type == "secp256k1":
+        return max(DEVICE_THRESHOLD, SECP_DEVICE_THRESHOLD)
+    return DEVICE_THRESHOLD
+
 
 def safe_verify(pub_key, msg: bytes, sig: bytes) -> bool:
     """verify_signature with backend errors mapped to invalid.
@@ -228,8 +244,9 @@ def create_batch_verifier(key_type: str = "ed25519", n_hint: int = 0,
         return _CPU_BY_TYPE[key_type]()
     if provider == "tpu":
         return _TPU_BY_TYPE[key_type]()
-    # auto: pick by expected batch size
-    if n_hint and n_hint < DEVICE_THRESHOLD:
+    # auto: pick by expected batch size (per-keytype crossover — secp
+    # lacks an RLC equation, so its device win starts much later)
+    if n_hint and n_hint < _device_threshold(key_type):
         return _CPU_BY_TYPE[key_type]()
     return _TPU_BY_TYPE[key_type]()
 

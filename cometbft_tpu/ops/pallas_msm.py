@@ -47,12 +47,14 @@ def blk_for(w: int, cap: int | None = None):
     b = min(BLK, cap) if cap else BLK
     if b <= 0:          # garbage env override: loud fallback, no hang
         return None
-    # an exact match keeps non-pow2 blocks that are legal Mosaic tiles
-    # (multiples of 128, e.g. 384 = 3 lane-tiles) or sub-128 test
-    # blocks; only the FALLBACK walk rounds to a power of two first —
-    # halving from 384 walks 384->192->96 and never tests the pow2
-    # candidates below it (r4 advisor)
-    if w % b == 0 and (b % 128 == 0 or b < 128):
+    # sub-128 test blocks may be any size (the in-kernel tree never
+    # halves them: out_lanes == blk).  At or above 128 the tree must
+    # halve exactly onto the 128-lane output, so blocks are pow2-only
+    # — a non-pow2 override (e.g. 384, whose halving walks 384->192->96
+    # past the 128-lane scratch) rounds DOWN to a pow2 candidate
+    # instead of being returned verbatim or losing the path (r4
+    # advisor + r5 review)
+    if b < 128 and w % b == 0:
         return b
     b = 1 << (b.bit_length() - 1)
     floor = min(128, b)
@@ -222,15 +224,12 @@ def _point_add(p, q, d2):
 
 # -- the kernel -------------------------------------------------------------
 
-def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
-    """tab (17, 4, 20, BLK) VMEM; mag/neg (1, BLK); d2 (20, 1);
-    out (1, 4, 20, OUT) — the block index rides a LEADING output dim
-    so stores stay tile-aligned (an 8-lane slice at lane offset 8*i
-    is not a legal Mosaic store; a full block at leading index i is).
-    """
-    mag = mag_ref[0, :]                  # (BLK,)
-    neg = neg_ref[0, :]
-    d2 = d2_ref[:, :]                    # (20, 1)
+def _block_contrib(tab_ref, mag, neg, d2, out_w):
+    """Shared kernel prologue: 17-row predicated select from the VMEM
+    table block, signed-digit negation (X/T arithmetic negation of the
+    redundant signed limbs), and the tile-aligned pairwise halving of
+    the block down to out_w lanes.  ONE copy of this subtle
+    select/flip/tree logic — every MSM kernel variant calls it."""
     sel = tab_ref[0]                     # (4, 20, BLK)
     for k in range(1, 17):
         cond = (mag == jnp.int32(k))[None, None]
@@ -240,11 +239,22 @@ def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     t = jnp.where(flip, -sel[3], sel[3])
     pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
     w = pts.shape[-1]
-    while w > out_ref.shape[-1]:
+    while w > out_w:
         half = w // 2
         pts = _point_add(pts[..., :half], pts[..., half:w], d2)
         w = half
-    out_ref[0] = pts
+    return pts
+
+
+def _select_tree_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
+    """tab (17, 4, 20, BLK) VMEM; mag/neg (1, BLK); d2 (20, 1);
+    out (1, 4, 20, OUT) — the block index rides a LEADING output dim
+    so stores stay tile-aligned (an 8-lane slice at lane offset 8*i
+    is not a legal Mosaic store; a full block at leading index i is).
+    """
+    d2 = d2_ref[:, :]                    # (20, 1)
+    out_ref[0] = _block_contrib(tab_ref, mag_ref[0, :], neg_ref[0, :],
+                                d2, out_ref.shape[-1])
 
 
 def _point_double(p, with_t: bool):
@@ -278,22 +288,9 @@ def _window_loop_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref):
     pipeline keeps it VMEM-resident rather than re-fetching.
     """
     j = pl.program_id(1)
-    mag = mag_ref[0, 0, :]
-    neg = neg_ref[0, 0, :]
     d2 = d2_ref[:, :]
-    sel = tab_ref[0]
-    for k in range(1, 17):
-        cond = (mag == jnp.int32(k))[None, None]
-        sel = jnp.where(cond, tab_ref[k], sel)
-    flip = (neg != 0)[None]
-    x = jnp.where(flip, -sel[0], sel[0])
-    t = jnp.where(flip, -sel[3], sel[3])
-    pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
-    w = pts.shape[-1]
-    while w > out_ref.shape[-1]:
-        half = w // 2
-        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
-        w = half
+    pts = _block_contrib(tab_ref, mag_ref[0, 0, :], neg_ref[0, 0, :],
+                         d2, out_ref.shape[-1])
 
     @pl.when(j == 0)
     def _first():
@@ -459,22 +456,9 @@ def _window_major_kernel(tab_ref, mag_ref, neg_ref, d2_ref, out_ref,
                          wacc_ref, *, nblk):
     j = pl.program_id(0)
     i = pl.program_id(1)
-    mag = mag_ref[0, 0, :]
-    neg = neg_ref[0, 0, :]
     d2 = d2_ref[:, :]
-    sel = tab_ref[0]
-    for k in range(1, 17):
-        cond = (mag == jnp.int32(k))[None, None]
-        sel = jnp.where(cond, tab_ref[k], sel)
-    flip = (neg != 0)[None]
-    x = jnp.where(flip, -sel[0], sel[0])
-    t = jnp.where(flip, -sel[3], sel[3])
-    pts = jnp.stack([x, sel[1], sel[2], t], axis=0)
-    w = pts.shape[-1]
-    while w > wacc_ref.shape[-1]:
-        half = w // 2
-        pts = _point_add(pts[..., :half], pts[..., half:w], d2)
-        w = half
+    pts = _block_contrib(tab_ref, mag_ref[0, 0, :], neg_ref[0, 0, :],
+                         d2, wacc_ref.shape[-1])
 
     @pl.when(i == 0)
     def _win_first():
@@ -533,13 +517,136 @@ def _msm_window_major_jit(tab, mags, negs, interpret, blk):
     return out[0]
 
 
-def msm_window_major(tab, mags, negs, interpret=False, blk=None):
+def msm_window_major(tab, mags, negs, interpret=False, blk=None,
+                     group=None):
     """(17,4,20,W) table + (nwin,W) MSB-first signed digits ->
     (4,20,out_lanes) accumulator holding the FULL MSM (its lane-sum):
     the exact Straus recurrence with one global accumulator — no
     per-block doubling chains to pay for, no cross-block linearity
-    argument needed."""
+    argument needed.
+
+    group > 1 dispatches the GROUPED variant: G consecutive windows
+    share one table-block fetch (see _window_major_grouped_kernel)."""
+    g = WIN_GROUP if group is None else group
+    if g > 1:
+        return _msm_window_major_grouped_jit(tab, mags, negs,
+                                             interpret, blk or BLK, g)
     return _msm_window_major_jit(tab, mags, negs, interpret, blk or BLK)
+
+
+# -- grouped window-major kernel -------------------------------------------
+#
+# The window-major grid (nwin, nblk) re-fetches each table block from
+# HBM once PER WINDOW: 52 windows x 64 blocks x 2.8 MB = ~9.3 GB per
+# A-side dispatch at batch 32767 — ~11 ms of HBM time at v5e peak
+# against a ~65 ms dispatch, paid again (~4.6 GB) on the R side.  This
+# variant makes the group of G consecutive windows share one fetch:
+# grid (nwin/G, nblk, G) with the GROUP index outermost and the window-
+# in-group index g fastest; the tab index map ignores g, so the
+# pipeline keeps the block VMEM-resident across the G inner steps
+# (same revisiting guarantee the window-loop kernel relies on), cutting
+# table traffic by G.  Each window-in-group accumulates into its own
+# (4, 20, out_l) VMEM scratch row; when the LAST block of the LAST
+# window-in-group closes, the group folds into the global accumulator
+# with the usual 5-doublings-then-add chain per window, preserving the
+# exact Straus recurrence acc <- 32*acc + contrib_w in MSB order.
+
+WIN_GROUP = int(os.environ.get("COMETBFT_TPU_PALLAS_WIN_GROUP", "1"))
+
+
+def group_for(nwin: int, requested: int) -> int:
+    """Largest divisor of nwin that is <= requested (window counts per
+    MSM side differ — 52-window A sides admit {2, 4, 13}, 26-window R
+    sides {2, 13} — so the requested group degrades per side)."""
+    g = 1
+    for c in range(2, min(requested, nwin) + 1):
+        if nwin % c == 0:
+            g = c
+    return g
+
+
+def _window_major_grouped_kernel(tab_ref, mag_ref, neg_ref, d2_ref,
+                                 out_ref, wacc_ref, *, nblk, group):
+    jg = pl.program_id(0)
+    i = pl.program_id(1)
+    g = pl.program_id(2)
+    d2 = d2_ref[:, :]
+    pts = _block_contrib(tab_ref, mag_ref[0, 0, :], neg_ref[0, 0, :],
+                         d2, wacc_ref.shape[-1])
+
+    @pl.when(i == 0)
+    def _win_first():
+        wacc_ref[pl.ds(g, 1)] = pts[None]
+
+    @pl.when(i != 0)
+    def _win_accum():
+        cur = wacc_ref[pl.ds(g, 1)][0]
+        wacc_ref[pl.ds(g, 1)] = _point_add(cur, pts, d2)[None]
+
+    @pl.when((i == nblk - 1) & (g == group - 1))
+    def _close_group():
+        # fori_loop, NOT a python unroll: an unrolled close is 5*group
+        # point_doubles of ~5k HLO nodes each — a compile bomb at
+        # group 13 (both XLA-interpret and Mosaic); the loop body
+        # compiles once and the doubling chain math is identical
+        def body(gp, acc):
+            for _ in range(4):
+                acc = _point_double(acc, with_t=False)
+            acc = _point_double(acc, with_t=True)
+            return _point_add(acc, wacc_ref[pl.ds(gp, 1)][0], d2)
+
+        @pl.when(jg == 0)
+        def _first_group():
+            out_ref[0] = jax.lax.fori_loop(1, group, body, wacc_ref[0])
+
+        @pl.when(jg != 0)
+        def _later_group():
+            out_ref[0] = jax.lax.fori_loop(0, group, body, out_ref[0])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "blk",
+                                             "group"))
+def _msm_window_major_grouped_jit(tab, mags, negs, interpret, blk,
+                                  group):
+    from jax.experimental.pallas import tpu as pltpu
+
+    w = tab.shape[-1]
+    assert w % blk == 0, (w, blk)
+    nblk = w // blk
+    nwin = mags.shape[0]
+    grp = group_for(nwin, group)
+    if grp == 1:
+        return _msm_window_major_jit(tab, mags, negs, interpret, blk)
+    ngrp = nwin // grp
+    out_l = _out_lanes(blk)
+    kernel = functools.partial(_window_major_grouped_kernel,
+                               nblk=nblk, group=grp)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 4, fe.NLIMBS, out_l),
+                                       jnp.int32),
+        # g fastest so the tab block (index map ignores g) stays
+        # resident for the whole group; i next so each block sweep
+        # completes before the group closes
+        grid=(ngrp, nblk, grp),
+        in_specs=[
+            pl.BlockSpec((17, 4, fe.NLIMBS, blk),
+                         lambda jg, i, g: (0, 0, 0, i)),
+            pl.BlockSpec((1, 1, blk),
+                         lambda jg, i, g, _grp=grp: (jg * _grp + g, 0, i)),
+            pl.BlockSpec((1, 1, blk),
+                         lambda jg, i, g, _grp=grp: (jg * _grp + g, 0, i)),
+            pl.BlockSpec((fe.NLIMBS, 1), lambda jg, i, g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 4, fe.NLIMBS, out_l),
+                               lambda jg, i, g: (0, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((grp, 4, fe.NLIMBS, out_l),
+                                   jnp.int32)],
+        interpret=interpret,
+    )(tab, mags.reshape(nwin, 1, w),
+      negs.astype(jnp.int32).reshape(nwin, 1, w),
+      jnp.asarray(fe.D2_LIMBS).reshape(fe.NLIMBS, 1))
+    return out[0]
 
 
 # -- fused fold/verify epilogue --------------------------------------------

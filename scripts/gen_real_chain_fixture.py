@@ -1,0 +1,186 @@
+"""Generate tests/fixtures/real_chain_commit.json: a pinned
+CometBFT-wire-format /commit + /validators response pair.
+
+The JSON shapes mirror the reference RPC serializers field by field:
+  - ResultCommit {signed_header{header, commit}, canonical}
+    (/root/reference/rpc/core/blocks.go Commit,
+     /root/reference/rpc/core/types/responses.go ResultCommit)
+  - header ints as decimal strings, hashes as UPPER hex, time as
+    RFC3339Nano (the reference's tmjson conventions for int64,
+    HexBytes, time.Time — /root/reference/types/block.go:603-606)
+  - commit signatures: block_id_flag as a bare int (BlockIDFlag is a
+    byte), validator_address hex, signature base64
+  - validators: pub_key {"type": "tendermint/PubKeyEd25519",
+    "value": b64}, voting_power/proposer_priority as strings
+    (/root/reference/rpc/core/consensus.go Validators)
+
+This environment has no network egress, so the chain is synthetic —
+but every pinned value (header hash, block ID, validator hashes, the
+64-byte signatures over the reference's canonical vote sign-bytes) is
+FROZEN in the committed fixture: the parity test decodes the wire
+JSON with light/rpc_decode, recomputes each hash from first
+principles, and fails on any drift in wire decoding, canonical
+encoding, merkle hashing, or commit verification.  Per-validator
+timestamps differ (as on a real chain), so each signature pins its
+own sign-bytes.
+
+Run once; the output is committed and the test never regenerates it.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from cometbft_tpu.crypto import ed25519  # noqa: E402
+from cometbft_tpu.types import canonical  # noqa: E402
+from cometbft_tpu.types.block import (  # noqa: E402
+    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BlockID, Commit,
+    CommitSig, Consensus, Data, Header, PartSetHeader)
+from cometbft_tpu.types.timestamp import Timestamp  # noqa: E402
+from cometbft_tpu.types.validator_set import (  # noqa: E402
+    Validator, ValidatorSet)
+
+CHAIN_ID = "pin-chain-1"
+HEIGHT = 12
+
+
+def _hexu(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def main() -> None:
+    privs = [ed25519.PrivKey.generate(bytes([0x42 + i]) * 32)
+             for i in range(4)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10 + i)
+                         for i, p in enumerate(privs)])
+    by_addr = {p.pub_key().address(): p for p in privs}
+
+    t_block = Timestamp(1_750_000_000, 123_456_789)
+    header = Header(
+        version=Consensus(11, 2),
+        chain_id=CHAIN_ID,
+        height=HEIGHT,
+        time=t_block,
+        last_block_id=BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32)),
+        last_commit_hash=b"\xcc" * 32,
+        data_hash=Data([]).hash(),
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        consensus_hash=b"\xdd" * 32,
+        app_hash=HEIGHT.to_bytes(8, "big"),
+        last_results_hash=b"\xee" * 32,
+        evidence_hash=Data([]).hash(),
+        proposer_address=vals.validators[0].address,
+    )
+    block_id = BlockID(header.hash(), PartSetHeader(1, b"\x11" * 32))
+
+    sigs = []
+    for i, v in enumerate(vals.validators):
+        if i == 2:      # one absent signer, as on a real chain
+            sigs.append(CommitSig(BLOCK_ID_FLAG_ABSENT, b"",
+                                  Timestamp.zero(), b""))
+            continue
+        ts = Timestamp(t_block.seconds, t_block.nanos + 1000 * i)
+        sb = canonical.vote_sign_bytes(CHAIN_ID, 2, HEIGHT, 0,
+                                       block_id, ts)
+        sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                              by_addr[v.address].sign(sb)))
+    commit = Commit(height=HEIGHT, round=0, block_id=block_id,
+                    signatures=sigs)
+
+    # sanity before pinning
+    vals.verify_commit_light(CHAIN_ID, block_id, HEIGHT, commit)
+
+    def ts_rfc(t: Timestamp) -> str:
+        return t.rfc3339()
+
+    def block_id_json(bid: BlockID) -> dict:
+        return {"hash": _hexu(bid.hash),
+                "parts": {"total": bid.part_set_header.total,
+                          "hash": _hexu(bid.part_set_header.hash)}}
+
+    commit_resp = {
+        "jsonrpc": "2.0", "id": -1,
+        "result": {
+            "signed_header": {
+                "header": {
+                    "version": {"block": "11", "app": "2"},
+                    "chain_id": CHAIN_ID,
+                    "height": str(HEIGHT),
+                    "time": ts_rfc(t_block),
+                    "last_block_id": block_id_json(header.last_block_id),
+                    "last_commit_hash": _hexu(header.last_commit_hash),
+                    "data_hash": _hexu(header.data_hash),
+                    "validators_hash": _hexu(header.validators_hash),
+                    "next_validators_hash":
+                        _hexu(header.next_validators_hash),
+                    "consensus_hash": _hexu(header.consensus_hash),
+                    "app_hash": _hexu(header.app_hash),
+                    "last_results_hash": _hexu(header.last_results_hash),
+                    "evidence_hash": _hexu(header.evidence_hash),
+                    "proposer_address": _hexu(header.proposer_address),
+                },
+                "commit": {
+                    "height": str(HEIGHT),
+                    "round": 0,
+                    "block_id": block_id_json(block_id),
+                    "signatures": [
+                        {"block_id_flag": int(s.block_id_flag),
+                         "validator_address": _hexu(s.validator_address),
+                         "timestamp": ts_rfc(s.timestamp)
+                         if s.block_id_flag == BLOCK_ID_FLAG_COMMIT
+                         else "0001-01-01T00:00:00Z",
+                         "signature": _b64(s.signature)
+                         if s.signature else None}
+                        for s in commit.signatures
+                    ],
+                },
+            },
+            "canonical": True,
+        },
+    }
+    validators_resp = {
+        "jsonrpc": "2.0", "id": -1,
+        "result": {
+            "block_height": str(HEIGHT),
+            "validators": [
+                {"address": _hexu(v.address),
+                 "pub_key": {"type": "tendermint/PubKeyEd25519",
+                             "value": _b64(v.pub_key.bytes())},
+                 "voting_power": str(v.voting_power),
+                 "proposer_priority": str(v.proposer_priority)}
+                for v in vals.validators
+            ],
+            "count": "4", "total": "4",
+        },
+    }
+    out = {
+        "commit_response": commit_resp,
+        "validators_response": validators_resp,
+        "pinned": {
+            "header_hash": _hexu(header.hash()),
+            "validators_hash": _hexu(vals.hash()),
+            "chain_id": CHAIN_ID,
+            "height": HEIGHT,
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "tests", "fixtures",
+                        "real_chain_commit.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    print("wrote", path, "header_hash", _hexu(header.hash()))
+
+
+if __name__ == "__main__":
+    main()

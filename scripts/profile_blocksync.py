@@ -1,0 +1,250 @@
+"""Blocksync replay stage profile (VERDICT r4 item 8): attribute
+ms/block across the stages of the real apply loop
+(blocksync/reactor._try_sync_one) at the BASELINE shape — 10k
+validators, 6667+1 signatures/commit, 24-block verify window — so
+"sig-verify is no longer the bottleneck" is a measured claim with a
+named residual, not an inference.
+
+Stages (real package code, realistic object sizes):
+  collect       ValidatorSet.verify_commit_light(defer_to=batch) —
+                commit structure checks + power tally + sign-bytes
+                (reference analog: types/validation.go:220 per-commit)
+  host_pack     crypto/ed25519.pack_rlc on the window's 160k sigs:
+                SHA-512, per-pubkey aggregation, signed-digit recoding
+  device        pipelined cached-A RLC dispatches (the one device
+                dispatch per window; TPU only — skipped elsewhere)
+  partset       PartSet.from_data(block.to_proto()) — the gossip/store
+                chunking of a block whose last_commit alone is ~730 KB
+  store_write   store.blockstore.save_block to a real on-disk KV store
+  abci_finalize kvstore FinalizeBlock + Commit per block (200 txs)
+
+Each stage logs ms/block and the window total; the JSONL feeds the
+PERF.md "blocksync residual bottleneck" table.
+
+Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
+       flock /tmp/tpu.lock python scripts/profile_blocksync.py [out.jsonl]
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/scripts")
+from _capture_util import already_done, append_log  # noqa: E402
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/blocksync_profile.jsonl"
+
+import os
+
+N_VALS = int(os.environ.get("PROFILE_N_VALS", "10000"))
+SIGNERS = (2 * N_VALS) // 3 + 1          # 6667+1 at 10k
+WINDOW = int(os.environ.get("PROFILE_WINDOW", "24"))
+N_TXS = int(os.environ.get("PROFILE_N_TXS", "200"))
+TX_BYTES = 256
+
+
+def log(**kv):
+    append_log(OUT, kv)
+
+
+def main():
+    t_start = time.time()
+    done = already_done(OUT, lambda r: r.get("stage"))
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types.block import (
+        Block, BlockID, Commit, CommitSig, Data, Header, PartSetHeader,
+        BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_ABSENT)
+    from cometbft_tpu.types.part_set import PartSet
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validation import DeferredSigBatch
+    from cometbft_tpu.types.validator_set import (
+        Validator, ValidatorSet)
+    from cometbft_tpu.types import canonical
+
+    chain_id = "profile-chain"
+
+    # -- fixture: 10k-validator set, 24 commits with 6668 real sigs ----
+    log(stage="fixture_start", n_vals=N_VALS, signers=SIGNERS,
+        window=WINDOW)
+    t0 = time.time()
+    privs = [ed.PrivKey.generate(bytes([i & 0xFF, (i >> 8) & 0xFF])
+                                 + b"\x07" * 30)
+             for i in range(N_VALS)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    # sorted validator order = address order; sign with the FIRST
+    # 6668 in set order so power reaches 2/3+1
+    ordered = [by_addr[v.address] for v in vals.validators]
+
+    blocks = []
+    commits = []
+    ts = Timestamp(1_700_000_000, 0)
+    for h in range(1, WINDOW + 1):
+        header = Header(
+            chain_id=chain_id, height=h, time=ts,
+            validators_hash=vals.hash(), next_validators_hash=vals.hash(),
+            consensus_hash=b"\x01" * 32,
+            app_hash=h.to_bytes(32, "big"),
+            last_results_hash=b"\x02" * 32,
+            proposer_address=vals.validators[0].address)
+        txs = [h.to_bytes(4, "little") + i.to_bytes(4, "little")
+               + bytes(TX_BYTES - 8) for i in range(N_TXS)]
+        blk = Block(header=header, data=Data(txs))
+        blk.fill_header()
+        parts_hdr = PartSetHeader(1, b"\x03" * 32)
+        bid = BlockID(blk.hash(), parts_hdr)
+        sigs = []
+        sb = canonical.vote_sign_bytes(chain_id, 2, h, 0, bid, ts)
+        for i, v in enumerate(vals.validators):
+            if i < SIGNERS:
+                sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address,
+                                      ts, ordered[i].sign(sb)))
+            else:
+                sigs.append(CommitSig(BLOCK_ID_FLAG_ABSENT, b"", ts,
+                                      b""))
+        commits.append(Commit(height=h, round=0, block_id=bid,
+                              signatures=sigs))
+        blocks.append((blk, bid))
+    log(stage="fixture", dt=round(time.time() - t0, 1))
+
+    # -- collect -------------------------------------------------------
+    if "collect" not in done:
+        batch = DeferredSigBatch()
+        t0 = time.time()
+        for (blk, bid), commit in zip(blocks, commits):
+            vals.verify_commit_light(chain_id, bid, commit.height,
+                                     commit, defer_to=batch)
+        dt = time.time() - t0
+        log(stage="collect", ms_per_block=round(1000 * dt / WINDOW, 2),
+            window_s=round(dt, 3), n_sigs=batch.count())
+    else:
+        batch = DeferredSigBatch()
+        for (blk, bid), commit in zip(blocks, commits):
+            vals.verify_commit_light(chain_id, bid, commit.height,
+                                     commit, defer_to=batch)
+
+    # -- host_pack -----------------------------------------------------
+    entries = batch._entries
+    pks = [pub.bytes() for _, _, pub, _, _ in entries]
+    msgs = [m for _, _, _, m, _ in entries]
+    sigs_raw = [s for _, _, _, _, s in entries]
+    if "host_pack" not in done:
+        t0 = time.time()
+        packed = ed.pack_rlc(pks, msgs, sigs_raw)
+        dt = time.time() - t0
+        log(stage="host_pack", ms_per_block=round(1000 * dt / WINDOW, 2),
+            window_s=round(dt, 3), n_sigs=len(pks),
+            a_width=int(packed[0].shape[-1]),
+            r_width=int(packed[1].shape[-1]))
+    else:
+        packed = ed.pack_rlc(pks, msgs, sigs_raw)
+
+    # -- device (TPU only) ---------------------------------------------
+    if "device" not in done:
+        try:
+            import jax
+            from cometbft_tpu.ops import ed25519 as dev
+
+            # jax.devices() HANGS on a wedged axon relay; probe it in a
+            # daemon thread with a deadline so an offline run degrades
+            # to a skip instead of wedging the whole profile
+            import threading
+            box = {}
+
+            def _probe():
+                try:
+                    box["d"] = jax.devices()[0]
+                except Exception as e:      # pragma: no cover
+                    box["err"] = repr(e)
+
+            th = threading.Thread(target=_probe, daemon=True)
+            th.start()
+            th.join(90)
+            d = box.get("d")
+            is_tpu = d is not None and (
+                "tpu" in getattr(d, "device_kind", "").lower()
+                or d.platform == "tpu")
+            if not is_tpu:
+                log(stage="device", skipped="no TPU in this process")
+            else:
+                placed = [jax.device_put(np.asarray(x)) for x in packed]
+                assert ed.rlc_verify(placed, use_cache=True)
+                a_tab, a_ok = ed._A_TABLE_CACHE.get(
+                    np.asarray(placed[0]))
+                dispatch = lambda: dev.rlc_verify_device_cached_a(  # noqa
+                    a_tab, a_ok, *placed[1:])
+                assert bool(np.asarray(dispatch()))
+                iters = 4
+                t0 = time.time()
+                outs = [dispatch() for _ in range(iters)]
+                assert np.asarray(outs[-1])
+                dt = (time.time() - t0) / iters
+                log(stage="device",
+                    ms_per_block=round(1000 * dt / WINDOW, 2),
+                    window_s=round(dt, 3), pipelined_iters=iters)
+        except Exception as e:
+            log(stage="device", err=repr(e)[:500])
+
+    # -- partset -------------------------------------------------------
+    full_blocks = []
+    for i, (blk, bid) in enumerate(blocks):
+        b = Block(header=blk.header, data=blk.data,
+                  last_commit=commits[i - 1] if i else Commit())
+        full_blocks.append(b)
+    if "partset" not in done:
+        t0 = time.time()
+        part_sets = [PartSet.from_data(b.to_proto())
+                     for b in full_blocks]
+        dt = time.time() - t0
+        log(stage="partset", ms_per_block=round(1000 * dt / WINDOW, 2),
+            window_s=round(dt, 3),
+            block_bytes=part_sets[0].byte_size)
+    else:
+        part_sets = [PartSet.from_data(b.to_proto())
+                     for b in full_blocks]
+
+    # -- store_write ---------------------------------------------------
+    if "store_write" not in done:
+        from cometbft_tpu.store.blockstore import BlockStore
+        from cometbft_tpu.store.kv import SQLiteDB
+
+        with tempfile.TemporaryDirectory() as td:
+            db = SQLiteDB(td + "/blockstore.db")
+            store = BlockStore(db)
+            t0 = time.time()
+            for i, b in enumerate(full_blocks):
+                store.save_block(b, part_sets[i], commits[i])
+            dt = time.time() - t0
+            log(stage="store_write",
+                ms_per_block=round(1000 * dt / WINDOW, 2),
+                window_s=round(dt, 3))
+
+    # -- abci_finalize -------------------------------------------------
+    if "abci_finalize" not in done:
+        from cometbft_tpu.abci.types import FinalizeBlockRequest
+        from cometbft_tpu.apps.kvstore import KVStoreApplication
+
+        app = KVStoreApplication()
+        t0 = time.time()
+        for b in full_blocks:
+            req = FinalizeBlockRequest()
+            req.txs = [b"k%d=v" % i for i in range(N_TXS)]
+            req.height = b.header.height
+            app.finalize_block(req)
+            app.commit(None)
+        dt = time.time() - t0
+        log(stage="abci_finalize",
+            ms_per_block=round(1000 * dt / WINDOW, 2),
+            window_s=round(dt, 3), n_txs=N_TXS)
+
+    log(stage="done", total_s=round(time.time() - t_start, 1))
+
+
+if __name__ == "__main__":
+    main()

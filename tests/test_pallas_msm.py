@@ -145,6 +145,7 @@ def _xla_epilogue_verdict(pa, pr):
     return bool(dev.point_is_identity(total)[0])
 
 
+@pytest.mark.slow
 def test_fold_verify_matches_xla():
     """Fused fold/verify epilogue vs the XLA reference at tile 8 (the
     halving/butterfly argument is width-independent; real Mosaic at
@@ -165,6 +166,7 @@ def test_fold_verify_matches_xla():
     assert got is False
 
 
+@pytest.mark.slow
 def test_fold_verify_chunk_sum_width():
     """A 3*tile-lane partial tensor takes the chunk-sum branch of
     _tree_to_tile (m odd after halving).  tile 4 keeps the interpret
@@ -215,6 +217,7 @@ def test_rlc_dispatches_fold_verify(monkeypatch):
     assert len(msm_calls) >= 2            # both MSM sides produced partials
 
 
+@pytest.mark.slow
 def test_msm_window_major_matches_scan():
     """The window-major kernel (blocks inner, ONE global accumulator,
     doublings once per window) equals the XLA shared-doubling scan —
@@ -270,6 +273,7 @@ def test_msm_scan_dispatches_window_major(monkeypatch):
     assert _pt_eq(want, got)
 
 
+@pytest.mark.slow
 def test_pallas_decompress_matches_xla():
     """Fused decompress vs ops/ed25519.decompress on valid encodings,
     torsion/low-order points, and invalid (non-square) encodings."""
@@ -431,6 +435,7 @@ def test_msm_scan_dispatches_select_tree(monkeypatch):
     assert _pt_eq(want, got)
 
 
+@pytest.mark.slow
 def test_pallas_table17_neg_matches_xla():
     """Fused table-build kernel vs _table17(point_neg(p)): every row
     k*(-P) for k=0..16, both blocks of a 2-block grid.  One jitted
@@ -482,10 +487,16 @@ def test_blk_for_non_pow2_override(monkeypatch):
     monkeypatch.setattr(pm, "BLK", 384)
     assert pm.blk_for(4096) == 256
     assert pm.blk_for(128) == 128
+    # >= 128 blocks are pow2-only: the in-kernel tree halves exactly
+    # onto the 128-lane scratch, which 384 -> 192 -> 96 would miss
+    assert pm.blk_for(768) == 256
     monkeypatch.setattr(pm, "BLK", 512)
     assert pm.blk_for(4096) == 512
-    monkeypatch.setattr(pm, "BLK", 96)   # sub-128 test override: pow2 floor
+    monkeypatch.setattr(pm, "BLK", 96)   # sub-128 test blocks: any size
     assert pm.blk_for(64) == 64
+    assert pm.blk_for(192) == 96
+    monkeypatch.setattr(pm, "BLK", -5)
+    assert pm.blk_for(4096) is None
 
 
 def test_prefold_odd_tile_width(monkeypatch):
@@ -500,3 +511,38 @@ def test_prefold_odd_tile_width(monkeypatch):
     got = dev._prefold(pts)
     assert got.shape[-1] == 256
     assert _pt_eq(want, dev._tree_reduce(got, 1))
+
+
+def test_group_for_divisor_degradation():
+    """Requested window groups degrade to the largest divisor of the
+    side's window count (52-window A sides vs 26-window R sides)."""
+    assert pm.group_for(6, 4) == 3
+    assert pm.group_for(52, 8) == 4
+    assert pm.group_for(52, 16) == 13
+    assert pm.group_for(26, 16) == 13
+    assert pm.group_for(26, 4) == 2
+    assert pm.group_for(7, 4) == 1      # prime: grouped == ungrouped
+
+
+@pytest.mark.slow
+def test_msm_window_major_grouped_matches_scan():
+    """The grouped window-major kernel (G windows per table fetch, per-
+    window VMEM scratch accumulators, fori_loop group-close doubling
+    chain) equals the XLA shared-doubling scan.  Slow tier: each
+    interpret compile is ~3.5 min on one core (the kernel also has
+    real-Mosaic parity probes in scripts/mosaic_smoke5.py and A/B
+    coverage in scripts/ab_round5.py).  Combos cover multiblock wacc
+    accumulation (blk 8), divisor degradation (4 -> 3), the jg != 0
+    later-group close, single-block grids, and group == nwin."""
+    nwin = 6
+    rng = np.random.default_rng(29)
+    tab = dev._table17(_points(W))
+    mags = jnp.asarray(rng.integers(0, 17, (nwin, W), dtype=np.int32))
+    negs = jnp.asarray(rng.integers(0, 2, (nwin, W)) != 0)
+    want = dev._msm_scan(tab, mags, negs)
+    for blk, grp in ((8, 4), (W, 2), (8, 6)):
+        got = pm.msm_window_major(tab, mags, negs, interpret=True,
+                                  blk=blk, group=grp)
+        assert got.shape[-1] == pm._out_lanes(blk), (blk, grp)
+        assert _pt_eq(want, dev._tree_reduce(jnp.asarray(got), 1)), \
+            (blk, grp)

@@ -172,3 +172,19 @@ class TestSecpKernel:
         mv.add(sp.pub_key(), b"m2", sp.sign(b"OTHER"))
         ok, verdicts = mv.verify()
         assert not ok and verdicts == [True, True, False]
+
+
+def test_secp_auto_routes_host_below_crossover(monkeypatch):
+    """auto provider routes secp sub-batches below the measured
+    host/device crossover (no RLC equation for ECDSA: the dispatch
+    floor dominates small batches) to the CPU verifier, while ed25519
+    keeps its own much lower threshold."""
+    from cometbft_tpu.crypto import batch as cb
+
+    v = cb.create_batch_verifier("secp256k1", n_hint=64, provider="auto")
+    assert isinstance(v, cb.CpuSecp256k1BatchVerifier)
+    v = cb.create_batch_verifier("secp256k1", n_hint=256,
+                                 provider="auto")
+    assert isinstance(v, cb.TpuSecp256k1BatchVerifier)
+    v = cb.create_batch_verifier("ed25519", n_hint=64, provider="auto")
+    assert isinstance(v, cb.TpuEd25519BatchVerifier)
