@@ -459,11 +459,6 @@ def main() -> None:
     # way a sustained pipeline would see it
     passes = int(os.environ.get("BENCH_HEADLINE_PASSES", "3"))
     rlc = bench_rlc(batch, iters, passes=passes)  # distinct keys: one
-    # the fresh headline exists THIS instant: retire the pre-headline
-    # protection before anything else (the extras-merge below runs git
-    # subprocesses — a watchdog deadline or driver SIGTERM crossing
-    # that window must not discard a measured number; review finding)
-    headline_done.set()
     extra = {                                     # sig/validator
         "rlc_batch": batch,
         "rlc_keys": "distinct (one per signature)",
@@ -487,8 +482,13 @@ def main() -> None:
         print(json.dumps(payload), flush=True)
         os._exit(0)
 
+    # ordering matters (review finding): the fresh-headline handler
+    # must be armed BEFORE the watchdog retires — between bench_rlc's
+    # return and here only microsecond dict literals ran, the smallest
+    # window achievable without signal masking
     signal.signal(signal.SIGTERM, _fresh_headline_term)
     signal.signal(signal.SIGINT, _fresh_headline_term)
+    headline_done.set()
 
     # -- extras merge (VERDICT r4 weak #2): pre-seed every secondary
     # metric from the last good committed capture so a watchdog kill or

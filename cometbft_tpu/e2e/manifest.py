@@ -64,6 +64,10 @@ class Manifest:
     initial_height: int = 1
     load_tx_rate: int = 10           # txs/sec injected during the run
     run_blocks: int = 8              # target height before teardown
+    pbts: bool = False               # proposer-based timestamps from
+                                     # height 1 (feature.PbtsEnableHeight
+                                     # — wall-anchored header times; the
+                                     # latency bench needs them)
 
     @staticmethod
     def parse(text: str) -> "Manifest":
@@ -71,7 +75,8 @@ class Manifest:
         m = Manifest(
             initial_height=int(data.get("initial_height", 1)),
             load_tx_rate=int(data.get("load_tx_rate", 10)),
-            run_blocks=int(data.get("run_blocks", 8)))
+            run_blocks=int(data.get("run_blocks", 8)),
+            pbts=bool(data.get("pbts", False)))
         for name, spec in (data.get("node") or {}).items():
             m.nodes.append(NodeManifest(
                 name=name,

@@ -161,6 +161,12 @@ class Testnet:
         # (types/params.go ValidateBasic against ABCIPubKeyTypes)
         genesis.consensus_params.validator.pub_key_types = sorted(
             key_types | {"ed25519"})
+        if self.manifest.pbts:
+            # wall-anchored header times (state/state.py make_block):
+            # without PBTS, header h carries the MEDIAN of height h-1's
+            # vote timestamps, which lags wall clock by a block — the
+            # loadtime latency report needs proposer timestamps
+            genesis.consensus_params.feature.pbts_enable_height = 1
 
         # worst-case RTT between any pair: both endpoints delay their
         # sends, so timeouts must absorb the SUM of two one-way delays

@@ -85,8 +85,10 @@ def main():
         dev._jitted = jax.jit(dev.verify_kernel)
 
     # 1: grouped window-major.  G=1 arms re-baseline the shipping stack
-    # in THIS queue's relay conditions so deltas are same-day; ordering
-    # alternates so a mid-queue wedge still leaves a contrast pair.
+    # in THIS queue's relay conditions so deltas are same-day; the G=1
+    # baseline runs FIRST within each batch, so a mid-queue wedge
+    # leaves the baseline banked and resume-skip retries only the
+    # wedged grouped arm on the next healthy window.
     for batch in (32767, 65535):
         for group in (1, 4, 13):
             if _skip(done, "win_group_ab", group=group, batch=batch):

@@ -190,3 +190,15 @@ def test_e2e_generated_statesync_and_mixed_keys(tmp_path):
         assert net.check_txs_committed(txs) == len(txs)
     finally:
         net.stop()
+
+
+def test_manifest_pbts_knob():
+    """pbts=true in a manifest enables proposer-based timestamps from
+    height 1 in the generated genesis (wall-anchored header times for
+    the latency bench)."""
+    from cometbft_tpu.e2e.manifest import Manifest
+
+    m = Manifest.parse("pbts = true\n[node.a]\nmode = \"validator\"\n")
+    assert m.pbts is True
+    m2 = Manifest.parse("[node.a]\nmode = \"validator\"\n")
+    assert m2.pbts is False
