@@ -177,10 +177,27 @@ class CommitSig:
             raise ValueError("signature too big")
 
     def to_proto(self) -> bytes:
-        return (pw.Writer().int_field(1, self.block_id_flag)
-                .bytes_field(2, self.validator_address)
-                .message_field(3, self.timestamp.to_proto())
-                .bytes_field(4, self.signature).bytes())
+        # inline fast path (byte parity with the Writer form pinned by
+        # tests): a 6668-sig commit serializes on every save_block and
+        # gossip send — per-sig Writer objects were the top residual
+        # of the blocksync stage profile (scripts/profile_blocksync.py)
+        ts = self.timestamp.to_proto()
+        uv = pw.encode_uvarint
+        out = bytearray()
+        if self.block_id_flag:
+            # mask like Writer.int_field: a decoded NEGATIVE flag (a
+            # peer's sign-extended varint) must re-encode to the same
+            # 10-byte form, not raise — the reject happens later via
+            # hash mismatch / validate_basic, as before
+            out += b"\x08" + uv(self.block_id_flag & pw.MASK64)
+        va = self.validator_address
+        if va:
+            out += b"\x12" + uv(len(va)) + va
+        out += b"\x1a" + uv(len(ts)) + ts
+        sig = self.signature
+        if sig:
+            out += b"\x22" + uv(len(sig)) + sig
+        return bytes(out)
 
     @staticmethod
     def from_proto(payload: bytes) -> "CommitSig":
@@ -207,19 +224,37 @@ class Commit:
     round: int = 0
     block_id: BlockID = field(default_factory=BlockID)
     signatures: list[CommitSig] = field(default_factory=list)
-    _hash: bytes | None = None
+    # memo caches: never part of equality/repr — calling hash() or
+    # to_proto() must not change what a commit compares equal to
+    _hash: bytes | None = field(default=None, compare=False, repr=False)
+    _proto: bytes | None = field(default=None, compare=False,
+                                 repr=False)
 
     def size(self) -> int:
         return len(self.signatures)
 
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Canonical sign-bytes for validator val_idx's precommit
-        (block.go:897, vote.go:150)."""
-        from . import canonical
+        (block.go:897, vote.go:150).  Uses per-commit templates — the
+        canonical vote differs between signatures ONLY in the
+        timestamp (and nil-vs-commit BlockID), so the 6667-sig verify
+        loop pays O(1) writer calls per signature."""
         sig = self.signatures[val_idx]
-        return canonical.vote_sign_bytes(
-            chain_id, PRECOMMIT, self.height, self.round,
-            sig.block_id(self.block_id), sig.timestamp)
+        tpl = getattr(self, "_sb_tpl", None)
+        if tpl is None or tpl[0] != (chain_id, self.height, self.round,
+                                     self.block_id):
+            from . import canonical
+            mk_commit = canonical.vote_sign_bytes_template(
+                chain_id, PRECOMMIT, self.height, self.round,
+                self.block_id)
+            mk_nil = canonical.vote_sign_bytes_template(
+                chain_id, PRECOMMIT, self.height, self.round, BlockID())
+            tpl = ((chain_id, self.height, self.round, self.block_id),
+                   mk_commit, mk_nil)
+            self._sb_tpl = tpl
+        if sig.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return tpl[1](sig.timestamp)
+        return tpl[2](sig.timestamp)
 
     def hash(self) -> bytes:
         if self._hash is None:
@@ -265,12 +300,21 @@ class Commit:
                 sig.validate_basic()
 
     def to_proto(self) -> bytes:
-        w = (pw.Writer().int_field(1, self.height)
-             .int_field(2, self.round)
-             .message_field(3, self.block_id.to_proto()))
-        for sig in self.signatures:
-            w.message_field(4, sig.to_proto())
-        return w.bytes()
+        # memoized under the same write-once assumption _hash already
+        # makes: a blocksync window serializes each commit 2-3 times
+        # (seen commit at h, last_commit at h+1, the h+1 block's part
+        # set), and a 6668-sig serialization costs ~33 ms
+        if self._proto is None:
+            uv = pw.encode_uvarint
+            out = bytearray(
+                pw.Writer().int_field(1, self.height)
+                .int_field(2, self.round)
+                .message_field(3, self.block_id.to_proto()).bytes())
+            for sig in self.signatures:
+                p = sig.to_proto()
+                out += b"\x22" + uv(len(p)) + p
+            self._proto = bytes(out)
+        return self._proto
 
     @staticmethod
     def from_proto(payload: bytes) -> "Commit":

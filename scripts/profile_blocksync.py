@@ -53,6 +53,17 @@ def log(**kv):
 
 
 def main():
+    if os.environ.get("PROFILE_STACK_DUMP") == "1":
+        import faulthandler
+        faulthandler.dump_traceback_later(90, repeat=True)
+    if os.environ.get("PROFILE_PLATFORM") == "cpu":
+        # offline runs: force CPU via jax.config — the sitecustomize
+        # axon patch ignores JAX_PLATFORMS, and a 10k-validator
+        # vals.hash() device-routes its merkle (ops/sha2), hanging
+        # backend init on a wedged relay.  The watch loop omits this
+        # (it just probed the relay healthy).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     t_start = time.time()
     # wedge-skip discipline (the r4 BENCH_live lesson): a stage that
     # dies in a native call leaves only its start marker; after 2

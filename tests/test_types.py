@@ -334,3 +334,94 @@ def test_vote_proposal_proto_zero_defaults():
     rt2 = Proposal.from_proto(p.to_proto())
     assert rt2.pol_round == 0
     assert rt2.sign_bytes(CHAIN_ID) == p.sign_bytes(CHAIN_ID)
+
+
+def test_commit_sig_proto_fast_path_parity():
+    """CommitSig.to_proto's inline encoder must match the generic
+    Writer form byte for byte (consensus-critical bytes feed the
+    commit merkle hash)."""
+    from cometbft_tpu.libs import protowire as pw
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL,
+        CommitSig)
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    def writer_form(cs):
+        return (pw.Writer().int_field(1, cs.block_id_flag)
+                .bytes_field(2, cs.validator_address)
+                .message_field(3, cs.timestamp.to_proto())
+                .bytes_field(4, cs.signature).bytes())
+
+    cases = [
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x41" * 20,
+                  Timestamp(1_700_000_000, 123), b"\x42" * 64),
+        CommitSig(BLOCK_ID_FLAG_ABSENT, b"", Timestamp.zero(), b""),
+        CommitSig(BLOCK_ID_FLAG_NIL, b"\x07" * 20,
+                  Timestamp(1, 0), b"\x01" * 64),
+        CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x09" * 20,
+                  Timestamp(0, 0), b"\xff" * 64),
+        # a decoded NEGATIVE flag (peer's sign-extended varint) must
+        # re-encode to the masked 10-byte form, not raise — the reject
+        # happens later via hash mismatch / validate_basic
+        CommitSig(-3, b"\x09" * 20, Timestamp(7, 0), b"\x02" * 64),
+    ]
+    for cs in cases:
+        assert cs.to_proto() == writer_form(cs), cs
+        assert CommitSig.from_proto(cs.to_proto()) == cs
+
+
+def test_commit_equality_unchanged_by_serialization():
+    """to_proto()/hash() memoization must not leak into __eq__: a
+    serialized commit still equals a logically identical fresh one."""
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT, BlockID, Commit, CommitSig,
+        PartSetHeader)
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    def make():
+        return Commit(
+            height=9, round=1,
+            block_id=BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32)),
+            signatures=[CommitSig(BLOCK_ID_FLAG_COMMIT, b"\x41" * 20,
+                                  Timestamp(5, 6), b"\x42" * 64)])
+
+    a, b = make(), make()
+    assert a == b
+    a.to_proto()
+    a.hash()
+    assert a == b
+    assert Commit.from_proto(a.to_proto()) == a
+
+
+def test_vote_sign_bytes_template_parity():
+    """The per-commit sign-bytes template splices timestamps into
+    prebuilt surroundings; output must equal the full canonical
+    builder for commit-flag AND nil-flag signatures across timestamp
+    shapes (zero, nanos, large)."""
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL, BlockID, Commit,
+        CommitSig, PartSetHeader, PRECOMMIT)
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    bid = BlockID(b"\x11" * 32, PartSetHeader(3, b"\x22" * 32))
+    stamps = [Timestamp.zero(), Timestamp(1, 0),
+              Timestamp(1_700_000_000, 999_999_999),
+              Timestamp(2 ** 33, 1)]
+    commit = Commit(height=77, round=2, block_id=bid, signatures=[
+        CommitSig(BLOCK_ID_FLAG_COMMIT if i % 2 == 0
+                  else BLOCK_ID_FLAG_NIL,
+                  b"\x07" * 20, ts, b"\x01" * 64)
+        for i, ts in enumerate(stamps)])
+    for idx, cs in enumerate(commit.signatures):
+        want = canonical.vote_sign_bytes(
+            "tpl-chain", PRECOMMIT, 77, 2, cs.block_id(bid),
+            cs.timestamp)
+        got = commit.vote_sign_bytes("tpl-chain", idx)
+        assert got == want, (idx, cs.block_id_flag)
+    # a SECOND chain id must rebuild the template, not reuse it
+    for idx, cs in enumerate(commit.signatures):
+        want = canonical.vote_sign_bytes(
+            "other-chain", PRECOMMIT, 77, 2, cs.block_id(bid),
+            cs.timestamp)
+        assert commit.vote_sign_bytes("other-chain", idx) == want
