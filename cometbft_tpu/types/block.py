@@ -310,9 +310,15 @@ class Commit:
                 pw.Writer().int_field(1, self.height)
                 .int_field(2, self.round)
                 .message_field(3, self.block_id.to_proto()).bytes())
-            for sig in self.signatures:
-                p = sig.to_proto()
-                out += b"\x22" + uv(len(p)) + p
+            from ..libs import native_codec
+            sig_section = native_codec.encode_commit_sigs(
+                self.signatures)
+            if sig_section is not None:
+                out += sig_section
+            else:
+                for sig in self.signatures:
+                    p = sig.to_proto()
+                    out += b"\x22" + uv(len(p)) + p
             self._proto = bytes(out)
         return self._proto
 

@@ -1,0 +1,132 @@
+// Native protowire encoder for the repeated-CommitSig section of a
+// Commit (the blocksync/store/gossip hot loop: a 6668-signature commit
+// costs ~33 ms in the pure-Python encoder; this does the same bytes in
+// well under a millisecond).  Wire semantics mirror
+// cometbft_tpu/libs/protowire.Writer exactly (gogoproto conventions,
+// see that module's docstring; reference marshallers:
+// /root/reference/api/cometbft/types/v1/types.pb.go CommitSig):
+//   - proto3 zero scalars/bytes omitted
+//   - nullable=false embedded Timestamp ALWAYS emitted (field 3)
+//   - negative int64 varints sign-extend to 10 bytes (mask to uint64)
+// Parity with the Python encoder is pinned by
+// tests/test_libs.py test_native_commit_codec_parity.
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline long put_uvarint(unsigned char* out, unsigned long long v) {
+    long n = 0;
+    while (v >= 0x80) {
+        out[n++] = (unsigned char)(v) | 0x80;
+        v >>= 7;
+    }
+    out[n++] = (unsigned char)v;
+    return n;
+}
+
+inline long uvarint_len(unsigned long long v) {
+    long n = 1;
+    while (v >= 0x80) { v >>= 7; ++n; }
+    return n;
+}
+
+// Timestamp message body: field1 varint seconds, field2 varint nanos,
+// zeros omitted (int_field semantics: mask int64/int32 to uint64)
+inline long put_timestamp(unsigned char* out, long long sec, int nano) {
+    long n = 0;
+    if (sec != 0) {
+        out[n++] = 0x08;
+        n += put_uvarint(out + n, (unsigned long long)sec);
+    }
+    if (nano != 0) {
+        out[n++] = 0x10;
+        n += put_uvarint(out + n, (unsigned long long)(long long)nano);
+    }
+    return n;
+}
+
+inline long timestamp_len(long long sec, int nano) {
+    long n = 0;
+    if (sec != 0) n += 1 + uvarint_len((unsigned long long)sec);
+    if (nano != 0) n += 1 + uvarint_len((unsigned long long)(long long)nano);
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encodes n CommitSigs, each wrapped as Commit field 4
+// (0x22 <len> <CommitSig payload>), concatenated.  Columnar inputs;
+// addr/sig are offset-indexed blobs (absent sigs: empty slices).
+// Returns bytes written, or -1 if out_cap is too small.
+long pw_encode_commit_sigs(
+    long n,
+    const long long* flags,
+    const int* addr_off, const unsigned char* addr_blob,
+    const long long* ts_sec, const int* ts_nano,
+    const int* sig_off, const unsigned char* sig_blob,
+    unsigned char* out, long out_cap) {
+    long w = 0;
+    for (long i = 0; i < n; ++i) {
+        const long alen = addr_off[i + 1] - addr_off[i];
+        const long slen = sig_off[i + 1] - sig_off[i];
+        const long tlen = timestamp_len(ts_sec[i], ts_nano[i]);
+        long payload = 0;
+        if (flags[i] != 0)
+            payload += 1 + uvarint_len((unsigned long long)flags[i]);
+        if (alen) payload += 1 + uvarint_len(alen) + alen;
+        payload += 1 + uvarint_len(tlen) + tlen;   // ts always emitted
+        if (slen) payload += 1 + uvarint_len(slen) + slen;
+        const long total = 1 + uvarint_len(payload) + payload;
+        if (w + total > out_cap) return -1;
+        out[w++] = 0x22;
+        w += put_uvarint(out + w, payload);
+        if (flags[i] != 0) {
+            out[w++] = 0x08;
+            w += put_uvarint(out + w, (unsigned long long)flags[i]);
+        }
+        if (alen) {
+            out[w++] = 0x12;
+            w += put_uvarint(out + w, alen);
+            memcpy(out + w, addr_blob + addr_off[i], alen);
+            w += alen;
+        }
+        out[w++] = 0x1a;
+        w += put_uvarint(out + w, tlen);
+        w += put_timestamp(out + w, ts_sec[i], ts_nano[i]);
+        if (slen) {
+            out[w++] = 0x22;
+            w += put_uvarint(out + w, slen);
+            memcpy(out + w, sig_blob + sig_off[i], slen);
+            w += slen;
+        }
+    }
+    return w;
+}
+
+int pw_codec_selftest(void) {
+    // one COMMIT sig: flag 2, 2-byte addr, ts(5, 6), 3-byte sig
+    long long flags[1] = {2};
+    int aoff[2] = {0, 2};
+    unsigned char ab[2] = {0x41, 0x42};
+    long long sec[1] = {5};
+    int nano[1] = {6};
+    int soff[2] = {0, 3};
+    unsigned char sb[3] = {1, 2, 3};
+    unsigned char out[64];
+    long n = pw_encode_commit_sigs(1, flags, aoff, ab, sec, nano,
+                                   soff, sb, out, sizeof out);
+    const unsigned char want[] = {
+        0x22, 0x11,                    // field4, len 17
+        0x08, 0x02,                    // flag 2
+        0x12, 0x02, 0x41, 0x42,        // addr
+        0x1a, 0x04, 0x08, 0x05, 0x10, 0x06,  // ts {sec:5, nanos:6}
+        0x22, 0x03, 0x01, 0x02, 0x03,  // sig
+    };
+    if (n != (long)sizeof want) return 1;
+    return memcmp(out, want, sizeof want) ? 2 : 0;
+}
+
+}  // extern "C"

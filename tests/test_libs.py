@@ -216,3 +216,49 @@ class TestTmJson:
         back = tmjson.unmarshal(tmjson.marshal(ev))
         assert isinstance(back, DuplicateVoteEvidence)
         assert back.vote_a.block_id.hash == b"\x01" * 32
+
+
+def test_native_commit_codec_parity(monkeypatch):
+    """The C commit codec (native/protowire) must produce byte-
+    identical repeated-CommitSig sections to the pure-Python encoder —
+    consensus-critical bytes (commit hash, store, gossip) — across
+    absent/nil/commit/negative flags, empty and present fields."""
+    import random
+
+    from cometbft_tpu.libs import native_codec
+    from cometbft_tpu.libs import protowire as pw
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL,
+        CommitSig)
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    if not native_codec.build():
+        pytest.skip("g++ unavailable")
+    assert native_codec.enabled()
+    monkeypatch.setattr(native_codec, "MIN_SIGS", 64)
+
+    rng = random.Random(11)
+
+    def rand_sig():
+        kind = rng.randrange(4)
+        if kind == 0:
+            return CommitSig(BLOCK_ID_FLAG_ABSENT, b"",
+                             Timestamp.zero(), b"")
+        flag = [BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL, -3][kind - 1]
+        return CommitSig(
+            flag, rng.randbytes(20),
+            Timestamp(rng.randrange(0, 2 ** 33),
+                      rng.randrange(0, 10 ** 9)),
+            rng.randbytes(64))
+
+    sigs = [rand_sig() for _ in range(300)]
+    native = native_codec.encode_commit_sigs(sigs)
+    assert native is not None
+    uv = pw.encode_uvarint
+    pure = bytearray()
+    for s in sigs:
+        p = s.to_proto()
+        pure += b"\x22" + uv(len(p)) + p
+    assert native == bytes(pure)
+    # below the gather-amortization floor the native path declines
+    assert native_codec.encode_commit_sigs(sigs[:8]) is None
