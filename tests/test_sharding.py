@@ -59,3 +59,38 @@ def test_batch_verifier_uses_sharded_path():
     assert not ok
     assert verdicts[2] is False or verdicts[2] == False  # noqa: E712
     assert sum(bool(v) for v in verdicts) == 9
+
+
+@pytest.mark.slow
+def test_sharded_pallas_msm_interpret():
+    """ops/msm_shard.sharded_msm on the 8-device CPU mesh, interpret
+    mode: the SHIPPING window-major kernel runs per device on its lane
+    shard; the all_gather + group-addition fold must equal the single-
+    device XLA scan (the driver's dryrun phase 4, as a local
+    regression test).  Slow tier: ~9-10 min wall on one core
+    (shard_map multiplies the interpret compile)."""
+    import jax.numpy as jnp
+
+    from cometbft_tpu.ops import msm_shard
+    from cometbft_tpu.ops import fe
+
+    n_dev = sharding.device_count()
+    w = 4 * n_dev
+    pks, msgs, sigs_ = _sigs(w)
+    enc = np.stack([np.frombuffer(pk, dtype="<u4") for pk in pks],
+                   axis=1)
+    tab, ok = dev._msm_tables(jnp.asarray(enc))
+    assert bool(np.asarray(ok))
+    rng = np.random.default_rng(3)
+    nwin = 4
+    mags = jnp.asarray(rng.integers(0, 17, (nwin, w), dtype=np.int32))
+    negs = jnp.asarray(rng.integers(0, 2, (nwin, w)) != 0)
+    want = dev._msm_scan(tab, mags, negs)
+    got = msm_shard.sharded_msm(tab, mags, negs,
+                                mesh=sharding._mesh(),
+                                interpret=True, blk=4, group=1)
+    x_eq = np.asarray(fe.freeze(fe.mul(got[0], want[2]))) \
+        == np.asarray(fe.freeze(fe.mul(want[0], got[2])))
+    y_eq = np.asarray(fe.freeze(fe.mul(got[1], want[2]))) \
+        == np.asarray(fe.freeze(fe.mul(want[1], got[2])))
+    assert x_eq.all() and y_eq.all()
