@@ -198,6 +198,42 @@ def main():
             log("prod5_blocksync", group=best_g, blocks_per_dispatch=48,
                 error=repr(e)[:200])
 
+    # 4: follow-up levers at the winning config — (a) blk 1024 with
+    # grouping (the r4b blk sweep predates the grouped kernel: bigger
+    # blocks halve the per-window tree's share but double the VMEM
+    # table block), (b) pipeline depth 16 (quantifies how much of the
+    # headline is still per-dispatch overhead at the winning width).
+    dflt_blk = pallas_msm.BLK
+    if not _skip(done, "blk_group_ab", group=best_g, batch=best_batch):
+        pallas_msm.WIN_GROUP = best_g
+        pallas_msm.BLK = 1024
+        refresh_jits()
+        log("blk_group_ab", group=best_g, batch=best_batch, start=True)
+        try:
+            r = bench.bench_rlc(best_batch, 8, passes=3)
+            log("blk_group_ab", group=best_g, batch=best_batch,
+                sigs_per_sec=round(r, 1),
+                pass_rates=bench.bench_rlc.last_pass_rates,
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("blk_group_ab", group=best_g, batch=best_batch,
+                error=repr(e)[:200])
+        pallas_msm.BLK = dflt_blk
+        refresh_jits()
+    if not _skip(done, "iters16_ab", group=best_g, batch=best_batch):
+        pallas_msm.WIN_GROUP = best_g
+        refresh_jits()
+        log("iters16_ab", group=best_g, batch=best_batch, start=True)
+        try:
+            r = bench.bench_rlc(best_batch, 16, passes=3)
+            log("iters16_ab", group=best_g, batch=best_batch,
+                sigs_per_sec=round(r, 1),
+                pass_rates=bench.bench_rlc.last_pass_rates,
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("iters16_ab", group=best_g, batch=best_batch,
+                error=repr(e)[:200])
+
     pallas_msm.WIN_GROUP = dflt_group
     log("done", t=round(time.time() - t0, 1))
 
