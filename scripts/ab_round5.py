@@ -84,6 +84,22 @@ def main():
         dev._a_tables_jitted = jax.jit(dev._msm_tables)
         dev._jitted = jax.jit(dev.verify_kernel)
 
+    def run_arm(name, fn, result_key="sigs_per_sec", nd=1,
+                rates=False, **key):
+        """One arm: skip-if-settled, start marker, measure, log.  The
+        shared stanza every arm previously copy-pasted (r5 review)."""
+        if _skip(done, name, **key):
+            return
+        log(name, **key, start=True)
+        try:
+            r = fn()
+            rec = {result_key: round(r, nd)}
+            if rates:
+                rec["pass_rates"] = bench.bench_rlc.last_pass_rates
+            log(name, **key, **rec, t=round(time.time() - t0, 1))
+        except Exception as e:
+            log(name, **key, error=repr(e)[:200])
+
     # 1: grouped window-major.  G=1 arms re-baseline the shipping stack
     # in THIS queue's relay conditions so deltas are same-day; the G=1
     # baseline runs FIRST within each batch, so a mid-queue wedge
@@ -91,35 +107,21 @@ def main():
     # wedged grouped arm on the next healthy window.
     for batch in (32767, 65535):
         for group in (1, 4, 13):
-            if _skip(done, "win_group_ab", group=group, batch=batch):
-                continue
-            pallas_msm.WIN_GROUP = group
-            refresh_jits()
-            log("win_group_ab", group=group, batch=batch, start=True)
-            try:
-                r = bench.bench_rlc(batch, 8, passes=3)
-                log("win_group_ab", group=group, batch=batch,
-                    sigs_per_sec=round(r, 1),
-                    pass_rates=bench.bench_rlc.last_pass_rates,
-                    t=round(time.time() - t0, 1))
-            except Exception as e:
-                log("win_group_ab", group=group, batch=batch,
-                    error=repr(e)[:200])
+            def _arm(batch=batch, group=group):
+                pallas_msm.WIN_GROUP = group
+                refresh_jits()
+                return bench.bench_rlc(batch, 8, passes=3)
+            run_arm("win_group_ab", _arm, rates=True,
+                    group=group, batch=batch)
     pallas_msm.WIN_GROUP = dflt_group
     refresh_jits()
 
     # 2: secp256k1 batch-width sweep (kernel unchanged: the lever is
     # dispatch-overhead amortization)
     for batch in (1024, 4096, 16383):
-        if _skip(done, "secp_batch_ab", batch=batch):
-            continue
-        log("secp_batch_ab", batch=batch, start=True)
-        try:
-            r = bench.bench_secp(batch, 6)
-            log("secp_batch_ab", batch=batch, sigs_per_sec=round(r, 1),
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("secp_batch_ab", batch=batch, error=repr(e)[:200])
+        run_arm("secp_batch_ab",
+                lambda batch=batch: bench.bench_secp(batch, 6),
+                batch=batch)
 
     # 3: prod5 re-measures at the best measured (group, batch).  Best
     # is picked from THIS file so resume is deterministic.
@@ -145,94 +147,51 @@ def main():
         sigs_per_sec=best_rate)
     pallas_msm.WIN_GROUP = best_g
     refresh_jits()
-
     done = _already_done()
-    if not _skip(done, "prod5_rlc_fused", group=best_g,
-                 batch=best_batch):
-        log("prod5_rlc_fused", group=best_g, batch=best_batch,
-            start=True)
-        try:
-            r = bench.bench_rlc(best_batch, 8, passes=3)
-            log("prod5_rlc_fused", group=best_g, batch=best_batch,
-                sigs_per_sec=round(r, 1),
-                pass_rates=bench.bench_rlc.last_pass_rates,
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("prod5_rlc_fused", group=best_g, batch=best_batch,
-                error=repr(e)[:200])
-    if not _skip(done, "prod5_rlc_cached", group=best_g,
-                 batch=best_batch):
-        log("prod5_rlc_cached", group=best_g, batch=best_batch,
-            start=True)
-        try:
-            r = bench.bench_rlc(best_batch, 8, use_cache=True, passes=3)
-            log("prod5_rlc_cached", group=best_g, batch=best_batch,
-                sigs_per_sec=round(r, 1),
-                pass_rates=bench.bench_rlc.last_pass_rates,
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("prod5_rlc_cached", group=best_g, batch=best_batch,
-                error=repr(e)[:200])
-    if not _skip(done, "prod5_light", group=best_g,
-                 commits_per_dispatch=384):
-        log("prod5_light", group=best_g, commits_per_dispatch=384,
-            start=True)
-        try:
-            r = bench.bench_light_headers(150, 8, 384)
-            log("prod5_light", group=best_g, commits_per_dispatch=384,
-                headers_per_sec=round(r, 1),
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("prod5_light", group=best_g, commits_per_dispatch=384,
-                error=repr(e)[:200])
-    if not _skip(done, "prod5_blocksync", group=best_g,
-                 blocks_per_dispatch=48):
-        log("prod5_blocksync", group=best_g, blocks_per_dispatch=48,
-            start=True)
-        try:
-            r = bench.bench_blocksync(10_000, 48, 4)
-            log("prod5_blocksync", group=best_g, blocks_per_dispatch=48,
-                blocks_per_sec=round(r, 2),
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("prod5_blocksync", group=best_g, blocks_per_dispatch=48,
-                error=repr(e)[:200])
+
+    run_arm("prod5_rlc_fused",
+            lambda: bench.bench_rlc(best_batch, 8, passes=3),
+            rates=True, group=best_g, batch=best_batch)
+    run_arm("prod5_rlc_cached",
+            lambda: bench.bench_rlc(best_batch, 8, use_cache=True,
+                                    passes=3),
+            rates=True, group=best_g, batch=best_batch)
+    run_arm("prod5_light",
+            lambda: bench.bench_light_headers(150, 8, 384),
+            result_key="headers_per_sec", group=best_g,
+            commits_per_dispatch=384)
+    run_arm("prod5_blocksync",
+            lambda: bench.bench_blocksync(10_000, 48, 4),
+            result_key="blocks_per_sec", nd=2, group=best_g,
+            blocks_per_dispatch=48)
 
     # 4: follow-up levers at the winning config — (a) blk 1024 with
     # grouping (the r4b blk sweep predates the grouped kernel: bigger
-    # blocks halve the per-window tree's share but double the VMEM
+    # blocks halve the per-window tree share but double the VMEM
     # table block), (b) pipeline depth 16 (quantifies how much of the
     # headline is still per-dispatch overhead at the winning width).
     dflt_blk = pallas_msm.BLK
-    if not _skip(done, "blk_group_ab", group=best_g, batch=best_batch):
+
+    def _blk_arm():
         pallas_msm.WIN_GROUP = best_g
         pallas_msm.BLK = 1024
         refresh_jits()
-        log("blk_group_ab", group=best_g, batch=best_batch, start=True)
         try:
-            r = bench.bench_rlc(best_batch, 8, passes=3)
-            log("blk_group_ab", group=best_g, batch=best_batch,
-                sigs_per_sec=round(r, 1),
-                pass_rates=bench.bench_rlc.last_pass_rates,
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("blk_group_ab", group=best_g, batch=best_batch,
-                error=repr(e)[:200])
-        pallas_msm.BLK = dflt_blk
-        refresh_jits()
-    if not _skip(done, "iters16_ab", group=best_g, batch=best_batch):
+            return bench.bench_rlc(best_batch, 8, passes=3)
+        finally:
+            pallas_msm.BLK = dflt_blk
+            refresh_jits()
+
+    run_arm("blk_group_ab", _blk_arm, rates=True, group=best_g,
+            batch=best_batch)
+
+    def _iters_arm():
         pallas_msm.WIN_GROUP = best_g
         refresh_jits()
-        log("iters16_ab", group=best_g, batch=best_batch, start=True)
-        try:
-            r = bench.bench_rlc(best_batch, 16, passes=3)
-            log("iters16_ab", group=best_g, batch=best_batch,
-                sigs_per_sec=round(r, 1),
-                pass_rates=bench.bench_rlc.last_pass_rates,
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("iters16_ab", group=best_g, batch=best_batch,
-                error=repr(e)[:200])
+        return bench.bench_rlc(best_batch, 16, passes=3)
+
+    run_arm("iters16_ab", _iters_arm, rates=True, group=best_g,
+            batch=best_batch)
 
     pallas_msm.WIN_GROUP = dflt_group
     log("done", t=round(time.time() - t0, 1))
