@@ -334,11 +334,13 @@ def _best_measured_config():
     defaults run)."""
     best = None
     try:
-        with open(AB5_PATH) as f:
+        with open(AB5_PATH, errors="replace") as f:
             for line in f:
                 try:
                     rec = json.loads(line)
-                except json.JSONDecodeError:
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if not isinstance(rec, dict):
                     continue
                 # iters16_ab measures depth 16 — not comparable to the
                 # depth-8 headline, and it can never change the pick
@@ -346,14 +348,18 @@ def _best_measured_config():
                                            "prod5_rlc_fused"):
                     continue
                 r = rec.get("sigs_per_sec")
+                b = rec.get("batch")
+                g = rec.get("group", 1)
                 if not isinstance(r, (int, float)) \
-                        or not rec.get("batch"):
+                        or not isinstance(b, int) or b <= 0 \
+                        or not isinstance(g, int) or g < 1:
                     continue
                 if best is None or r > best[2]:
-                    best = (rec.get("group", 1), rec["batch"],
-                            r, rec["name"])
-    except OSError:
-        pass
+                    best = (g, b, r, rec["name"])
+    except Exception:
+        # bad evidence must degrade to defaults, never crash the
+        # official capture before its protection is armed
+        return None
     return best
 
 
@@ -411,8 +417,13 @@ def _acquire_tpu_lock():
     if os.environ.get("COMETBFT_TPU_HAVE_LOCK") == "1":
         return None
     import fcntl
+    # 3600 (was 1800): the watch loop's A/B phases legitimately hold
+    # the lock for long stretches on a healthy window — a capture that
+    # waits its turn measures cleanly, while proceeding unlocked races
+    # the queue and wedges BOTH (axon discipline: one TPU process).
+    # The pre-headline watchdog still bounds total wall time.
     deadline = time.perf_counter() + float(
-        os.environ.get("BENCH_LOCK_TIMEOUT", "1800"))
+        os.environ.get("BENCH_LOCK_TIMEOUT", "3600"))
     fd = open("/tmp/tpu.lock", "w")
     while True:
         try:
@@ -427,51 +438,17 @@ def _acquire_tpu_lock():
 
 
 def main() -> None:
-    _acquire_tpu_lock()
-    # 16383 after the round-4 width sweep (ab_round4_results.jsonl):
-    # the relay's fixed per-dispatch cost dominates narrow batches —
-    # 4095 measured 35.1k sigs/s where 16383 measured 81.1k on the
-    # same kernel (32767 re-measured best once the Pallas stack
-    # landed: 292.8k vs 278.7k, prod_rlc_fused arms); commit
-    # verification feeds widths like this via cross-commit deferred
-    # batching (types/validation.py)
-    batch = int(os.environ.get("BENCH_BATCH", "32767"))
-    iters = int(os.environ.get("BENCH_ITERS", "8"))
-    # round-5 A/B evidence steers the measured configuration (env
-    # overrides still win; the code default flips only after review)
-    ab_pick = _best_measured_config()
-    ab_note = None
-    if ab_pick is not None:
-        g, b, r, arm = ab_pick
-        applied = []
-        if "BENCH_BATCH" not in os.environ:
-            batch = int(b)
-            applied.append(f"batch={b}")
-        if "COMETBFT_TPU_PALLAS_WIN_GROUP" not in os.environ and g:
-            from cometbft_tpu.ops import pallas_msm as _pm
-            _pm.WIN_GROUP = int(g)
-            applied.append(f"group={g}")
-        if applied:
-            # the note records what was ACTUALLY applied: env
-            # overrides must not let it claim a config the run didn't
-            # measure
-            ab_note = (f"A/B evidence applied: {', '.join(applied)} "
-                       f"(best arm {arm}: {r:,.0f} sigs/s at "
-                       f"group={g} batch={b}, "
-                       f"ab_round5_results.jsonl)")
-    try:                         # a stale partial from a previous round
-        os.unlink(PARTIAL_PATH)  # must never masquerade as this one's
-    except OSError:
-        pass
-    # Pre-headline protection, two layers (review findings):
+    # Pre-headline protection, two layers, armed BEFORE anything that
+    # can block (the lock wait below can last an hour — review
+    # finding):
     # 1. a signal handler for driver SIGTERM/SIGINT — fires during
-    #    Python-bytecode windows (probe sleeps, host packing) and
+    #    Python-bytecode windows (lock/probe sleeps, host packing) and
     #    emits the carry fallback with a PHASE-ACCURATE label;
     # 2. a daemon watchdog thread with a hard deadline — Python defers
     #    signal handlers while the main thread sits in a native XLA
     #    compile (the >420 s headline cold compile), so only a thread
     #    can guarantee an emission before the driver's SIGKILL.
-    phase = {"now": "probe envelope"}
+    phase = {"now": "waiting for the TPU lock"}
 
     def _pre_headline_term(signum, frame):
         _carry_fallback(f"signal {signum} during {phase['now']}; "
@@ -479,7 +456,8 @@ def main() -> None:
         os._exit(1)
 
     hard_deadline = time.monotonic() + float(os.environ.get(
-        "BENCH_PROBE_ENVELOPE", "2700")) + float(os.environ.get(
+        "BENCH_LOCK_TIMEOUT", "3600")) + float(os.environ.get(
+            "BENCH_PROBE_ENVELOPE", "2700")) + float(os.environ.get(
             "BENCH_HEADLINE_ALLOWANCE", "900"))
     headline_done = threading.Event()
 
@@ -498,6 +476,39 @@ def main() -> None:
                      daemon=True).start()
     signal.signal(signal.SIGTERM, _pre_headline_term)
     signal.signal(signal.SIGINT, _pre_headline_term)
+
+    _acquire_tpu_lock()
+    # 16383 after the round-4 width sweep (ab_round4_results.jsonl):
+    # the relay's fixed per-dispatch cost dominates narrow batches —
+    # 4095 measured 35.1k sigs/s where 16383 measured 81.1k on the
+    # same kernel (32767 re-measured best once the Pallas stack
+    # landed: 292.8k vs 278.7k, prod_rlc_fused arms); commit
+    # verification feeds widths like this via cross-commit deferred
+    # batching (types/validation.py)
+    batch = int(os.environ.get("BENCH_BATCH", "32767"))
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+    # round-5 A/B evidence steers the measured configuration — only
+    # for fully-unattended captures: ANY env pin means an operator
+    # chose a config, and applying half a measured pair would produce
+    # a combination no arm ever ranked (review finding)
+    ab_note = None
+    if ("BENCH_BATCH" not in os.environ
+            and "COMETBFT_TPU_PALLAS_WIN_GROUP" not in os.environ):
+        ab_pick = _best_measured_config()
+        if ab_pick is not None:
+            g, b, r, arm = ab_pick
+            batch = b
+            if g:
+                from cometbft_tpu.ops import pallas_msm as _pm
+                _pm.WIN_GROUP = g
+            ab_note = (f"A/B evidence applied: group={g} batch={b} "
+                       f"(best arm {arm}: {r:,.0f} sigs/s, "
+                       f"ab_round5_results.jsonl)")
+    try:                         # a stale partial from a previous round
+        os.unlink(PARTIAL_PATH)  # must never masquerade as this one's
+    except OSError:
+        pass
+    phase["now"] = "probe envelope"
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         _probe_device()
     phase["now"] = "headline measurement (probe already healthy)"

@@ -173,10 +173,13 @@ def main():
     dflt_blk = pallas_msm.BLK
 
     def _blk_arm():
-        pallas_msm.WIN_GROUP = best_g
-        pallas_msm.BLK = 1024
-        refresh_jits()
+        # mutations INSIDE the try: run_arm swallows exceptions, so a
+        # refresh_jits failure must not leak BLK=1024 into later arms
+        # (which would mislabel the evidence bench.py steers on)
         try:
+            pallas_msm.WIN_GROUP = best_g
+            pallas_msm.BLK = 1024
+            refresh_jits()
             return bench.bench_rlc(best_batch, 8, passes=3)
         finally:
             pallas_msm.BLK = dflt_blk
