@@ -131,7 +131,13 @@ def main():
 
     # -- 1. grouped window-major parity ----------------------------------
     def _wg(tab, mags, negs, ref, grp):
-        got = pm.msm_window_major(tab, mags, negs, blk=512, group=grp)
+        # per-side block: the A side pads 1025 keys to 1280 lanes,
+        # which 512 does not divide (blk_for picks 256 there) — a
+        # hardcoded 512 width-asserts at trace time (caught by the
+        # CPU control-flow dry-run before it could burn a hardware
+        # window)
+        blk = pm.blk_for(tab.shape[-1])
+        got = pm.msm_window_major(tab, mags, negs, blk=blk, group=grp)
         return _proj_eq(np.asarray(tr1_j(jnp.asarray(got))), ref)
 
     _probe(done, "wg_r", 2, lambda: _wg(tab_r, r_mag, r_neg, r_ref, 2))
@@ -175,9 +181,10 @@ def main():
         from cometbft_tpu.ops import msm_shard
 
         mesh = Mesh(np.array(jax.devices()[:1]), ("sig",))
+        # blk=None: per-side blk_for (the A side is 1280 wide)
         ok = msm_shard.rlc_verify_sharded(
             *[jnp.asarray(np.asarray(x)) for x in packed],
-            mesh=mesh, blk=512, group=1)
+            mesh=mesh, blk=None, group=1)
         return bool(np.asarray(ok))
 
     _probe(done, "shard1_rlc", 1, _shard1)
@@ -190,7 +197,7 @@ def main():
         mesh = Mesh(np.array(jax.devices()[:1]), ("sig",))
         ok = msm_shard.rlc_verify_sharded(
             *[jnp.asarray(np.asarray(x)) for x in packed],
-            mesh=mesh, blk=512, group=4)
+            mesh=mesh, blk=None, group=4)
         return bool(np.asarray(ok))
 
     _probe(done, "shard1_rlc", 4, _shard1_grouped)
