@@ -193,9 +193,11 @@ DEVICE_THRESHOLD = int(os.environ.get("COMETBFT_TPU_BATCH_THRESHOLD", "8"))
 # per-signature Straus chains, so the per-sig device advantage is far
 # smaller than ed25519's and the ~70 ms dispatch floor dominates small
 # batches.  Measured: host 889 sigs/s (1.12 ms/sig, recorded in
-# docs/PERF.md), device 6651 sigs/s at batch 1024 -> fixed+marginal
-# crossover ≈ 70 sigs; 96 leaves margin for relay jitter.  Refined by
-# the r5 width sweep (scripts/ab_round5.py secp_batch_ab).
+# docs/PERF.md); device (r5 width sweep, ab_round5_results.jsonl
+# secp_batch_ab): 6613 sigs/s at batch 1024, 27583 at 4096, 27383 at
+# 16383 — marginal device cost ~36 us/sig once dispatch overhead
+# amortizes.  Fixed+marginal crossover ~= 70 sigs; 96 leaves margin
+# for relay jitter.
 SECP_DEVICE_THRESHOLD = int(os.environ.get(
     "COMETBFT_TPU_SECP_THRESHOLD", "96"))
 
