@@ -537,8 +537,54 @@ def main() -> None:
     # and max-of-passes estimates the program's actual throughput the
     # way a sustained pipeline would see it
     passes = int(os.environ.get("BENCH_HEADLINE_PASSES", "3"))
-    rlc = bench_rlc(batch, iters, passes=passes)  # distinct keys: one
-    extra = {                                     # sig/validator
+    # the probe envelope proves the relay was healthy BEFORE the
+    # headline, but relay flakes also strike mid-measurement (observed
+    # 2026-08-02: "response body closed before all bytes were read"
+    # 3.5 min into the steered config's first compile -> rc=1, the
+    # exact failure mode VERDICT r4 item 1 exists to kill).  Retry
+    # with a fresh probe envelope between attempts; a still-failing
+    # headline falls back to the carried capture rather than a
+    # traceback.  AssertionError stays fatal: a verification that
+    # returns False is a correctness failure no carried number may
+    # paper over.
+    rlc = None                                    # distinct keys: one
+    headline_attempts = max(1, int(               # sig/validator
+        os.environ.get("BENCH_HEADLINE_ATTEMPTS", "3")))
+    # fault seam for off-hardware drives of this path: first N
+    # attempts raise as a relay flake would (default 0 = inert)
+    _fault_n = int(os.environ.get("BENCH_FAULT_HEADLINE", "0"))
+    for _attempt in range(1, headline_attempts + 1):
+        try:
+            if _attempt <= _fault_n:
+                raise RuntimeError(
+                    f"injected headline fault {_attempt}/{_fault_n} "
+                    f"(BENCH_FAULT_HEADLINE)")
+            rlc = bench_rlc(batch, iters, passes=passes)
+            break
+        except AssertionError:
+            raise
+        except Exception as e:                    # relay flake
+            diag = (f"headline measurement raised on attempt "
+                    f"{_attempt}/{headline_attempts}: {repr(e)[:300]}")
+            print(diag, file=sys.stderr, flush=True)
+            if _attempt == headline_attempts:
+                _carry_fallback(diag)  # exits 0 when a carry exists
+                raise                  # no carry: keep the loud rc=1
+            phase["now"] = f"re-probe after headline flake {_attempt}"
+            # injected faults are off-hardware drives: a real probe
+            # would burn the whole envelope against a relay that was
+            # never the problem (review finding)
+            if (os.environ.get("BENCH_SKIP_PROBE") != "1"
+                    and _fault_n == 0):
+                _probe_device()
+            phase["now"] = "headline measurement (retry)"
+    # re-base the extras clock: a mid-headline flake's re-probe can
+    # consume most of BENCH_PROBE_ENVELOPE, and charging that against
+    # the extras budget would skip every fresh extra right after the
+    # hardware RECOVERED (review finding).  Total wall time stays
+    # bounded by the pre-headline watchdog's hard deadline.
+    t0 = time.perf_counter()
+    extra = {
         "rlc_batch": batch,
         "rlc_keys": "distinct (one per signature)",
         "headline_passes": passes,
@@ -582,7 +628,7 @@ def main() -> None:
         ("per_sig_kernel_sigs_per_sec", None),
         ("rlc_cached_a_sigs_per_sec", "rlc_cached_a_config"),
         ("light_client_headers_per_sec", "light_client_config"),
-        ("secp256k1_sigs_per_sec", None),
+        ("secp256k1_sigs_per_sec", "secp256k1_config"),
         ("blocksync_blocks_per_sec", "blocksync_config"),
     )
     # per-key provenance so CHAINED carries don't launder staleness
@@ -761,8 +807,15 @@ def main() -> None:
               "light_client_config",
               "150 validators/commit, 192 commits/RLC dispatch,"
               " pipelined")
+    # batch 4096 is the A/B'd config (ab_round5 secp_batch_ab: 1024 ->
+    # 6.6k, 4096 -> 27.6k, 16383 -> 27.4k sigs/s — dispatch overhead
+    # fully amortized by 4096, and fixture cost stays modest)
     run_extra("secp256k1_sigs_per_sec",
-              lambda: round(bench_secp(1024, 6), 1))
+              lambda: round(bench_secp(4096, 6), 1),
+              "secp256k1_config",
+              "batch 4096, per-signature Straus kernel (A/B'd: "
+              "6.6k/27.6k/27.4k sigs/s at 1024/4096/16383, "
+              "ab_round5 secp_batch_ab)")
     run_extra("blocksync_blocks_per_sec",
               lambda: round(bench_blocksync(10_000, 12, 4), 2),
               "blocksync_config",

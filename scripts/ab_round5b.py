@@ -1,0 +1,88 @@
+"""Round-5b follow-up A/B arms: ride the batch-width amortization one
+more doubling.
+
+The round-5 queue measured (same relay day, G=1): batch 32767 ->
+465.7k sigs/s, batch 65535 -> 496.5k (+6.6%) — the fixed per-dispatch
+relay cost still amortizes at 65535.  These arms test batch 131071
+(pad_width -> exactly 131072 = 128<<10, table HBM ~713 MB/side: well
+inside v5e) at G in {1, 4} to see where the curve flattens.
+
+Arms APPEND to ab_round5_results.jsonl under the SAME win_group_ab
+name so bench.py's `_best_measured_config` steering ranks them with
+the round-5 evidence — if 131071 wins, the unattended capture measures
+it; if it loses, the pick is unchanged.  relay_watch5.sh's done-marker
+grep still matches (records land after the existing "done" line).
+
+Usage:  env PYTHONPATH=/root/repo:/root/.axon_site \
+            flock /tmp/tpu.lock python scripts/ab_round5b.py [results.jsonl]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo/scripts")
+from _capture_util import already_done, append_log, wedged  # noqa: E402
+
+OUT = (sys.argv[1] if len(sys.argv) > 1
+       else "/root/repo/ab_round5_results.jsonl")
+
+
+def log(name, **kv):
+    append_log(OUT, {"name": name, **kv})
+
+
+def _arm_key(rec: dict) -> tuple:
+    return (rec.get("name"), rec.get("batch"), rec.get("group"),
+            rec.get("commits_per_dispatch"),
+            rec.get("blocks_per_dispatch"))
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/cometbft_tpu_jax_cache")
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/cometbft_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    t0 = time.time()
+    done = already_done(OUT, _arm_key) | wedged(OUT, _arm_key)
+
+    import bench
+    from cometbft_tpu.ops import ed25519 as dev
+    from cometbft_tpu.ops import pallas_msm
+
+    dflt_group = pallas_msm.WIN_GROUP
+
+    def refresh_jits():
+        jax.clear_caches()
+        dev._rlc_jitted = jax.jit(dev.rlc_verify_kernel)
+        dev._rlc_cached_jitted = jax.jit(dev.rlc_verify_kernel_cached_a)
+        dev._a_tables_jitted = jax.jit(dev._msm_tables)
+        dev._jitted = jax.jit(dev.verify_kernel)
+
+    for group in (1, 4):
+        batch = 131071
+        key = {"group": group, "batch": batch}
+        if _arm_key({"name": "win_group_ab", **key}) in done:
+            continue
+        log("win_group_ab", **key, start=True)
+        try:
+            pallas_msm.WIN_GROUP = group
+            refresh_jits()
+            r = bench.bench_rlc(batch, 8, passes=3)
+            log("win_group_ab", **key,
+                sigs_per_sec=round(r, 1),
+                pass_rates=bench.bench_rlc.last_pass_rates,
+                t=round(time.time() - t0, 1))
+        except Exception as e:
+            log("win_group_ab", **key, error=repr(e)[:200])
+    pallas_msm.WIN_GROUP = dflt_group
+    log("done5b", t=round(time.time() - t0, 1))
+
+
+if __name__ == "__main__":
+    main()
