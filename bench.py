@@ -29,6 +29,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import statistics
 import sys
 import threading
 import time
@@ -220,6 +221,25 @@ def bench_secp(batch: int, iters: int) -> float:
     return batch / dt
 
 
+def bench_blocksync_e2e() -> dict:
+    """Reactor-level end-to-end (VERDICT missing #3): blocks through
+    the REAL blocksync/reactor.py -> DeferredSigBatch device verify ->
+    blockstore over the simnet in-memory transport, not a dispatch
+    loop over pre-packed arrays.  Sizes via SIMNET_BENCH_BLOCKS /
+    SIMNET_BENCH_VALS (defaults 96 x 64)."""
+    from cometbft_tpu.simnet import bench as simbench
+    return simbench.bench_blocksync_e2e()
+
+
+def bench_light_e2e() -> dict:
+    """Headers through light/client.py windowed sequential sync
+    against a simnet node's real JSON-RPC server (HttpProvider over
+    HTTP loopback).  Sizes via SIMNET_LIGHT_HEADERS /
+    SIMNET_LIGHT_VALS (defaults 128 x 32)."""
+    from cometbft_tpu.simnet import bench as simbench
+    return simbench.bench_light_e2e()
+
+
 def _probe_device_once(timeout_s: float = 120.0) -> str | None:
     """One probe attempt in a subprocess (a raw jax.devices() on a
     wedged axon relay hangs indefinitely).  Returns None on success,
@@ -331,7 +351,8 @@ def _best_measured_config():
     on a human being awake when the relay heals.  Only same-kernel
     arms count (win_group_ab / prod5_rlc_fused / blk-independent
     follow-ups measure the identical program family the shipping
-    defaults run)."""
+    defaults run).  Arms are ranked by the MEDIAN of their stored
+    pass_rates, not the single best pass."""
     best = None
     try:
         with open(AB5_PATH, errors="replace") as f:
@@ -348,6 +369,14 @@ def _best_measured_config():
                                            "prod5_rlc_fused"):
                     continue
                 r = rec.get("sigs_per_sec")
+                # median of the stored passes: max-of-passes lets one
+                # outlier inside the documented ±7% relay swing win
+                # the steering (ADVICE r5 finding 2); the median is
+                # what a sustained pipeline actually repeats
+                rates = rec.get("pass_rates")
+                if isinstance(rates, list) and rates and \
+                        all(isinstance(x, (int, float)) for x in rates):
+                    r = statistics.median(rates)
                 b = rec.get("batch")
                 g = rec.get("group", 1)
                 if not isinstance(r, (int, float)) \
@@ -571,18 +600,25 @@ def main() -> None:
                 _carry_fallback(diag)  # exits 0 when a carry exists
                 raise                  # no carry: keep the loud rc=1
             phase["now"] = f"re-probe after headline flake {_attempt}"
-            # injected faults are off-hardware drives: a real probe
+            # injected faults are off-hardware drives where a probe
             # would burn the whole envelope against a relay that was
-            # never the problem (review finding)
+            # never the problem — but only the INJECTED attempts are
+            # exempt: a REAL flake in a mixed run (attempt past
+            # _fault_n) still re-probes (ADVICE r5 finding 4)
             if (os.environ.get("BENCH_SKIP_PROBE") != "1"
-                    and _fault_n == 0):
+                    and _attempt > _fault_n):
                 _probe_device()
             phase["now"] = "headline measurement (retry)"
     # re-base the extras clock: a mid-headline flake's re-probe can
     # consume most of BENCH_PROBE_ENVELOPE, and charging that against
     # the extras budget would skip every fresh extra right after the
-    # hardware RECOVERED (review finding).  Total wall time stays
-    # bounded by the pre-headline watchdog's hard deadline.
+    # hardware RECOVERED (review finding).  Bound after the re-base:
+    # the pre-headline watchdog retires once the headline lands, and
+    # the separate EXTRAS deadline (budget + 2*extra_timeout from
+    # here) takes over — worst-case wall time is the SUM of the two
+    # envelopes, not one global cap; the driver's outer timeout
+    # (relay_watch5.sh: timeout 7200) is sized for that (ADVICE r5
+    # finding 3).
     t0 = time.perf_counter()
     extra = {
         "rlc_batch": batch,
@@ -630,6 +666,8 @@ def main() -> None:
         ("light_client_headers_per_sec", "light_client_config"),
         ("secp256k1_sigs_per_sec", "secp256k1_config"),
         ("blocksync_blocks_per_sec", "blocksync_config"),
+        ("blocksync_e2e_blocks_per_sec", "blocksync_e2e_config"),
+        ("light_e2e_headers_per_sec", "light_e2e_config"),
     )
     # per-key provenance so CHAINED carries don't launder staleness
     # (review finding): a key already carried/merged in the previous
@@ -825,6 +863,42 @@ def main() -> None:
         "blocksync_blocks_per_sec", "blocksync_config",
         lambda: round(bench_blocksync(10_000, 24, 4), 2),
         "10k validators, 6667+1 sigs/commit, 24 blocks/dispatch")
+
+    # -- reactor-level e2e (simnet): the first metrics measured
+    # THROUGH the protocol stack (blocksync/reactor.py -> blockstore,
+    # light/client.py -> real JSON-RPC) rather than beside it; the gap
+    # to the kernel-only rates above IS the host residual, and the
+    # *_detail stage spans say where it lives (docs/SIMNET.md)
+    def _attach_e2e_detail(key, detail_key, detail):
+        if (key not in carried_keys
+                and isinstance(extra.get(key), (int, float))
+                and detail is not None):
+            extra[detail_key] = detail
+            persist()
+
+    run_extra("blocksync_e2e_blocks_per_sec",
+              lambda: bench_blocksync_e2e()["blocks_per_sec"],
+              "blocksync_e2e_config",
+              "simnet e2e: real blocks through the blocksync reactor"
+              " into the store (defaults 96 blocks x 64 validators;"
+              " SIMNET_BENCH_* overrides)")
+    try:
+        from cometbft_tpu.simnet import bench as _simbench
+    except Exception:          # run_extra already recorded the error
+        class _simbench:       # noqa: N801 - sentinel with empty results
+            last_blocksync = None
+            last_light = None
+    _attach_e2e_detail("blocksync_e2e_blocks_per_sec",
+                       "blocksync_e2e_detail", _simbench.last_blocksync)
+    run_extra("light_e2e_headers_per_sec",
+              lambda: bench_light_e2e()["headers_per_sec"],
+              "light_e2e_config",
+              "simnet e2e: headers through light/client.py sequential"
+              " sync against a simnet node's real JSON-RPC server"
+              " (defaults 128 headers x 32 validators; SIMNET_LIGHT_*"
+              " overrides)")
+    _attach_e2e_detail("light_e2e_headers_per_sec",
+                       "light_e2e_detail", _simbench.last_light)
 
     # -- deepening tier: strictly-better configs measured by the r4b
     # sweeps; a wedge here can only cost the upgrades, never a metric

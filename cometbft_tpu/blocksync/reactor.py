@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..libs.trace import span as trace_span
 from ..p2p.base_reactor import Envelope, Reactor
 from ..p2p.conn.connection import ChannelDescriptor
 from ..types.block import BlockID
@@ -114,7 +115,8 @@ class BlocksyncReactor(Reactor):
 
     # -- receive -----------------------------------------------------------
     def receive(self, envelope: Envelope) -> None:
-        msg = bm.unwrap(bytes(envelope.message))
+        with trace_span("blocksync", "decode"):
+            msg = bm.unwrap(bytes(envelope.message))
         peer = envelope.src
         if isinstance(msg, bm.BlockRequest):
             self._respond_to_block_request(peer, msg.height)
@@ -211,25 +213,27 @@ class BlocksyncReactor(Reactor):
         parts_ids = []
         collecting_h = None
         try:
-            for i in range(usable):
-                block = blocks[i]
-                collecting_h = block.header.height
-                if i == 0:
-                    vals = self.state.validators
-                elif block.header.validators_hash == next_hash:
-                    vals = self.state.next_validators
-                else:
-                    break
-                parts = PartSet.from_data(block.to_proto())
-                bid = BlockID(block.hash(), parts.header)
-                parts_ids.append((parts, bid))
-                vals.verify_commit_light(
-                    self.state.chain_id, bid, block.header.height,
-                    commits[i], defer_to=batch)
-                verified += 1
-            collecting_h = None
+            with trace_span("blocksync", "verify_dispatch"):
+                for i in range(usable):
+                    block = blocks[i]
+                    collecting_h = block.header.height
+                    if i == 0:
+                        vals = self.state.validators
+                    elif block.header.validators_hash == next_hash:
+                        vals = self.state.next_validators
+                    else:
+                        break
+                    parts = PartSet.from_data(block.to_proto())
+                    bid = BlockID(block.hash(), parts.header)
+                    parts_ids.append((parts, bid))
+                    vals.verify_commit_light(
+                        self.state.chain_id, bid, block.header.height,
+                        commits[i], defer_to=batch)
+                    verified += 1
+                collecting_h = None
             # HOT PATH: one device dispatch for the whole window
-            batch.verify()
+            with trace_span("blocksync", "device"):
+                batch.verify()
         except Exception as e:
             # blame the failing height: a deferred sig failure carries
             # it as failed_ctx; structural errors (bad commit shape,
@@ -255,9 +259,10 @@ class BlocksyncReactor(Reactor):
                 return progressed
             parts, first_id = parts_ids[i]
             try:
-                if ext_enabled:
-                    first_ext.ensure_extensions(True)
-                self.block_exec.validate_block(self.state, first)
+                with trace_span("blocksync", "apply"):
+                    if ext_enabled:
+                        first_ext.ensure_extensions(True)
+                    self.block_exec.validate_block(self.state, first)
             except Exception:
                 # evict BOTH suppliers (reactor.go:560): the next
                 # block's LastCommit drove the batched verify
@@ -265,15 +270,17 @@ class BlocksyncReactor(Reactor):
                     self._on_peer_error(pid, "served invalid block")
                 return progressed
             self.pool.pop_request()
-            if ext_enabled:
-                self.store.save_block(first, parts,
-                                      first_ext.to_commit(),
-                                      ext_commit=first_ext.to_proto())
-            else:
-                self.store.save_block(first, parts, commits[i])
-            self.state = self.block_exec.apply_verified_block(
-                self.state, first_id, first,
-                syncing_to_height=self.pool.max_peer_height())
+            with trace_span("blocksync", "store"):
+                if ext_enabled:
+                    self.store.save_block(first, parts,
+                                          first_ext.to_commit(),
+                                          ext_commit=first_ext.to_proto())
+                else:
+                    self.store.save_block(first, parts, commits[i])
+            with trace_span("blocksync", "apply"):
+                self.state = self.block_exec.apply_verified_block(
+                    self.state, first_id, first,
+                    syncing_to_height=self.pool.max_peer_height())
             if self.metrics is not None:
                 self.metrics.record_block(first, size_bytes=parts.byte_size)
             progressed = True

@@ -1,0 +1,114 @@
+"""Stage-span tracing: per-stage wall-clock timers for the protocol
+hot paths (decode -> verify-dispatch -> device -> apply -> store).
+
+The host-residual breakdown that blocksync_profile_r5.jsonl measured
+with a one-off script becomes a first-class observable: reactors and
+the light client open spans around each stage, a process-wide
+StageTracer accumulates (count, seconds) per (subsystem, stage), and —
+when the node runs with instrumentation — every span also lands in the
+libs/metrics.py registry as a histogram observation
+(cometbft_trace_stage_duration_seconds{subsystem, stage}).
+
+No reference analog: the reference profiles with pprof; here the
+interesting question is how much of a block's wall time is host work
+around the single device dispatch, so the stages are first-class.
+
+The seam mirrors libs/metrics.set_device_metrics: a module-level
+tracer the crypto/reactor layers reach without any node wiring.  With
+no tracer installed a span is a shared no-op object — the hot paths
+pay one global read and an `is None` test.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# canonical stage names for the blocksync ingest pipeline; other
+# subsystems (light) reuse the subset that applies to them
+BLOCKSYNC_STAGES = ("decode", "verify_dispatch", "device", "apply",
+                    "store")
+LIGHT_STAGES = ("fetch", "verify_dispatch", "device", "store")
+
+
+class StageTracer:
+    """Accumulates span durations per (subsystem, stage); optionally
+    mirrors every observation into a metrics.TraceMetrics bundle."""
+
+    def __init__(self, metrics=None):
+        self._mtx = threading.Lock()
+        self._totals: dict[tuple[str, str], list] = {}
+        self.metrics = metrics
+
+    def record(self, subsystem: str, stage: str, seconds: float) -> None:
+        with self._mtx:
+            t = self._totals.setdefault((subsystem, stage), [0, 0.0])
+            t[0] += 1
+            t[1] += seconds
+        if self.metrics is not None:
+            self.metrics.stage_duration_seconds.labels(
+                subsystem, stage).observe(seconds)
+
+    def snapshot(self) -> dict:
+        """{"subsystem.stage": {"count": n, "seconds": s}} — the shape
+        the simnet benches report alongside their e2e rates."""
+        with self._mtx:
+            return {
+                f"{sub}.{stage}": {"count": c, "seconds": round(s, 6)}
+                for (sub, stage), (c, s) in sorted(self._totals.items())}
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._totals.clear()
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _TimedSpan:
+    __slots__ = ("_tracer", "_subsystem", "_stage", "_t0")
+
+    def __init__(self, tracer: StageTracer, subsystem: str, stage: str):
+        self._tracer = tracer
+        self._subsystem = subsystem
+        self._stage = stage
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.record(self._subsystem, self._stage,
+                            time.perf_counter() - self._t0)
+        return False
+
+
+# process-wide tracer seam (same pattern as metrics.set_device_metrics)
+_tracer: StageTracer | None = None
+
+
+def set_tracer(t: StageTracer | None) -> None:
+    global _tracer
+    _tracer = t
+
+
+def tracer() -> StageTracer | None:
+    return _tracer
+
+
+def span(subsystem: str, stage: str):
+    """Context manager timing one stage; free when no tracer is set."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return _TimedSpan(t, subsystem, stage)

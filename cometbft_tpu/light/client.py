@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..libs.trace import span as trace_span
 from ..types.timestamp import Timestamp
 from ..types.validation import Fraction
 from . import verifier
@@ -178,9 +179,10 @@ class Client:
             trace = self._verify_skipping(self.primary, anchor, new_block,
                                           now)
         self._detect_divergence(trace, now)
-        for lb in trace[1:]:
-            self.store.save_light_block(lb)
-        self.store.prune(self.pruning_size)
+        with trace_span("light", "store"):
+            for lb in trace[1:]:
+                self.store.save_light_block(lb)
+            self.store.prune(self.pruning_size)
 
     # -- strategies --------------------------------------------------------
 
@@ -198,9 +200,10 @@ class Client:
         from ..types import validation
 
         def fetch_window(start: int, end: int) -> list[LightBlock]:
-            return [target if hh == target.height else
-                    self._from_primary(hh)
-                    for hh in range(start, end + 1)]
+            with trace_span("light", "fetch"):
+                return [target if hh == target.height else
+                        self._from_primary(hh)
+                        for hh in range(start, end + 1)]
 
         trace = [trusted]
         verified = trusted
@@ -221,13 +224,15 @@ class Client:
                                   target.height)
                     pending = ex.submit(fetch_window, nxt, nxt_end)
                 batch = validation.DeferredSigBatch()
-                for interim in window:
-                    verifier.verify_adjacent(
-                        verified.signed_header, interim.signed_header,
-                        interim.validator_set, self.trusting_period_ns,
-                        now, self.max_clock_drift_ns, defer_to=batch)
-                    verified = interim
-                batch.verify()
+                with trace_span("light", "verify_dispatch"):
+                    for interim in window:
+                        verifier.verify_adjacent(
+                            verified.signed_header, interim.signed_header,
+                            interim.validator_set, self.trusting_period_ns,
+                            now, self.max_clock_drift_ns, defer_to=batch)
+                        verified = interim
+                with trace_span("light", "device"):
+                    batch.verify()
                 trace.extend(window)
                 h = wend + 1
                 wend = min(h + self.sequential_batch_size - 1,

@@ -359,6 +359,13 @@ class Node(BaseService):
                 libmetrics.BLOCK_STORE_TIMED_METHODS)
             # the crypto layers report through the process-wide seam
             libmetrics.set_device_metrics(DeviceMetrics(registry))
+            # stage spans (decode/verify-dispatch/device/apply/store):
+            # the block-ingest breakdown reports through the same kind
+            # of process-wide seam (libs/trace.py)
+            from ..libs import trace as libtrace
+            from ..libs.metrics import TraceMetrics
+            libtrace.set_tracer(libtrace.StageTracer(
+                TraceMetrics(registry)))
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -442,9 +449,12 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         if self.metrics_server is not None:
-            # this node owns the process-wide device-metrics seam
+            # this node owns the process-wide device-metrics and
+            # stage-tracer seams
             from ..libs import metrics as libmetrics
+            from ..libs import trace as libtrace
             libmetrics.set_device_metrics(None)
+            libtrace.set_tracer(None)
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if self.privileged_rpc_server is not None:

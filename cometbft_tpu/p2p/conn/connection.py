@@ -229,15 +229,19 @@ class MConnection(BaseService):
                 if not self.is_running():
                     return
 
-                # ping if due
+                # ping if due; length-prefixed like every packet (the
+                # recv routine frames the stream on 4-byte prefixes, so
+                # a bare ping would desync everything after it)
                 now = time.monotonic()
                 if now - self._last_ping >= self._ping_interval:
-                    self._conn.write(_pack_ping())
+                    pkt = _pack_ping()
+                    self._conn.write(struct.pack(">I", len(pkt)) + pkt)
                     self._last_ping = now
                     self._pong_deadline = now + self._pong_timeout
                 if self._pong_pending.is_set():
                     self._pong_pending.clear()
-                    self._conn.write(_pack_pong())
+                    pkt = _pack_pong()
+                    self._conn.write(struct.pack(">I", len(pkt)) + pkt)
                 if self._pong_deadline is not None and \
                         now > self._pong_deadline:
                     raise MConnectionError("pong timeout")

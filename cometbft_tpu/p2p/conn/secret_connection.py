@@ -18,12 +18,22 @@ from __future__ import annotations
 import struct
 import threading
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - env-dependent
+    # the module must stay importable without the cryptography wheel
+    # (simnet and every p2p consumer reach Switch/MConnection through
+    # this package); only the actual TCP handshake needs the AEAD +
+    # X25519 primitives, and make() gates on the flag
+    HAVE_CRYPTOGRAPHY = False
 
 from ...crypto import ed25519
 
@@ -38,6 +48,44 @@ CHALLENGE_INFO = b"TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
 
 class SecretConnectionError(Exception):
     pass
+
+
+def _hkdf_sha256(ikm: bytes, salt: bytes | None, info: bytes,
+                 length: int) -> bytes:
+    if HAVE_CRYPTOGRAPHY:
+        return HKDF(algorithm=hashes.SHA256(), length=length,
+                    salt=salt, info=info).derive(ikm)
+    # stdlib RFC 5869 (extract-then-expand over HMAC-SHA256)
+    import hashlib
+    import hmac
+    prk = hmac.new(salt if salt else b"\x00" * 32, ikm,
+                   hashlib.sha256).digest()
+    okm, t, i = b"", b"", 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]),
+                     hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+def derive_secrets(shared: bytes, salt: bytes | None, we_are_lo: bool,
+                   info: bytes = CHALLENGE_INFO
+                   ) -> tuple[bytes, bytes, bytes]:
+    """HKDF-SHA256 -> (recv_key, send_key, challenge).
+
+    Split rule matches the reference's deriveSecrets
+    (secret_connection.go + TestDeriveSecretsAndChallengeGolden): the
+    lo ("least") side receives with okm[0:32] and sends with
+    okm[32:64]; the hi side swaps them; okm[64:96] is the transcript
+    challenge both sides sign.  Pinned against independent RFC-5869
+    vectors in tests/fixtures/secret_connection_kdf.json."""
+    okm = _hkdf_sha256(shared, salt, info, 96)
+    if we_are_lo:
+        recv_key, send_key = okm[0:32], okm[32:64]
+    else:
+        send_key, recv_key = okm[0:32], okm[32:64]
+    return recv_key, send_key, okm[64:96]
 
 
 class _NonceCounter:
@@ -76,6 +124,11 @@ class SecretConnection:
     def make(sock, priv_key) -> "SecretConnection":
         """Mutual-auth handshake (secret_connection.go
         MakeSecretConnection). priv_key: our long-term Ed25519 key."""
+        if not HAVE_CRYPTOGRAPHY:
+            raise SecretConnectionError(
+                "SecretConnection handshake requires the cryptography "
+                "package (X25519 + ChaCha20-Poly1305); in-process "
+                "peers can use simnet's transport instead")
         eph_priv = X25519PrivateKey.generate()
         eph_pub = eph_priv.public_key().public_bytes_raw()
 
@@ -92,13 +145,8 @@ class SecretConnection:
 
         # 2. derive: 2 x 32-byte keys + 32-byte challenge, transcript-
         # bound to both ephemerals via the HKDF salt
-        okm = HKDF(algorithm=hashes.SHA256(), length=96,
-                   salt=lo + hi, info=CHALLENGE_INFO).derive(shared)
-        if we_are_lo:
-            recv_key, send_key = okm[0:32], okm[32:64]
-        else:
-            send_key, recv_key = okm[0:32], okm[32:64]
-        challenge = okm[64:96]
+        recv_key, send_key, challenge = derive_secrets(
+            shared, lo + hi, we_are_lo)
 
         conn = SecretConnection(sock, ChaCha20Poly1305(recv_key),
                                 ChaCha20Poly1305(send_key), None)

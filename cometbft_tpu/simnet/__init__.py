@@ -1,0 +1,11 @@
+"""simnet: deterministic in-process multi-node simulation harness.
+
+Real reactors, real stores, real device-verification seam — in-memory
+transport with seeded latency / jitter / drops / partitions.  See
+docs/SIMNET.md.
+"""
+
+from .node import (  # noqa: F401
+    SimNode, clone_chain, grow_chain, make_sim_genesis,
+)
+from .transport import LinkSpec, SimNetwork, SimTransport  # noqa: F401

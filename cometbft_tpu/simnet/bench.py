@@ -1,0 +1,160 @@
+"""Reactor-level end-to-end benchmarks over the simnet.
+
+Where bench.py's kernel metrics time the device dispatch loop over
+pre-packed arrays, these drive the REAL protocol stack:
+
+- blocksync e2e: blocks flow source-switch -> conditioned link ->
+  syncing node's BlocksyncReactor -> BlockPool -> windowed
+  DeferredSigBatch device verify -> BlockExecutor (ABCI finalize +
+  commit) -> BlockStore.  The rate is blocks actually landed in the
+  store per wall second, and the libs/trace.py stage spans
+  (decode / verify_dispatch / device / apply / store) are reported
+  alongside so the host-residual around the device dispatch is visible
+  in the same record.
+
+- light e2e: headers pulled through light/client.py's windowed
+  sequential sync against a simnet node's REAL JSON-RPC server
+  (HttpProvider -> HTTP -> rpc/core Environment -> stores), signatures
+  batch-verified on the device per window.
+
+Module-level `last_blocksync` / `last_light` keep the full result dict
+of the most recent run (bench.py attaches the stage breakdown to its
+extras from there, mirroring bench_rlc.last_pass_rates).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..libs import trace as libtrace
+from .node import SimNode, clone_chain, grow_chain, make_sim_genesis
+from .transport import SimNetwork
+
+last_blocksync: dict | None = None
+last_light: dict | None = None
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def bench_blocksync_e2e(n_blocks: int | None = None,
+                        n_vals: int | None = None,
+                        txs_per_block: int = 2,
+                        seed: int = 7,
+                        timeout: float = 480.0) -> dict:
+    """Sync n_blocks through the real blocksync reactor; returns the
+    result dict (blocks_per_sec + stage breakdown) and stores it in
+    `last_blocksync`."""
+    global last_blocksync
+    n_blocks = n_blocks if n_blocks is not None else _env_int(
+        "SIMNET_BENCH_BLOCKS", 96)
+    n_vals = n_vals if n_vals is not None else _env_int(
+        "SIMNET_BENCH_VALS", 64)
+
+    net = SimNetwork(seed=seed)
+    genesis, privs = make_sim_genesis(n_vals=n_vals, seed=seed)
+    src = SimNode("bsrc", genesis, net, seed=seed)
+    # +1: the tip block's LastCommit verifies height-1; blocksync
+    # converges one block behind the serving tip (sync_target)
+    grow_chain(src, privs, n_blocks + 1, txs_per_block=txs_per_block)
+    syncer = SimNode("bsync", genesis, net, block_sync=True, seed=seed)
+
+    prev_tracer = libtrace.tracer()
+    tr = libtrace.StageTracer(
+        metrics=prev_tracer.metrics if prev_tracer else None)
+    libtrace.set_tracer(tr)
+    target = src.sync_target()
+    try:
+        src.start()
+        syncer.start()
+        t0 = time.perf_counter()
+        syncer.dial(src)
+        ok = syncer.wait_for_height(target, timeout=timeout)
+        dt = time.perf_counter() - t0
+    finally:
+        libtrace.set_tracer(prev_tracer)
+        syncer.stop()
+        src.stop()
+    if not ok:
+        raise RuntimeError(
+            f"blocksync e2e stalled at {syncer.height()}/{target} "
+            f"after {timeout:.0f}s")
+    # the source's header ABOVE the target carries the app hash the
+    # syncer must have reached after applying the target block
+    want = src.block_store.load_block(target + 1).header.app_hash
+    if syncer.app_hash() != want:
+        raise RuntimeError("blocksync e2e app hash diverged")
+
+    stages = {k: v for k, v in tr.snapshot().items()
+              if k.startswith("blocksync.")}
+    last_blocksync = {
+        "blocks_per_sec": round(n_blocks / dt, 2),
+        "blocks": n_blocks,
+        "validators": n_vals,
+        "seconds": round(dt, 3),
+        "stages": stages,
+    }
+    return last_blocksync
+
+
+def bench_light_e2e(n_headers: int | None = None,
+                    n_vals: int | None = None,
+                    seed: int = 11,
+                    sequential_batch_size: int | None = None) -> dict:
+    """Sequential light-client sync over the real RPC wire; returns the
+    result dict (headers_per_sec + stage breakdown) and stores it in
+    `last_light`."""
+    global last_light
+    n_headers = n_headers if n_headers is not None else _env_int(
+        "SIMNET_LIGHT_HEADERS", 128)
+    n_vals = n_vals if n_vals is not None else _env_int(
+        "SIMNET_LIGHT_VALS", 32)
+
+    from ..light.client import SEQUENTIAL, Client, TrustOptions
+    from ..light.provider import HttpProvider
+
+    net = SimNetwork(seed=seed)
+    genesis, privs = make_sim_genesis(n_vals=n_vals, seed=seed)
+    src = SimNode("lsrc", genesis, net, seed=seed)
+    grow_chain(src, privs, n_headers + 1, txs_per_block=1)
+
+    prev_tracer = libtrace.tracer()
+    tr = libtrace.StageTracer(
+        metrics=prev_tracer.metrics if prev_tracer else None)
+    libtrace.set_tracer(tr)
+    try:
+        rpc_addr = src.start_rpc()
+        provider = HttpProvider(genesis.chain_id, f"http://{rpc_addr}")
+        root_meta = src.block_store.load_block_meta(1)
+        opts = TrustOptions(
+            period_ns=100 * 365 * 24 * 3600 * 1_000_000_000,
+            height=1, hash=root_meta.header.hash())
+        target = src.height()
+        t0 = time.perf_counter()
+        client = Client(
+            genesis.chain_id, opts, provider,
+            verification_mode=SEQUENTIAL,
+            sequential_batch_size=(sequential_batch_size
+                                   or min(384, n_headers)))
+        lb = client.verify_light_block_at_height(target)
+        dt = time.perf_counter() - t0
+    finally:
+        libtrace.set_tracer(prev_tracer)
+        src.stop()
+    if lb.height != target:
+        raise RuntimeError(f"light e2e stopped at {lb.height}/{target}")
+
+    stages = {k: v for k, v in tr.snapshot().items()
+              if k.startswith("light.")}
+    # headers verified = trust root (fetch+verify in _initialize) plus
+    # every height from 2..target
+    last_light = {
+        "headers_per_sec": round(target / dt, 2),
+        "headers": target,
+        "validators": n_vals,
+        "seconds": round(dt, 3),
+        "stages": stages,
+    }
+    return last_light

@@ -1,0 +1,342 @@
+"""In-memory p2p transport for the simnet subsystem.
+
+SimTransport implements the MultiplexTransport surface (listen / dial /
+close) that p2p.Switch drives, but over queues instead of TCP +
+SecretConnection: N real nodes in one process, connected through links
+with injectable one-way latency, jitter, probabilistic frame drops, and
+named partitions — all from ONE seeded RNG per link, so a faulted run
+is reproducible bit-for-bit at the fault schedule level.
+
+Fault semantics match what the real stack would see:
+
+- latency/jitter delay whole write() payloads without throttling the
+  sender (the LatencyConnection shape: a burst stays a burst, shifted);
+- drops swallow whole write() payloads.  MConnection's send routine
+  emits write()s that are concatenations of complete length-prefixed
+  packets, so a dropped frame loses messages without desyncing the
+  receiver's framing — the protocol must recover via its own retry
+  machinery (pool redo/timeout), never via transport magic;
+- a partition silently drops frames BETWEEN groups and fails dials
+  across the cut, like a blackholed route; heal() restores delivery
+  for everything sent afterwards.
+
+Everything the Switch/MConnection layer touches is real: channel
+descriptors, packetization, flow control, peer lifecycle.  Only the
+wire and the crypto handshake are elided (nodes in one process have
+nothing to prove to each other; NodeInfo compatibility checks still
+run, matching transport.upgrade's gate order).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import random
+import threading
+import time
+
+from ..p2p.transport import ErrRejected, TransportError, parse_addr
+
+_CLOSED = object()          # inbox sentinel: EOF
+
+
+class LinkSpec:
+    """Per-link conditioning: one-way latency (s), uniform jitter (s),
+    drop probability per frame."""
+
+    __slots__ = ("latency", "jitter", "drop")
+
+    def __init__(self, latency: float = 0.0, jitter: float = 0.0,
+                 drop: float = 0.0):
+        self.latency = latency
+        self.jitter = jitter
+        self.drop = drop
+
+    @property
+    def conditioned(self) -> bool:
+        return self.latency > 0 or self.jitter > 0 or self.drop > 0
+
+
+class SimNetwork:
+    """Registry of listening SimTransports + link/partition state.
+
+    Node endpoints register under a "host:port" key (the host part of
+    the node's listen address names the node).  Link specs are keyed by
+    the unordered endpoint pair; partitions are lists of key groups —
+    endpoints in different groups cannot exchange frames until heal().
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._mtx = threading.Lock()
+        self._transports: dict[str, "SimTransport"] = {}
+        self._default = LinkSpec()
+        self._links: dict[frozenset, LinkSpec] = {}
+        self._groups: list[set[str]] | None = None
+
+    # -- registry ----------------------------------------------------------
+    def _register(self, key: str, transport: "SimTransport") -> None:
+        with self._mtx:
+            if key in self._transports:
+                raise TransportError(f"simnet address {key!r} taken")
+            self._transports[key] = transport
+
+    def _unregister(self, key: str) -> None:
+        with self._mtx:
+            self._transports.pop(key, None)
+
+    # -- link conditioning -------------------------------------------------
+    def set_default_link(self, latency: float = 0.0, jitter: float = 0.0,
+                         drop: float = 0.0) -> None:
+        with self._mtx:
+            self._default = LinkSpec(latency, jitter, drop)
+
+    def set_link(self, a: str, b: str, latency: float = 0.0,
+                 jitter: float = 0.0, drop: float = 0.0) -> None:
+        """Condition the (a, b) link; names may be bare hosts or
+        'host:port' keys."""
+        with self._mtx:
+            self._links[self._pair(a, b)] = LinkSpec(latency, jitter,
+                                                     drop)
+
+    @staticmethod
+    def _norm(name: str) -> str:
+        return name.split(":")[0]
+
+    def _pair(self, a: str, b: str) -> frozenset:
+        return frozenset((self._norm(a), self._norm(b)))
+
+    def link_spec(self, a: str, b: str) -> LinkSpec:
+        with self._mtx:
+            return self._links.get(self._pair(a, b), self._default)
+
+    def link_rng(self, a: str, b: str) -> random.Random:
+        """Seeded per unordered link: stable across runs and process
+        restarts (never Python's randomized str hash)."""
+        lo, hi = sorted((self._norm(a), self._norm(b)))
+        digest = hashlib.sha256(
+            f"simnet/{self.seed}/{lo}/{hi}".encode()).digest()
+        return random.Random(int.from_bytes(digest[:8], "big"))
+
+    # -- partitions --------------------------------------------------------
+    def partition(self, *groups) -> None:
+        """Split the network: endpoints in different groups stop
+        exchanging frames.  Endpoints named in no group are unaffected
+        (they still reach everyone)."""
+        self._groups_set([set(self._norm(n) for n in g) for g in groups])
+
+    def _groups_set(self, groups: list[set[str]] | None) -> None:
+        with self._mtx:
+            self._groups = groups
+
+    def heal(self) -> None:
+        self._groups_set(None)
+
+    def blocked(self, a: str, b: str) -> bool:
+        a, b = self._norm(a), self._norm(b)
+        with self._mtx:
+            groups = self._groups
+        if not groups:
+            return False
+        ga = next((i for i, g in enumerate(groups) if a in g), None)
+        gb = next((i for i, g in enumerate(groups) if b in g), None)
+        if ga is None or gb is None:
+            return False
+        return ga != gb
+
+    # -- connection establishment -------------------------------------------
+    def connect(self, from_key: str, to_key: str):
+        """Pair two endpoints across a conditioned link.  Returns
+        (local_conn, remote_conn, remote_transport)."""
+        with self._mtx:
+            target = self._transports.get(to_key)
+        if target is None or target._accept_cb is None:
+            raise TransportError(f"no simnet listener at {to_key!r}")
+        if self.blocked(from_key, to_key):
+            raise TransportError(
+                f"simnet partition blocks {from_key!r} -> {to_key!r}")
+        link = _Link(self, from_key, to_key)
+        return link.end_a, link.end_b, target
+
+
+class _Link:
+    """One bidirectional connection: two endpoints, two delivery pumps.
+
+    Each direction is a FIFO of (due_time, frame); the pump sleeps
+    until due and moves frames into the receiving endpoint's inbox.
+    Conditioning (drop decision, delay draw) happens at SEND time from
+    the link's seeded RNG, so the fault schedule depends only on the
+    seed and the sequence of sends, not on receiver timing."""
+
+    def __init__(self, network: SimNetwork, key_a: str, key_b: str):
+        self.network = network
+        self.key_a = key_a
+        self.key_b = key_b
+        self._rng = network.link_rng(key_a, key_b)
+        self._rng_mtx = threading.Lock()
+        self._closed = threading.Event()
+        self.end_a = _SimConn(self, key_a, key_b)
+        self.end_b = _SimConn(self, key_b, key_a)
+        self.end_a._peer = self.end_b
+        self.end_b._peer = self.end_a
+
+    def send(self, src: "_SimConn", data: bytes) -> None:
+        if self._closed.is_set():
+            raise OSError("simnet connection closed")
+        if self.network.blocked(src.local_key, src.remote_key):
+            return                       # partitioned: blackholed
+        spec = self.network.link_spec(src.local_key, src.remote_key)
+        delay = 0.0
+        if spec.conditioned:
+            with self._rng_mtx:
+                if spec.drop > 0 and self._rng.random() < spec.drop:
+                    return               # dropped whole frame
+                if spec.jitter > 0:
+                    delay = spec.latency + self._rng.random() * spec.jitter
+                else:
+                    delay = spec.latency
+        src._peer._deliver(data, delay)
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for end in (self.end_a, self.end_b):
+            end._deliver(_CLOSED, 0.0)
+
+
+class _SimConn:
+    """One endpoint: the conn interface MConnection drives
+    (write / read / close) plus the remote_addr attribute the Switch
+    reads for inbound peers."""
+
+    def __init__(self, link: _Link, local_key: str, remote_key: str):
+        self._link = link
+        self.local_key = local_key
+        self.remote_key = remote_key
+        self.remote_addr = remote_key
+        self._peer: _SimConn | None = None
+        self._inbox: queue.Queue = queue.Queue()
+        self._sched: queue.Queue = queue.Queue()
+        self._pump_started = False
+        self._pump_mtx = threading.Lock()
+
+    # -- receiving side plumbing (called by the OTHER endpoint) -----------
+    def _deliver(self, frame, delay: float) -> None:
+        # once any frame has been delayed, EVERY later frame routes
+        # through the pump — mixing direct puts with an active pump
+        # would reorder frames and corrupt message reassembly.  Frames
+        # for one endpoint come from a single sender thread
+        # (MConnection's send routine), so the started flag cannot race.
+        if delay > 0:
+            self._ensure_pump()
+        if self._pump_started:
+            self._sched.put((time.monotonic() + delay, frame))
+        else:
+            self._inbox.put(frame)
+
+    def _ensure_pump(self) -> None:
+        with self._pump_mtx:
+            if self._pump_started:
+                return
+            self._pump_started = True
+            threading.Thread(target=self._pump, daemon=True,
+                             name=f"simnet-pump-{self.local_key}").start()
+
+    def _pump(self) -> None:
+        while True:
+            due, frame = self._sched.get()
+            wait = due - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            self._inbox.put(frame)
+            if frame is _CLOSED:
+                return
+
+    # -- conn interface ----------------------------------------------------
+    def write(self, data: bytes) -> int:
+        self._link.send(self, data)
+        return len(data)
+
+    def read(self) -> bytes:
+        item = self._inbox.get()
+        if item is _CLOSED:
+            self._inbox.put(_CLOSED)     # every later read also EOFs
+            return b""
+        return item
+
+    def close(self) -> None:
+        self._link.close()
+
+
+class SimTransport:
+    """Drop-in for p2p.transport.MultiplexTransport over a SimNetwork.
+
+    Addresses look like the real thing ('id@host:port') so
+    Switch.dial_peer's parsing, peer-ID pinning, and dedup all run
+    unchanged; the 'host' names the node inside the network.
+    """
+
+    def __init__(self, network: SimNetwork, node_key, node_info):
+        self.network = network
+        self.node_key = node_key
+        self.node_info = node_info
+        self._accept_cb = None
+        self.key: str | None = None
+        self._closed = False
+
+    # -- MultiplexTransport surface ----------------------------------------
+    def listen(self, addr: str, accept_cb) -> str:
+        _, host, port = parse_addr(addr)
+        self.key = f"{host}:{port}"
+        self._accept_cb = accept_cb
+        self.network._register(self.key, self)
+        return self.key
+
+    def dial(self, addr: str):
+        """-> (conn, their NodeInfo); same gate order as
+        transport.upgrade: identity pin, self-connect, compatibility."""
+        if self._closed:
+            raise TransportError("transport closed")
+        peer_id, host, port = parse_addr(addr)
+        if self.key is None:
+            raise TransportError("dial before listen")
+        local, remote, target = self.network.connect(
+            self.key, f"{host}:{port}")
+        their_info = target.node_info
+        if peer_id and their_info.node_id != peer_id:
+            local.close()
+            raise ErrRejected(
+                f"peer ID mismatch: dialed {peer_id}, got "
+                f"{their_info.node_id}")
+        if their_info.node_id == self.node_info.node_id:
+            local.close()
+            raise ErrRejected("connected to self")
+        try:
+            self.node_info.compatible_with(their_info)
+            their_info.compatible_with(self.node_info)
+        except Exception as e:
+            local.close()
+            raise ErrRejected(str(e)) from e
+        # hand the remote end to the target's accept loop off-thread,
+        # like the real transport's per-connection handler
+        my_info = self.node_info
+        threading.Thread(
+            target=target._handle_inbound, args=(remote, my_info),
+            daemon=True, name=f"simnet-accept-{target.key}").start()
+        return local, their_info
+
+    def _handle_inbound(self, conn, their_info) -> None:
+        cb = self._accept_cb
+        if cb is None or self._closed:
+            conn.close()
+            return
+        try:
+            cb(conn, their_info)
+        except Exception:
+            conn.close()
+
+    def close(self) -> None:
+        self._closed = True
+        if self.key is not None:
+            self.network._unregister(self.key)

@@ -64,23 +64,28 @@ def main():
         dev._a_tables_jitted = jax.jit(dev._msm_tables)
         dev._jitted = jax.jit(dev.verify_kernel)
 
-    for group in (1, 4):
-        batch = 131071
-        key = {"group": group, "batch": batch}
-        if _arm_key({"name": "win_group_ab", **key}) in done:
-            continue
-        log("win_group_ab", **key, start=True)
-        try:
-            pallas_msm.WIN_GROUP = group
-            refresh_jits()
-            r = bench.bench_rlc(batch, 8, passes=3)
-            log("win_group_ab", **key,
-                sigs_per_sec=round(r, 1),
-                pass_rates=bench.bench_rlc.last_pass_rates,
-                t=round(time.time() - t0, 1))
-        except Exception as e:
-            log("win_group_ab", **key, error=repr(e)[:200])
-    pallas_msm.WIN_GROUP = dflt_group
+    try:
+        for group in (1, 4):
+            batch = 131071
+            key = {"group": group, "batch": batch}
+            if _arm_key({"name": "win_group_ab", **key}) in done:
+                continue
+            log("win_group_ab", **key, start=True)
+            try:
+                pallas_msm.WIN_GROUP = group
+                refresh_jits()
+                r = bench.bench_rlc(batch, 8, passes=3)
+                log("win_group_ab", **key,
+                    sigs_per_sec=round(r, 1),
+                    pass_rates=bench.bench_rlc.last_pass_rates,
+                    t=round(time.time() - t0, 1))
+            except Exception as e:
+                log("win_group_ab", **key, error=repr(e)[:200])
+    finally:
+        # a watchdog trip / unexpected raise must not leak the steered
+        # group override into whatever runs next in this process
+        # (ADVICE r5 finding 5)
+        pallas_msm.WIN_GROUP = dflt_group
     log("done5b", t=round(time.time() - t0, 1))
 
 
