@@ -240,6 +240,17 @@ def bench_light_e2e() -> dict:
     return simbench.bench_light_e2e()
 
 
+def bench_consensus_e2e() -> dict:
+    """Live rounds through the real consensus reactor over simnet:
+    blocks committed per wall second, with the per-stage consensus
+    breakdown (propose/prevote/precommit/commit + the vote-verify
+    dispatch/device spans) and round-latency percentiles.  Sizes via
+    SIMNET_CONSENSUS_BLOCKS / SIMNET_CONSENSUS_VALS (defaults
+    12 x 4)."""
+    from cometbft_tpu.simnet import bench as simbench
+    return simbench.bench_consensus_e2e()
+
+
 def _probe_device_once(timeout_s: float = 120.0) -> str | None:
     """One probe attempt in a subprocess (a raw jax.devices() on a
     wedged axon relay hangs indefinitely).  Returns None on success,
@@ -899,6 +910,18 @@ def main() -> None:
               " overrides)")
     _attach_e2e_detail("light_e2e_headers_per_sec",
                        "light_e2e_detail", _simbench.last_light)
+    run_extra("consensus_e2e_blocks_per_sec",
+              lambda: bench_consensus_e2e()["blocks_per_sec"],
+              "consensus_e2e_config",
+              "simnet e2e: live multi-validator rounds through the"
+              " real consensus reactor (defaults 12 blocks x 4"
+              " validators; SIMNET_CONSENSUS_* overrides); detail"
+              " carries the per-stage consensus breakdown +"
+              " round-latency percentiles + per-node flight-recorder"
+              " summaries")
+    _attach_e2e_detail("consensus_e2e_blocks_per_sec",
+                       "consensus_e2e_detail",
+                       getattr(_simbench, "last_consensus", None))
 
     # -- deepening tier: strictly-better configs measured by the r4b
     # sweeps; a wedge here can only cost the upgrades, never a metric

@@ -21,6 +21,8 @@ from dataclasses import dataclass
 
 _log = logging.getLogger(__name__)
 
+from ..libs import flightrec
+from ..libs import trace as libtrace
 from ..libs.fail import fail_point
 from ..libs.service import BaseService
 from ..types import events as events_
@@ -95,7 +97,12 @@ class ConsensusState(BaseService):
         self.wal = wal
         # optional ConsensusMetrics (libs/metrics.py), assigned by the node
         self.metrics = None
+        # optional FlightRecorder (libs/flightrec.py), assigned by the
+        # node/simnet wiring; None keeps every hot path a single test
+        self.recorder = None
         self._last_commit_monotonic = None
+        self._step_start = time.monotonic()
+        self._round_start = time.monotonic()
         self.priv_validator = priv_validator
         self.priv_validator_pub_key = \
             priv_validator.get_pub_key() if priv_validator else None
@@ -260,6 +267,10 @@ class ConsensusState(BaseService):
         if ti.height != self.height or ti.round < self.round or \
                 (ti.round == self.round and ti.step < self.step):
             return
+        if not self.replay_mode and self.recorder is not None:
+            self.recorder.record(
+                flightrec.EV_TIMEOUT, height=ti.height, round=ti.round,
+                step=STEP_NAMES.get(ti.step, str(ti.step)))
         if ti.step == STEP_NEW_HEIGHT:
             self.enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:
@@ -338,8 +349,9 @@ class ConsensusState(BaseService):
             height = state.initial_height
 
         self.height = height
-        self.round = 0
-        self.step = STEP_NEW_HEIGHT
+        self._update_round_step(0, STEP_NEW_HEIGHT)
+        if not self.replay_mode and self.recorder is not None:
+            self.recorder.record(flightrec.EV_NEW_HEIGHT, height=height)
         if self.commit_time == 0.0:
             self.start_time = time.monotonic() + self.config.timeout_commit
         else:
@@ -408,6 +420,34 @@ class ConsensusState(BaseService):
             round=round_, step=step))
 
     def _update_round_step(self, round_: int, step: int) -> None:
+        """Every round/step transition funnels through here — the one
+        place step_duration / round_duration / the flight recorder see
+        the timeline (reference state.go updateRoundStep with
+        metrics.MarkStep / MarkRound)."""
+        now = time.monotonic()
+        if not self.replay_mode:
+            # round 0 re-entry at a new height counts as a new round
+            new_round = round_ != self.round or \
+                (round_ == 0 and step == STEP_NEW_ROUND)
+            m = self.metrics
+            if m is not None:
+                if step != self.step:
+                    m.step_duration_seconds.labels(
+                        STEP_NAMES.get(self.step, str(self.step))
+                    ).observe(now - self._step_start)
+                if new_round:
+                    m.round_duration_seconds.observe(
+                        now - self._round_start)
+            if step != self.step:
+                self._step_start = now
+            if new_round:
+                self._round_start = now
+            rec = self.recorder
+            if rec is not None and (round_ != self.round
+                                    or step != self.step):
+                rec.record(flightrec.EV_STEP, height=self.height,
+                           round=round_,
+                           step=STEP_NAMES.get(step, str(step)))
         self.round = round_
         self.step = step
 
@@ -442,6 +482,15 @@ class ConsensusState(BaseService):
         self.validators = validators
         if self.metrics is not None:
             self.metrics.rounds.set(round_)
+        if round_ > 0 and not self.replay_mode and \
+                self.recorder is not None:
+            # the timeline that led here is exactly what the recorder
+            # exists to answer — dump it on the first escalation
+            self.recorder.record(flightrec.EV_ESCALATION,
+                                 height=height, round=round_)
+            if round_ == 1:
+                self.recorder.dump_to_log(
+                    f"height {height} escalated past round 0", _log)
         if round_ != 0:
             # round catchup: clear the proposal from the earlier round
             self.proposal = None
@@ -488,7 +537,8 @@ class ConsensusState(BaseService):
             if not self.validators.has_address(addr):
                 return
             if self._is_proposer(addr):
-                self._decide_proposal(height, round_)
+                with libtrace.span("consensus", "propose"):
+                    self._decide_proposal(height, round_)
         finally:
             self._update_round_step(round_, STEP_PROPOSE)
             self._new_step()
@@ -559,10 +609,17 @@ class ConsensusState(BaseService):
                 (self.round == round_ and self.step >= STEP_PREVOTE):
             return
         try:
-            self._do_prevote(height, round_)
+            with libtrace.span("consensus", "prevote"):
+                self._do_prevote(height, round_)
         finally:
             self._update_round_step(round_, STEP_PREVOTE)
             self._new_step()
+
+    def _mark_proposal(self, status: str) -> None:
+        """proposal_receive_count{status}: the prevote-time verdict on
+        the proposal (reference MarkProposalProcessed)."""
+        if self.metrics is not None and not self.replay_mode:
+            self.metrics.proposal_receive_count.labels(status).inc()
 
     def _do_prevote(self, height: int, round_: int) -> None:
         """defaultDoPrevote (state.go:1387)."""
@@ -587,10 +644,12 @@ class ConsensusState(BaseService):
                 if self.state.consensus_params.pbts_enabled(height):
                     if self.proposal.timestamp != \
                             self.proposal_block.header.time:
+                        self._mark_proposal("rejected")
                         self._sign_add_vote(PREVOTE_TYPE, b"",
                                             PartSetHeader())
                         return
                     if not self._proposal_is_timely():
+                        self._mark_proposal("rejected")
                         self._sign_add_vote(PREVOTE_TYPE, b"",
                                             PartSetHeader())
                         return
@@ -599,15 +658,18 @@ class ConsensusState(BaseService):
                     self.block_exec.validate_block(self.state,
                                                    self.proposal_block)
                 except Exception:
+                    self._mark_proposal("rejected")
                     self._sign_add_vote(PREVOTE_TYPE, b"",
                                         PartSetHeader())
                     return
                 # app-level validity
                 if not self.block_exec.process_proposal(
                         self.proposal_block, self.state):
+                    self._mark_proposal("rejected")
                     self._sign_add_vote(PREVOTE_TYPE, b"",
                                         PartSetHeader())
                     return
+                self._mark_proposal("accepted")
                 self._sign_add_vote(PREVOTE_TYPE, block_hash,
                                     self.proposal_block_parts.header)
                 return
@@ -664,7 +726,8 @@ class ConsensusState(BaseService):
                 (self.round == round_ and self.step >= STEP_PRECOMMIT):
             return
         try:
-            self._do_precommit(height, round_)
+            with libtrace.span("consensus", "precommit"):
+                self._do_precommit(height, round_)
         finally:
             self._update_round_step(round_, STEP_PRECOMMIT)
             self._new_step()
@@ -779,11 +842,14 @@ class ConsensusState(BaseService):
         self._finalize_commit(height)
 
     def _finalize_commit(self, height: int) -> None:
-        """state.go:1834: save -> WAL EndHeight (fsync) -> apply -> next
-        height. The ordering is the crash-recovery contract."""
         if self.height != height or self.step != STEP_COMMIT:
             return
+        with libtrace.span("consensus", "commit"):
+            self._do_finalize_commit(height)
 
+    def _do_finalize_commit(self, height: int) -> None:
+        """state.go:1834: save -> WAL EndHeight (fsync) -> apply -> next
+        height. The ordering is the crash-recovery contract."""
         block_id, ok = self.votes.precommits(
             self.commit_round).two_thirds_majority()
         block, block_parts = self.proposal_block, self.proposal_block_parts
@@ -879,6 +945,10 @@ class ConsensusState(BaseService):
         if self.proposal_block_parts is None:
             self.proposal_block_parts = PartSet.new_from_header(
                 proposal.block_id.part_set_header)
+        if not self.replay_mode and self.recorder is not None:
+            self.recorder.record(
+                flightrec.EV_PROPOSAL, height=proposal.height,
+                round=proposal.round, pol_round=proposal.pol_round)
         self._notify_listeners("proposal", proposal)
 
     def _add_proposal_block_part(self, msg: msgs.BlockPartMessage,
@@ -951,15 +1021,41 @@ class ConsensusState(BaseService):
         except Exception:
             return False
 
+    _VOTE_TYPE_NAMES = {PREVOTE_TYPE: "prevote",
+                        PRECOMMIT_TYPE: "precommit"}
+
+    def _record_vote(self, vote: Vote, late: bool) -> None:
+        """Vote-arrival observability: lateness counter + one flight
+        recorder event per vote (cheap: a lock and a ring store)."""
+        if self.replay_mode:
+            return
+        tname = self._VOTE_TYPE_NAMES.get(vote.type, str(vote.type))
+        if late and self.metrics is not None:
+            self.metrics.late_votes.labels(tname).inc()
+        if self.recorder is not None:
+            self.recorder.record(
+                flightrec.EV_VOTE, height=vote.height, round=vote.round,
+                type=tname, index=vote.validator_index, late=late)
+
+    def _count_duplicate_vote(self) -> None:
+        if self.metrics is not None and not self.replay_mode:
+            self.metrics.duplicate_vote_count.inc()
+
     def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         """state.go:2294."""
+        self._record_vote(
+            vote, late=vote.height < self.height or
+            (vote.height == self.height and vote.round < self.round))
         # precommit for the previous height (during commit timeout)
         if vote.height + 1 == self.height and \
                 vote.type == PRECOMMIT_TYPE:
             if self.step != STEP_NEW_HEIGHT:
                 return False
-            added = self.last_commit.add_vote(vote) \
-                if self.last_commit else False
+            added = False
+            if self.last_commit is not None:
+                added = self.last_commit.add_vote(vote)
+                if not added:
+                    self._count_duplicate_vote()
             if added:
                 self.event_bus.publish_vote(events_.EventDataVote(vote))
                 self._notify_listeners("vote", vote)
@@ -989,6 +1085,7 @@ class ConsensusState(BaseService):
         height = self.height
         added = self.votes.add_vote(vote, peer_id)
         if not added:
+            self._count_duplicate_vote()
             return False
 
         self.event_bus.publish_vote(events_.EventDataVote(vote))
@@ -1004,6 +1101,21 @@ class ConsensusState(BaseService):
         prevotes = self.votes.prevotes(vote.round)
 
         block_id, ok = prevotes.two_thirds_majority()
+        if self.metrics is not None and not self.replay_mode and \
+                self.proposal is not None and vote.round == self.round:
+            # seconds from the proposal timestamp to the prevote quorum
+            # arriving / to the full prevote set arriving (reference
+            # quorum_prevote_delay / full_prevote_delay gauges) — the
+            # number that says whether slow rounds wait on gossip or on
+            # verification
+            if ok:
+                self.metrics.quorum_prevote_delay.set(
+                    Timestamp.now().diff_ns(self.proposal.timestamp)
+                    / 1e9)
+            if all(v is not None for v in prevotes.votes):
+                self.metrics.full_prevote_delay.set(
+                    Timestamp.now().diff_ns(self.proposal.timestamp)
+                    / 1e9)
         if ok and not block_id.is_nil():
             # update valid block on POL
             if self.valid_round < vote.round and vote.round == self.round:

@@ -96,11 +96,13 @@ def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
         packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n, parsed=parsed)
         if packed is not None and ed.rlc_verify(packed):
             return True, [True] * n
+        from ..libs import flightrec
         from ..libs import metrics as libmetrics
 
         dm = libmetrics.device_metrics()
         if dm is not None:
             dm.rlc_fallbacks.inc()
+        flightrec.record(flightrec.EV_RLC_FALLBACK, batch=n)
     bucket = dev.bucket_size(n)
     a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
                                       bucket, parsed=parsed)

@@ -124,42 +124,59 @@ class StreamingVerifier(BaseService):
             return
         self.flushes += 1
         self.verified += len(batch)
+        from ..libs import flightrec
         from ..libs import metrics as libmetrics
+        from ..libs import trace as libtrace
 
         dm = libmetrics.device_metrics()
         t0 = time.monotonic()
         path = "host"
         try:
-            if len(batch) >= self.device_threshold:
-                try:
-                    self._flush_device(batch)
-                    path = "device"
-                    return
-                except Exception:  # device trouble: host path still right
-                    pass
-            for pk, msg, sig, fut in batch:
-                if not fut.set_running_or_notify_cancel():
-                    continue
-                try:
-                    fut.set_result(_host_verify(pk, msg, sig))
-                except Exception as e:  # pragma: no cover
-                    fut.set_exception(e)
+            # the vote-verify dispatch IS the consensus hot path the
+            # stage-span framework exists for
+            with libtrace.span("consensus", "verify_dispatch"):
+                if len(batch) >= self.device_threshold:
+                    try:
+                        self._flush_device(batch)
+                        path = "device"
+                        return
+                    except Exception as e:
+                        # device trouble: host path is still correct,
+                        # but the operator must be able to see it
+                        rec = flightrec.recorder()
+                        if rec is not None:
+                            rec.record(flightrec.EV_DEVICE_FALLBACK,
+                                       batch=len(batch),
+                                       error=type(e).__name__)
+                            rec.dump_to_log(
+                                "device verify flush failed: %r" % e)
+                for pk, msg, sig, fut in batch:
+                    if not fut.set_running_or_notify_cancel():
+                        continue
+                    try:
+                        fut.set_result(_host_verify(pk, msg, sig))
+                    except Exception as e:  # pragma: no cover
+                        fut.set_exception(e)
         finally:
             if dm is not None:
                 dm.flushes.labels(path).inc()
                 dm.batch_size.labels(path).observe(len(batch))
                 dm.flush_latency_seconds.observe(time.monotonic() - t0)
+            flightrec.record(flightrec.EV_VERIFY_FLUSH, path=path,
+                             batch=len(batch))
 
     def _flush_device(self, batch) -> None:
         from . import batch as cb
         from . import ed25519 as ed
+        from ..libs import trace as libtrace
 
         self.device_flushes += 1
         pks = [b[0] for b in batch]
         msgs = [b[1] for b in batch]
         sigs = [b[2] for b in batch]
-        parsed = ed.parse_and_hash(pks, msgs, sigs)
-        _, verdicts = cb._device_verify(pks, parsed)
+        with libtrace.span("consensus", "device"):
+            parsed = ed.parse_and_hash(pks, msgs, sigs)
+            _, verdicts = cb._device_verify(pks, parsed)
         for (_, _, _, fut), ok in zip(batch, verdicts):
             if fut.set_running_or_notify_cancel():
                 fut.set_result(bool(ok))

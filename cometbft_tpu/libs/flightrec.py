@@ -1,0 +1,160 @@
+"""Consensus flight recorder: a bounded ring buffer of structured
+events covering one node's round lifecycle — step/round transitions,
+timeout fires, vote arrivals (with lateness), proposal receipts,
+verify flushes (batch size + execution path), and RLC fallbacks.
+
+The reference answers "why did this round go long?" with the
+DumpConsensusState RPC — a snapshot of the CURRENT round state.  A
+snapshot cannot show the timeline that led there, and the question this
+framework exists for (where between vote arrival and device flush did
+the time go?) is inherently a timeline question.  So the recorder is
+event-sourced: recording is always-on once installed, the buffer is
+bounded (old events overwrite, totals keep counting), and dumps are
+reachable three ways — the `flightrec` RPC route (rpc/core.py), the
+`/debug/pprof/flightrec` handler (libs/pprof.py), and an automatic
+dump-to-log when a height escalates past round 0 or a device verify
+flush fails.
+
+Cost contract (the acceptance bar for the kernel benches): with no
+recorder installed, the hot paths pay ONE module-global read and an
+`is None` test — the same seam discipline as metrics.device_metrics()
+and trace.tracer().  With a recorder installed, one event is a lock,
+two integer ops, and a list store; there is no serialization, no I/O,
+and no allocation beyond the caller's field dict.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+_log = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = 4096
+
+# canonical event kinds (callers may record others; these are the ones
+# the consensus/crypto layers emit)
+EV_STEP = "step"                     # round-step transition
+EV_NEW_HEIGHT = "new_height"         # height advanced (commit applied)
+EV_TIMEOUT = "timeout"               # a scheduled timeout fired
+EV_VOTE = "vote"                     # vote arrival (with lateness)
+EV_PROPOSAL = "proposal"             # proposal receipt
+EV_ESCALATION = "round_escalation"   # height moved past round 0
+EV_VERIFY_FLUSH = "verify_flush"     # streaming-verifier flush
+EV_DEVICE_FALLBACK = "device_fallback"  # device flush failed -> host
+EV_RLC_FALLBACK = "rlc_fallback"     # RLC whole-batch check failed
+
+
+class FlightRecorder:
+    """Bounded ring of (seq, monotonic, kind, fields) event tuples.
+
+    `recorded` counts every event ever seen; the ring keeps the last
+    `capacity` of them, so `dropped = recorded - len(ring)`.  Thread
+    safe: consensus state thread, reactor gossip threads, and the
+    votestream worker all record into one instance.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._clock = clock
+        self._mtx = threading.Lock()
+        self._ring: list = [None] * capacity
+        self._recorded = 0
+
+    # -- recording ---------------------------------------------------------
+    def record(self, kind: str, **fields) -> None:
+        t = self._clock()
+        with self._mtx:
+            seq = self._recorded
+            self._ring[seq % self.capacity] = (seq, t, kind, fields)
+            self._recorded = seq + 1
+
+    # -- reading -----------------------------------------------------------
+    def __len__(self) -> int:
+        with self._mtx:
+            return min(self._recorded, self.capacity)
+
+    @property
+    def recorded(self) -> int:
+        with self._mtx:
+            return self._recorded
+
+    def events(self) -> list[dict]:
+        """Oldest-to-newest snapshot of the retained events."""
+        with self._mtx:
+            n = self._recorded
+            kept = min(n, self.capacity)
+            raw = [self._ring[(n - kept + i) % self.capacity]
+                   for i in range(kept)]
+        return [{"seq": seq, "t": t, "kind": kind, **dict(fields)}
+                for (seq, t, kind, fields) in raw]
+
+    def dump(self) -> dict:
+        evs = self.events()
+        return {
+            "recorded": self.recorded,
+            "dropped": self.recorded - len(evs),
+            "capacity": self.capacity,
+            "events": evs,
+        }
+
+    def summary(self) -> dict:
+        """Per-kind counts over the retained window plus totals — the
+        shape simnet reports per node next to its e2e rates."""
+        counts: dict[str, int] = {}
+        max_round = 0
+        for e in self.events():
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+            if e["kind"] in (EV_STEP, EV_ESCALATION):
+                max_round = max(max_round, int(e.get("round", 0)))
+        return {"recorded": self.recorded,
+                "dropped": self.recorded - len(self),
+                "by_kind": counts,
+                "max_round_seen": max_round}
+
+    def dump_text(self) -> str:
+        d = self.dump()
+        lines = [f"flight recorder: {d['recorded']} recorded, "
+                 f"{d['dropped']} dropped (capacity {d['capacity']})"]
+        for e in d["events"]:
+            extra = " ".join(f"{k}={v}" for k, v in e.items()
+                             if k not in ("seq", "t", "kind"))
+            lines.append(f"  #{e['seq']:<6} t={e['t']:.6f} "
+                         f"{e['kind']:<16} {extra}")
+        return "\n".join(lines)
+
+    def dump_to_log(self, reason: str, logger=None) -> None:
+        (logger or _log).warning("flight recorder dump (%s):\n%s",
+                                 reason, self.dump_text())
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._ring = [None] * self.capacity
+            self._recorded = 0
+
+
+# -- process-wide seam -------------------------------------------------------
+# Layers below any node wiring (crypto/votestream, crypto/batch) report
+# through this, exactly like metrics.set_device_metrics / trace.set_tracer.
+_recorder: FlightRecorder | None = None
+
+
+def set_recorder(r: FlightRecorder | None) -> None:
+    global _recorder
+    _recorder = r
+
+
+def recorder() -> FlightRecorder | None:
+    return _recorder
+
+
+def record(kind: str, **fields) -> None:
+    """Record into the process-wide recorder; free when none is set."""
+    r = _recorder
+    if r is None:
+        return
+    r.record(kind, **fields)

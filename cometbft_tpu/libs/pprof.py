@@ -8,6 +8,7 @@ Endpoints (GET):
   /debug/pprof/heap       - gc + allocation counters, top object types
   /debug/pprof/profile?seconds=N - statistical CPU profile (cProfile)
   /debug/pprof/cmdline    - process command line
+  /debug/pprof/flightrec  - consensus flight recorder dump
 """
 
 from __future__ import annotations
@@ -19,7 +20,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
-_ENDPOINTS = ("goroutine", "heap", "profile", "cmdline")
+_ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec")
 
 
 def _dump_threads() -> str:
@@ -119,6 +120,13 @@ class PprofServer:
                     self._text(_cpu_profile(secs))
                 elif name == "cmdline":
                     self._text("\x00".join(sys.argv))
+                elif name == "flightrec":
+                    from . import flightrec as _fr
+                    rec = _fr.recorder()
+                    if rec is None:
+                        self._text("no flight recorder installed", 404)
+                    else:
+                        self._text(rec.dump_text())
                 else:
                     self._text("unknown profile", 404)
 

@@ -324,6 +324,14 @@ class Node(BaseService):
         self.grpc_server = None
         self.grpc_privileged_server = None
 
+        # consensus flight recorder: always-on (recording one event is a
+        # lock + ring store), dumpable via the flightrec RPC route and
+        # /debug/pprof/flightrec; the CONSENSUS layer reaches it through
+        # consensus_state.recorder, so per-node even in shared processes
+        from ..libs.flightrec import FlightRecorder
+        self.flight_recorder = FlightRecorder()
+        self.consensus_state.recorder = self.flight_recorder
+
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
         self.metrics_server = None
@@ -366,6 +374,11 @@ class Node(BaseService):
             from ..libs.metrics import TraceMetrics
             libtrace.set_tracer(libtrace.StageTracer(
                 TraceMetrics(registry)))
+            # the votestream/RLC layers sit below node wiring and
+            # report flush / fallback events through the same kind of
+            # process-wide seam
+            from ..libs import flightrec as libflightrec
+            libflightrec.set_recorder(self.flight_recorder)
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -449,12 +462,14 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         if self.metrics_server is not None:
-            # this node owns the process-wide device-metrics and
-            # stage-tracer seams
+            # this node owns the process-wide device-metrics,
+            # stage-tracer, and flight-recorder seams
+            from ..libs import flightrec as libflightrec
             from ..libs import metrics as libmetrics
             from ..libs import trace as libtrace
             libmetrics.set_device_metrics(None)
             libtrace.set_tracer(None)
+            libflightrec.set_recorder(None)
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if self.privileged_rpc_server is not None:

@@ -150,6 +150,9 @@ class MConnection(BaseService):
         on_receive(channel_id, msg_bytes); on_error(exc)."""
         super().__init__("MConnection")
         self._conn = conn
+        # optional P2PMetrics (libs/metrics.py), assigned by the switch:
+        # per-channel framed-byte counters at the wire seam
+        self.metrics = None
         self._channels: dict[int, _Channel] = {
             d.id: _Channel(d) for d in channel_descs}
         self._on_receive = on_receive
@@ -265,6 +268,11 @@ class MConnection(BaseService):
                     batch.append(pkt)
                     batch_bytes += len(pkt)
                     self._send_monitor.update(len(pkt))
+                    if self.metrics is not None:
+                        # framed length: prefix + packet, the bytes the
+                        # wire actually carries for this channel
+                        self.metrics.message_send_bytes_total.labels(
+                            "%#x" % ch.desc.id).add(4 + len(pkt))
                     if time.monotonic() >= deadline or \
                             batch_bytes > 64 * 1024:
                         self._conn.write(b"".join(
@@ -322,6 +330,9 @@ class MConnection(BaseService):
         ch = self._channels.get(ch_id)
         if ch is None:
             raise MConnectionError(f"unknown channel {ch_id}")
+        if self.metrics is not None:
+            self.metrics.message_receive_bytes_total.labels(
+                "%#x" % ch_id).add(4 + len(payload))
         msg = ch.recv_packet(eof, data)
         if msg is not None:
             self._on_receive(ch_id, msg)

@@ -150,6 +150,7 @@ class Switch(BaseService):
             conn = self.conn_wrap(conn)
         mconn = MConnection(conn, self.channel_descs, on_receive,
                             on_error)
+        mconn.metrics = self.metrics
         peer = Peer(node_info, mconn, outbound, persistent, socket_addr)
         peer_ref[0] = peer
 

@@ -273,6 +273,26 @@ class Environment:
             {"node_address": p.node_info.node_id}
             for p in (self.p2p_switch.peers.list()
                       if self.p2p_switch else [])]
+        rec = getattr(self.consensus_state, "recorder", None)
+        if rec is not None:
+            out["flight_recorder"] = rec.summary()
+        return out
+
+    def flightrec_handler(self, limit=None) -> dict:
+        """Dump the consensus flight recorder (libs/flightrec.py): the
+        event timeline the round-state snapshot above cannot show.
+        `limit` keeps only the newest N events."""
+        rec = getattr(self.consensus_state, "recorder", None)
+        if rec is None:
+            from ..libs import flightrec as _fr
+            rec = _fr.recorder()
+        if rec is None:
+            raise RPCError(-32603, "flight recorder unavailable")
+        out = rec.dump()
+        if limit:
+            n = int(limit)
+            if n >= 0:
+                out["events"] = out["events"][-n:] if n else []
         return out
 
     # -- abci --------------------------------------------------------------
@@ -630,6 +650,7 @@ ROUTES = {
     "consensus_params": "consensus_params",
     "consensus_state": "consensus_state_handler",
     "dump_consensus_state": "dump_consensus_state_handler",
+    "flightrec": "flightrec_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

@@ -118,6 +118,12 @@ class SimNode:
             self.block_store, priv_validator=pv,
             event_bus=self.event_bus, evidence_pool=self.evidence_pool,
             mempool=self.mempool)
+        # per-node flight recorder (libs/flightrec.py): many nodes share
+        # this process, so each consensus state records into its own
+        # ring; benches/tests read recorder_summary() per node
+        from ..libs.flightrec import FlightRecorder
+        self.flight_recorder = FlightRecorder()
+        self.consensus_state.recorder = self.flight_recorder
         # an inactive consensus reactor still gossips/receives (real
         # wiring) but never starts the state machine
         self.consensus_reactor = ConsensusReactor(
@@ -203,6 +209,18 @@ class SimNode:
     def app_hash(self) -> bytes:
         st = self.state_store.load()
         return st.app_hash if st is not None else b""
+
+    def recorder_summary(self) -> dict:
+        """Per-kind flight-recorder counts for this node (the shape
+        bench.py reports per node next to its e2e rates)."""
+        return self.flight_recorder.summary()
+
+    def round_latencies(self) -> list[float]:
+        """Seconds between consecutive new_height recorder events —
+        the commit-to-commit round latency series for this node."""
+        heights = [e["t"] for e in self.flight_recorder.events()
+                   if e["kind"] == "new_height"]
+        return [t1 - t0 for t0, t1 in zip(heights, heights[1:])]
 
     def dial(self, other: "SimNode", persistent: bool = False) -> None:
         self.switch.dial_peer(other.addr, persistent=persistent)

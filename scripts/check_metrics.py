@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""metricsgen-style lint for the metrics bundles in
+cometbft_tpu/libs/metrics.py (the reference generates its metrics.go
+structs with scripts/metricsgen and so cannot drift; this repo writes
+them by hand and so checks them).
+
+Checks:
+  1. every registered metric's full name (subsystem_name) is unique;
+  2. subsystem and metric names are snake_case;
+  3. every bundle field (self.X = reg.counter/gauge/histogram(...)) is
+     OBSERVED somewhere — referenced as `.X` in cometbft_tpu/ or
+     tests/ outside its own registration line.  A registered metric
+     nothing ever drives is a dashboard lie.
+
+Run directly (exits 1 on findings) or through tests/test_tools.py as a
+tier-1 test.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+METRICS_PY = REPO / "cometbft_tpu" / "libs" / "metrics.py"
+SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
+REG_METHODS = ("counter", "gauge", "histogram")
+
+
+def registered_metrics(path: Path = METRICS_PY) -> list[dict]:
+    """[{cls, attr, kind, subsystem, name, lineno}] for every
+    `self.<attr> = reg.<kind>("<subsystem>", "<name>", ...)`."""
+    tree = ast.parse(path.read_text())
+    out = []
+    for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            fn = call.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in REG_METHODS):
+                continue
+            target = node.targets[0]
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            args = call.args
+            if len(args) < 2 or not all(
+                    isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    for a in args[:2]):
+                continue
+            out.append({"cls": cls.name, "attr": target.attr,
+                        "kind": fn.attr, "subsystem": args[0].value,
+                        "name": args[1].value, "lineno": node.lineno})
+    return out
+
+
+def _reference_count(attr: str, roots=("cometbft_tpu", "tests")) -> int:
+    """Occurrences of `.attr` (attribute access) across the tree,
+    excluding registration assignments in metrics.py itself."""
+    pat = re.compile(r"\.%s\b" % re.escape(attr))
+    reg_line = re.compile(
+        r"self\.%s\s*=\s*reg\.(?:%s)" % (re.escape(attr),
+                                         "|".join(REG_METHODS)))
+    count = 0
+    for root in roots:
+        for py in sorted((REPO / root).rglob("*.py")):
+            text = py.read_text()
+            n = len(pat.findall(text))
+            if py == METRICS_PY:
+                n -= len(reg_line.findall(text))
+            count += max(n, 0)
+    return count
+
+
+def run_checks() -> list[str]:
+    """All findings as human-readable strings; empty means clean."""
+    metrics = registered_metrics()
+    findings = []
+    if not metrics:
+        return ["no registered metrics found (parser broken?)"]
+
+    seen: dict[str, dict] = {}
+    for m in metrics:
+        full = f"{m['subsystem']}_{m['name']}"
+        if full in seen:
+            findings.append(
+                f"duplicate metric name {full!r}: {m['cls']}.{m['attr']} "
+                f"(line {m['lineno']}) vs {seen[full]['cls']}."
+                f"{seen[full]['attr']} (line {seen[full]['lineno']})")
+        else:
+            seen[full] = m
+        for part, label in ((m["subsystem"], "subsystem"),
+                            (m["name"], "name")):
+            if not SNAKE.match(part):
+                findings.append(
+                    f"{m['cls']}.{m['attr']}: {label} {part!r} is not "
+                    "snake_case")
+
+    for m in metrics:
+        if _reference_count(m["attr"]) == 0:
+            findings.append(
+                f"{m['cls']}.{m['attr']} ({m['subsystem']}_{m['name']}) "
+                "is registered but never observed anywhere in "
+                "cometbft_tpu/ or tests/")
+    return findings
+
+
+def main() -> int:
+    findings = run_checks()
+    for f in findings:
+        print(f"check_metrics: {f}", file=sys.stderr)
+    if findings:
+        print(f"check_metrics: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    n = len(registered_metrics())
+    print(f"check_metrics: {n} metrics OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,10 +38,83 @@ class TestRegistry:
             h.observe(v)
         text = reg.expose()
         assert 't_consensus_interval_bucket{le="0.1"} 1' in text
-        assert 't_consensus_interval_bucket{le="1.0"} 2' in text
+        # exposition conformance: le is %g-formatted ("1", never "1.0")
+        assert 't_consensus_interval_bucket{le="1"} 2' in text
+        assert 't_consensus_interval_bucket{le="1.0"}' not in text
         assert 't_consensus_interval_bucket{le="+Inf"} 3' in text
         assert "t_consensus_interval_count 3" in text
         assert "t_consensus_interval_sum 5.55" in text
+
+    def test_empty_labelless_histogram_exposes_zero_series(self):
+        """A # TYPE with no samples breaks scrapers: an unobserved
+        label-less histogram still emits zero buckets/_sum/_count."""
+        reg = Registry("t")
+        reg.histogram("consensus", "round_duration_seconds", "R.",
+                      buckets=(0.5,))
+        text = reg.expose()
+        assert 't_consensus_round_duration_seconds_bucket{le="0.5"} 0' \
+            in text
+        assert ('t_consensus_round_duration_seconds_bucket{le="+Inf"} 0'
+                in text)
+        assert "t_consensus_round_duration_seconds_sum 0" in text
+        assert "t_consensus_round_duration_seconds_count 0" in text
+
+    def test_consensus_bundle_has_reference_step_metrics(self):
+        from cometbft_tpu.libs.metrics import ConsensusMetrics
+        reg = Registry("t")
+        cm = ConsensusMetrics(reg)
+        cm.step_duration_seconds.labels("RoundStepPropose").observe(0.01)
+        cm.round_duration_seconds.observe(0.2)
+        cm.proposal_receive_count.labels("accepted").inc()
+        cm.late_votes.labels("prevote").inc()
+        cm.duplicate_vote_count.inc()
+        cm.quorum_prevote_delay.set(0.05)
+        cm.full_prevote_delay.set(0.09)
+        text = reg.expose()
+        assert ('t_consensus_step_duration_seconds_bucket{step='
+                '"RoundStepPropose",le=') in text
+        assert "t_consensus_round_duration_seconds_count 1" in text
+        assert ('t_consensus_proposal_receive_count{status="accepted"} 1'
+                in text)
+        assert 't_consensus_late_votes{vote_type="prevote"} 1' in text
+        assert "t_consensus_duplicate_vote_count 1" in text
+        assert "t_consensus_quorum_prevote_delay 0.05" in text
+        assert "t_consensus_full_prevote_delay 0.09" in text
+
+
+class TestMetricsServerBoundAddr:
+    def _scrape(self, srv):
+        with urllib.request.urlopen(
+                f"http://{srv.bound_addr}/metrics", timeout=5) as resp:
+            return resp.read().decode()
+
+    def test_bind_all_ipv4_reports_loopback(self):
+        reg = Registry("t")
+        reg.counter("a", "b", "B.").inc()
+        srv = MetricsServer(reg, "0.0.0.0:0")
+        srv.start()
+        try:
+            assert srv.bound_addr.startswith("127.0.0.1:")
+            assert "t_a_b 1" in self._scrape(srv)
+        finally:
+            srv.stop()
+
+    def test_ipv6_loopback_bracketed(self):
+        import socket
+
+        reg = Registry("t")
+        reg.counter("a", "b", "B.").inc()
+        try:
+            srv = MetricsServer(reg, "[::1]:0")
+        except (OSError, socket.gaierror):
+            import pytest
+            pytest.skip("IPv6 unavailable")
+        srv.start()
+        try:
+            assert srv.bound_addr.startswith("[::1]:")
+            assert "t_a_b 1" in self._scrape(srv)
+        finally:
+            srv.stop()
 
 
 class TestNodeMetrics:

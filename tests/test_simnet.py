@@ -216,6 +216,27 @@ class TestE2EBench:
         assert "blocksync.device" in res["stages"]
         assert simbench.last_blocksync is res
 
+    def test_consensus_e2e_bench_small(self):
+        """Live rounds through the real consensus reactor, with the
+        per-stage consensus breakdown + round-latency histogram + per
+        node flight-recorder summaries in one record."""
+        from cometbft_tpu.simnet import bench as simbench
+        res = simbench.bench_consensus_e2e(
+            n_blocks=3, n_vals=3, seed=17, timeout=120)
+        assert res["blocks_per_sec"] > 0
+        assert res["blocks"] == 3
+        for stage in ("consensus.propose", "consensus.prevote",
+                      "consensus.precommit", "consensus.commit",
+                      "consensus.verify_dispatch"):
+            assert stage in res["stages"] and \
+                res["stages"][stage]["count"] > 0, (stage, res["stages"])
+        assert res["round_latency_seconds"]["samples"] >= 1
+        assert res["round_latency_seconds"]["p50"] > 0
+        assert set(res["recorders"]) == {"cval0", "cval1", "cval2"}
+        for summ in res["recorders"].values():
+            assert summ["recorded"] > 0
+        assert simbench.last_consensus is res
+
     def test_light_e2e_over_real_rpc(self):
         """Headers through light/client.py against a simnet node's
         REAL JSON-RPC server (HttpProvider over HTTP loopback)."""
@@ -249,6 +270,132 @@ class TestTrace:
         with libtrace.span("blocksync", "device"):
             pass                         # must not record anywhere
         assert libtrace.span("a", "b") is libtrace.span("c", "d")
+
+
+class TestConsensusObservability:
+    """Acceptance: scraping /metrics during a live simnet consensus run
+    shows nonzero step durations and consensus trace spans, and a
+    partition-faulted run leaves a flight-recorder dump containing the
+    round>0 escalation timeline."""
+
+    CORE_STEPS = ("RoundStepNewHeight", "RoundStepNewRound",
+                  "RoundStepPropose", "RoundStepPrevote",
+                  "RoundStepPrecommit", "RoundStepCommit")
+
+    def test_partitioned_proposer_metrics_spans_flightrec(self):
+        import json
+        import urllib.request
+
+        from cometbft_tpu.libs.metrics import (
+            ConsensusMetrics, MetricsServer, P2PMetrics, Registry,
+            TraceMetrics)
+
+        net = SimNetwork(seed=31)
+        net.set_default_link(latency=0.002, jitter=0.001)
+        genesis, privs = make_sim_genesis(4, seed=31)
+        nodes = [SimNode(f"obs{i}", genesis, net, priv_validator=p,
+                         consensus_active=True, seed=31)
+                 for i, p in enumerate(privs)]
+
+        reg = Registry("cometbft_tpu")
+        cm = ConsensusMetrics(reg)
+        pm = P2PMetrics(reg)
+        for n in nodes:
+            n.consensus_state.metrics = cm
+            n.switch.metrics = pm
+        prev_tracer = libtrace.tracer()
+        libtrace.set_tracer(libtrace.StageTracer(TraceMetrics(reg)))
+        srv = MetricsServer(reg, "127.0.0.1:0")
+        srv.start()
+
+        live = nodes[1:]
+        try:
+            for n in nodes:
+                n.start()
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    b.dial(a)
+            # cut node 0 off: when its turn to propose comes, the live
+            # trio times out, nil-polkas, and escalates past round 0
+            net.partition({nodes[0].name}, {n.name for n in live})
+
+            def escalated():
+                return [n for n in live
+                        if any(e["kind"] == "round_escalation"
+                               for e in n.flight_recorder.events())]
+
+            assert _wait(lambda: escalated() and
+                         all(n.height() >= 1 for n in live),
+                         timeout=90), \
+                [n.height() for n in nodes]
+            esc_node = escalated()[0]
+            net.heal()
+            target = max(n.height() for n in live) + 2
+            assert _wait(lambda: all(n.height() >= target
+                                     for n in live), timeout=60), \
+                [n.height() for n in nodes]
+
+            # -- scrape /metrics over HTTP ----------------------------
+            with urllib.request.urlopen(
+                    f"http://{srv.bound_addr}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            for step in self.CORE_STEPS:
+                line = ("cometbft_tpu_consensus_step_duration_seconds"
+                        f'_count{{step="{step}"}}')
+                hits = [ln for ln in text.splitlines()
+                        if ln.startswith(line)]
+                assert hits and float(hits[0].split()[-1]) > 0, step
+            for ln in ("cometbft_tpu_consensus_round_duration_seconds"
+                       "_count",
+                       "cometbft_tpu_consensus_proposal_receive_count"
+                       '{status="accepted"}'):
+                hits = [x for x in text.splitlines()
+                        if x.startswith(ln)]
+                assert hits and float(hits[0].split()[-1]) > 0, ln
+            # consensus stage spans cover the hot path
+            for stage in ("propose", "prevote", "precommit", "commit",
+                          "verify_dispatch"):
+                needle = ('cometbft_tpu_trace_stage_duration_seconds_'
+                          'count{subsystem="consensus",stage="'
+                          f'{stage}"}}')
+                hits = [x for x in text.splitlines()
+                        if x.startswith(needle)]
+                assert hits and float(hits[0].split()[-1]) > 0, stage
+            # per-channel p2p byte counters (vote channel flowed)
+            assert ('cometbft_tpu_p2p_message_send_bytes_total'
+                    '{chID="0x22"}') in text
+            assert ('cometbft_tpu_p2p_message_receive_bytes_total'
+                    '{chID="0x22"}') in text
+
+            # -- flight-recorder escalation timeline ------------------
+            evs = esc_node.flight_recorder.events()
+            esc = next(e for e in evs
+                       if e["kind"] == "round_escalation")
+            assert esc["round"] >= 1
+            before = [e for e in evs if e["seq"] < esc["seq"]
+                      and e.get("height") == esc["height"]]
+            assert any(e["kind"] == "timeout" for e in before), \
+                "escalation timeline must show the timeouts that led up"
+            assert any(e["kind"] == "step" for e in before)
+            summ = esc_node.recorder_summary()
+            assert summ["by_kind"]["round_escalation"] >= 1
+            assert summ["max_round_seen"] >= 1
+
+            # -- the flightrec RPC route serves the same dump ---------
+            addr = esc_node.start_rpc()
+            with urllib.request.urlopen(
+                    f"http://{addr}/flightrec?limit=500",
+                    timeout=10) as resp:
+                out = json.loads(resp.read().decode())["result"]
+            assert out["recorded"] > 0
+            assert any(e["kind"] == "round_escalation"
+                       for e in out["events"])
+        finally:
+            libtrace.set_tracer(prev_tracer)
+            srv.stop()
+            for n in nodes:
+                n.stop()
 
 
 class TestConsensusOverSimnet:

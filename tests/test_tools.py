@@ -211,3 +211,52 @@ class TestWal2Json:
         assert wal2json_main([missing]) == 1
         # the dump tool must not create anything (WAL() would)
         assert not os.path.exists(os.path.dirname(missing))
+
+
+class TestCheckMetrics:
+    """scripts/check_metrics.py: the metricsgen-style lint runs as a
+    tier-1 test so a drifted metrics bundle fails CI, not a dashboard."""
+
+    @staticmethod
+    def _load():
+        import importlib.util
+        import pathlib
+        path = pathlib.Path(__file__).resolve().parent.parent / \
+            "scripts" / "check_metrics.py"
+        spec = importlib.util.spec_from_file_location(
+            "check_metrics", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_repo_bundles_are_clean(self):
+        mod = self._load()
+        assert mod.run_checks() == []
+
+    def test_parser_sees_the_new_consensus_metrics(self):
+        mod = self._load()
+        metrics = mod.registered_metrics()
+        assert len(metrics) >= 50
+        names = {(m["subsystem"], m["name"]) for m in metrics}
+        for want in ("step_duration_seconds", "round_duration_seconds",
+                     "quorum_prevote_delay", "proposal_receive_count",
+                     "late_votes", "duplicate_vote_count"):
+            assert ("consensus", want) in names, want
+        for want in ("message_send_bytes_total",
+                     "message_receive_bytes_total"):
+            assert ("p2p", want) in names, want
+
+    def test_parser_flags_bad_bundles(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "m.py"
+        bad.write_text(
+            "class A:\n"
+            "    def __init__(self, reg):\n"
+            "        self.x = reg.counter('c', 'CamelCase', 'H.')\n"
+            "        self.y = reg.gauge('c', 'dup', 'H.')\n"
+            "        self.z = reg.gauge('c', 'dup', 'H.')\n")
+        metrics = mod.registered_metrics(bad)
+        assert {m["attr"] for m in metrics} == {"x", "y", "z"}
+        full = [f"{m['subsystem']}_{m['name']}" for m in metrics]
+        assert full.count("c_dup") == 2
+        assert not mod.SNAKE.match("CamelCase")
