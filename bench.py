@@ -226,9 +226,24 @@ def bench_blocksync_e2e() -> dict:
     the REAL blocksync/reactor.py -> DeferredSigBatch device verify ->
     blockstore over the simnet in-memory transport, not a dispatch
     loop over pre-packed arrays.  Sizes via SIMNET_BENCH_BLOCKS /
-    SIMNET_BENCH_VALS (defaults 96 x 64)."""
+    SIMNET_BENCH_VALS (defaults 96 x 64).  Pinned to pipeline_depth=1
+    (the strictly serial ingest loop) so it stays the A/B base arm for
+    the pipelined extra below."""
     from cometbft_tpu.simnet import bench as simbench
-    return simbench.bench_blocksync_e2e()
+    return simbench.bench_blocksync_e2e(pipeline_depth=1)
+
+
+def bench_blocksync_pipelined() -> dict:
+    """The overlapped arm of the same e2e on the same seed: the
+    reactor's depth-K verify pipeline (crypto/dispatch.py) collects
+    and host-packs window N+1 while window N's dispatch is on device.
+    Depth via SIMNET_BENCH_PIPELINE_DEPTH (default 3: collect + device
+    + apply all concurrently distinct windows); the result carries
+    overlap_efficiency (sum-of-stages / wall-clock) and the measured
+    device-span-overlaps-collect seconds."""
+    from cometbft_tpu.simnet import bench as simbench
+    depth = int(os.environ.get("SIMNET_BENCH_PIPELINE_DEPTH", "3"))
+    return simbench.bench_blocksync_e2e(pipeline_depth=max(2, depth))
 
 
 def bench_light_e2e() -> dict:
@@ -678,6 +693,9 @@ def main() -> None:
         ("secp256k1_sigs_per_sec", "secp256k1_config"),
         ("blocksync_blocks_per_sec", "blocksync_config"),
         ("blocksync_e2e_blocks_per_sec", "blocksync_e2e_config"),
+        ("blocksync_pipelined_blocks_per_sec",
+         "blocksync_pipelined_config"),
+        ("pipeline_overlap_efficiency", None),
         ("light_e2e_headers_per_sec", "light_e2e_config"),
     )
     # per-key provenance so CHAINED carries don't launder staleness
@@ -901,6 +919,27 @@ def main() -> None:
             last_light = None
     _attach_e2e_detail("blocksync_e2e_blocks_per_sec",
                        "blocksync_e2e_detail", _simbench.last_blocksync)
+    # the overlapped arm, same seed/shape as the serial base arm above
+    # (A/B steering: serial vs pipelined is apples-to-apples)
+    run_extra("blocksync_pipelined_blocks_per_sec",
+              lambda: bench_blocksync_pipelined()["blocks_per_sec"],
+              "blocksync_pipelined_config",
+              "simnet e2e, overlapped verify pipeline: collect+pack"
+              " window N+1 while window N is on device (depth via"
+              " SIMNET_BENCH_PIPELINE_DEPTH, default 3); same"
+              " blocks/validators/seed as the serial base arm")
+    _attach_e2e_detail("blocksync_pipelined_blocks_per_sec",
+                       "blocksync_pipelined_detail",
+                       _simbench.last_blocksync)
+    if ("blocksync_pipelined_blocks_per_sec" not in carried_keys
+            and isinstance(extra.get("blocksync_pipelined_blocks_per_sec"),
+                           (int, float))
+            and isinstance(_simbench.last_blocksync, dict)):
+        extra["pipeline_overlap_efficiency"] = \
+            _simbench.last_blocksync.get("overlap_efficiency")
+        carried_keys.discard("pipeline_overlap_efficiency")
+        _sync_carried()
+        persist()
     run_extra("light_e2e_headers_per_sec",
               lambda: bench_light_e2e()["headers_per_sec"],
               "light_e2e_config",

@@ -235,15 +235,18 @@ class BlockPool(BaseService):
             second = r2.block if r2 else None
             return first, ext, second
 
-    def peek_window(self, max_blocks: int):
-        """Consecutive downloaded blocks from self.height: a list of
-        (block, ext_commit) of length <= max_blocks, plus the block at
-        the following height if present (its LastCommit verifies the
-        last window entry).  The windowed verify path batches all the
-        commits into one device dispatch (types.DeferredSigBatch)."""
+    def peek_window(self, max_blocks: int, offset: int = 0):
+        """Consecutive downloaded blocks from self.height + offset: a
+        list of (block, ext_commit) of length <= max_blocks, plus the
+        block at the following height if present (its LastCommit
+        verifies the last window entry).  The windowed verify path
+        batches all the commits into one device dispatch
+        (types.DeferredSigBatch); the overlapped pipeline peeks AHEAD
+        of in-flight windows via `offset` so window N+1 collects while
+        window N is on device."""
         with self._mtx:
             window = []
-            h = self.height
+            h = self.height + offset
             while len(window) < max_blocks:
                 r = self._requesters.get(h)
                 if r is None or r.block is None:

@@ -9,6 +9,7 @@ On catch-up it hands off to the consensus reactor (SwitchToConsensus).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -30,6 +31,11 @@ TRY_SYNC_INTERVAL = 0.01
 VERIFY_WINDOW = 48
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+# overlapped verify pipeline depth (crypto/dispatch.py): collect+pack
+# window N+1 while window N is on device and window N-1 applies/stores.
+# 1 = the serial path; 2 = double buffering (the default)
+PIPELINE_DEPTH = int(os.environ.get(
+    "COMETBFT_TPU_BLOCKSYNC_PIPELINE", "2"))
 
 
 class BlocksyncReactor(Reactor):
@@ -48,6 +54,8 @@ class BlocksyncReactor(Reactor):
         self._stop_sync = threading.Event()
         self.synced = not block_sync
         self.metrics = None        # BlockSyncMetrics when the node meters
+        self.pipeline_depth = PIPELINE_DEPTH
+        self._pipeline = None      # crypto/dispatch.VerifyPipeline
 
     def get_channels(self) -> list:
         return [ChannelDescriptor(
@@ -66,6 +74,17 @@ class BlocksyncReactor(Reactor):
     def on_stop(self) -> None:
         self._stop_sync.set()
         self.pool.stop()
+        if self._pipeline is not None:
+            self._pipeline.stop()
+            self._pipeline = None
+
+    def _get_pipeline(self):
+        if self._pipeline is None or not self._pipeline.is_running():
+            from ..crypto.dispatch import VerifyPipeline
+            self._pipeline = VerifyPipeline(
+                depth=self.pipeline_depth, name="blocksync-pipeline")
+            self._pipeline.start()
+        return self._pipeline
 
     def switch_to_blocksync(self, state) -> None:
         """Begin block-syncing from a statesync-bootstrapped state
@@ -173,7 +192,17 @@ class BlocksyncReactor(Reactor):
         one.  Batching beyond the next height is gated on the headers
         carrying the CURRENT next_validators hash; a lying header
         cannot commit anything — apply-time validate_block re-checks
-        the executed validator set before each block lands."""
+        the executed validator set before each block lands.
+
+        With pipeline_depth >= 2 the overlapped path runs instead:
+        window N+1 collects and host-packs while window N's dispatch
+        is in flight on device and window N-1 applies/stores
+        (_sync_pipelined); depth 1 keeps the strictly serial loop."""
+        if self.pipeline_depth >= 2:
+            return self._sync_pipelined()
+        return self._sync_serial()
+
+    def _sync_serial(self) -> bool:
         from ..types.validation import DeferredSigBatch
 
         window, after = self.pool.peek_window(VERIFY_WINDOW)
@@ -244,7 +273,20 @@ class BlocksyncReactor(Reactor):
                 self._on_peer_error(pid, "served invalid block")
             return False
 
+        progressed, _, _ = self._apply_window(blocks, window, parts_ids,
+                                              commits, verified)
+        return progressed
+
+    def _apply_window(self, blocks, window, parts_ids, commits,
+                      verified) -> tuple[bool, int, bool]:
+        """Apply + store `verified` signature-verified blocks one by
+        one (the serial tail of reactor.go:534 processBlock).  Returns
+        (progressed, popped, clean): popped counts blocks actually
+        landed; clean is False when a refetch/eviction interrupted the
+        window — the pipelined path then drops its lookahead (those
+        heights re-peek after the pool recovers)."""
         progressed = False
+        popped = 0
         for i in range(verified):
             first = blocks[i]
             first_ext = window[i][1]
@@ -256,7 +298,7 @@ class BlocksyncReactor(Reactor):
                 # params — refetch, don't evict (reactor.go:540)
                 for pid in self.pool.redo_request(first.header.height):
                     self._on_peer_error(pid, "missing extended commit")
-                return progressed
+                return progressed, popped, False
             parts, first_id = parts_ids[i]
             try:
                 with trace_span("blocksync", "apply"):
@@ -268,8 +310,9 @@ class BlocksyncReactor(Reactor):
                 # block's LastCommit drove the batched verify
                 for pid in self.pool.redo_request(first.header.height):
                     self._on_peer_error(pid, "served invalid block")
-                return progressed
+                return progressed, popped, False
             self.pool.pop_request()
+            popped += 1
             with trace_span("blocksync", "store"):
                 if ext_enabled:
                     self.store.save_block(first, parts,
@@ -284,7 +327,147 @@ class BlocksyncReactor(Reactor):
             if self.metrics is not None:
                 self.metrics.record_block(first, size_bytes=parts.byte_size)
             progressed = True
-        return progressed
+        return progressed, popped, True
+
+    # -- overlapped pipeline ----------------------------------------------
+
+    def _collect_ahead(self, offset: int):
+        """Collect ONE verify window starting `offset` blocks past
+        pool.height (the lookahead over in-flight windows): the same
+        structure checks, power tallies, sign-bytes templating, and
+        partset chunking as the serial path, with signature checks
+        deferred into a DeferredSigBatch for the pipeline.
+
+        Lookahead windows (offset > 0) are collected BEFORE earlier
+        windows apply, so every one of their blocks must pin the
+        CURRENT next_validators hash — the same trust discipline the
+        serial path uses past height+1; apply-time validate_block
+        re-checks the executed validator set before anything lands.
+        Returns None when nothing (more) is collectable; peer blame
+        for structural failures only fires at offset 0, where the
+        state is current (a lookahead failure re-collects as the head
+        window next pass and blames then)."""
+        from ..types.validation import DeferredSigBatch
+
+        window, after = self.pool.peek_window(VERIFY_WINDOW, offset)
+        usable = len(window) if after is not None else len(window) - 1
+        if usable < 1:
+            return None
+        for i in range(usable):
+            block, ext = window[i]
+            if ext is None and self.state.consensus_params \
+                    .vote_extensions_enabled(block.header.height):
+                if i == 0:
+                    if offset == 0:
+                        for pid in self.pool.redo_request(
+                                block.header.height):
+                            self._on_peer_error(
+                                pid, "missing extended commit")
+                    return None
+                usable = i
+                break
+        while usable & (usable - 1):
+            usable &= usable - 1
+        blocks = [b for b, _ in window]
+        commits = []
+        for i in range(usable):
+            nxt = blocks[i + 1] if i + 1 < len(window) else after
+            commits.append(nxt.last_commit)
+
+        next_hash = self.state.next_validators.hash() \
+            if self.state.next_validators else None
+        batch = DeferredSigBatch()
+        verified = 0
+        parts_ids = []
+        collecting_h = None
+        try:
+            with trace_span("blocksync", "verify_dispatch",
+                            offset=offset), \
+                    trace_span("blocksync", "collect", offset=offset):
+                for i in range(usable):
+                    block = blocks[i]
+                    collecting_h = block.header.height
+                    if offset == 0 and i == 0:
+                        vals = self.state.validators
+                    elif block.header.validators_hash == next_hash:
+                        vals = self.state.next_validators
+                    else:
+                        break
+                    parts = PartSet.from_data(block.to_proto())
+                    bid = BlockID(block.hash(), parts.header)
+                    parts_ids.append((parts, bid))
+                    vals.verify_commit_light(
+                        self.state.chain_id, bid, block.header.height,
+                        commits[i], defer_to=batch)
+                    verified += 1
+        except Exception as e:
+            if offset == 0:
+                bad_h = getattr(e, "failed_ctx", None) \
+                    or collecting_h or blocks[0].header.height
+                for pid in self.pool.redo_request(bad_h):
+                    self._on_peer_error(pid, "served invalid block")
+            return None
+        if verified < 1:
+            return None
+        return {"blocks": blocks, "window": window,
+                "parts_ids": parts_ids, "commits": commits,
+                "verified": verified, "batch": batch}
+
+    def _sync_pipelined(self) -> bool:
+        """The overlapped ingest loop: up to pipeline_depth windows in
+        flight at once — window N+1 collects/packs (host threads)
+        while window N's RLC dispatch runs on device and window N-1
+        applies/stores.  Verdicts resolve strictly in submission
+        order, and NO block applies before its window's verdict future
+        resolved true; a reject or device fault abandons the lookahead
+        (blocks stay in the pool — no loss) and the next pass retries
+        through the normal blame path."""
+        pipe = self._get_pipeline()
+        inflight: list[dict] = []
+        offset = 0
+        progressed = False
+        # yield back to the pool routine periodically so its status
+        # broadcasts and switch-to-consensus checks keep their cadence;
+        # past the deadline the fill stops and in-flight drains
+        deadline = time.monotonic() + SWITCH_TO_CONSENSUS_INTERVAL
+        while True:
+            while len(inflight) < self.pipeline_depth \
+                    and not self._stop_sync.is_set() \
+                    and time.monotonic() < deadline:
+                rec = self._collect_ahead(offset)
+                if rec is None:
+                    break
+                rec["verdict"] = rec.pop("batch").verify_async(
+                    pipe, subsystem="blocksync")
+                inflight.append(rec)
+                offset += rec["verified"]
+            if not inflight:
+                return progressed
+            rec = inflight.pop(0)
+            try:
+                # HOT PATH: the window's single device dispatch —
+                # later windows are collecting/packing RIGHT NOW
+                with trace_span("blocksync", "device_wait",
+                                inflight=len(inflight) + 1):
+                    rec["verdict"].wait()
+            except Exception as e:
+                # abandoned lookahead windows resolve in the
+                # background; their blocks were never popped from the
+                # pool, so nothing is lost — the next pass re-peeks
+                bad_h = getattr(e, "failed_ctx", None) \
+                    or rec["blocks"][0].header.height
+                for pid in self.pool.redo_request(bad_h):
+                    self._on_peer_error(pid, "served invalid block")
+                return progressed
+            applied, popped, clean = self._apply_window(
+                rec["blocks"], rec["window"], rec["parts_ids"],
+                rec["commits"], rec["verified"])
+            progressed = progressed or applied
+            offset -= rec["verified"]
+            if not clean or popped != rec["verified"]:
+                return progressed
+            if self._stop_sync.is_set() or not self.is_running():
+                return progressed
 
     def _maybe_switch_to_consensus(self) -> bool:
         """reactor.go:520: hand off when caught up."""

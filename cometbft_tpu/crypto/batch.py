@@ -82,18 +82,28 @@ class TpuEd25519BatchVerifier(_SigCollector):
         return _device_verify(pks, parsed)
 
 
-def _device_verify(pubkeys: list[bytes], parsed) -> tuple[bool, list[bool]]:
+# sentinel: "no precomputed RLC packing" (None is a real pack_rlc
+# result meaning structural reject, so it cannot double as the default)
+_NO_PACK = object()
+
+
+def _device_verify(pubkeys: list[bytes], parsed,
+                   packed=_NO_PACK) -> tuple[bool, list[bool]]:
     """Shared device dispatch for any Edwards-domain batch: RLC fast
     path first, per-signature kernel for verdict localization on
     failure — the reference's verifyCommitBatch -> verifyCommitSingle
-    pattern (/root/reference/types/validation.go:115)."""
+    pattern (/root/reference/types/validation.go:115).  `packed`
+    accepts a pack_rlc result computed ahead of time (the overlapped
+    pipeline packs window N+1 while window N is on device)."""
     import numpy as np
 
     from ..ops import ed25519 as dev
 
     n = len(pubkeys)
     if n >= 2:
-        packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n, parsed=parsed)
+        if packed is _NO_PACK:
+            packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n,
+                                 parsed=parsed)
         if packed is not None and ed.rlc_verify(packed):
             return True, [True] * n
         from ..libs import flightrec
@@ -285,18 +295,34 @@ class MixedBatchVerifier:
     def count(self) -> int:
         return len(self._order)
 
+    def _verify_subtype(self, kt: str, items) -> list[bool]:
+        sub = create_batch_verifier(kt, n_hint=len(items),
+                                    provider=self._provider)
+        for pk, msg, sig in items:
+            sub.add(pk, msg, sig)
+        return sub.verify()[1]
+
     def verify(self) -> tuple[bool, list[bool]]:
         # per-type verifiers are created HERE so n_hint can route
         # sub-threshold sub-batches (e.g. a lone secp256k1 validator in
         # an ed25519 set) to the cheap host loop instead of a device
-        # dispatch + cold kernel compile
+        # dispatch + cold kernel compile.  Sub-batches of DIFFERENT key
+        # types are independent programs, so they dispatch
+        # concurrently: the device pipelines them and the host loops
+        # release the GIL in OpenSSL/numpy.
         results = {}
-        for kt, items in self._items.items():
-            sub = create_batch_verifier(kt, n_hint=len(items),
-                                        provider=self._provider)
-            for pk, msg, sig in items:
-                sub.add(pk, msg, sig)
-            results[kt] = sub.verify()[1]
+        if len(self._items) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=len(self._items),
+                    thread_name_prefix="mixed-batch") as ex:
+                futs = {kt: ex.submit(self._verify_subtype, kt, items)
+                        for kt, items in self._items.items()}
+                results = {kt: f.result() for kt, f in futs.items()}
+        else:
+            for kt, items in self._items.items():
+                results[kt] = self._verify_subtype(kt, items)
         singles = iter(self._singles)
         out = []
         for slot in self._order:

@@ -1,0 +1,516 @@
+"""Overlapped host/device verify pipeline: the depth-K dispatch engine
+between the product paths and the accelerator.
+
+The blocksync residual profile (docs/PERF.md "Blocksync residual
+bottleneck") shows the product path host-bound: ~240 ms/block of
+strictly SERIAL collect -> host_pack -> device -> apply -> store
+against ~6 ms of amortized device time.  The committee-verification
+literature (arxiv 2112.02229 FPGA ECDSA, arxiv 2302.00418 EdDSA
+committee consensus) gets its system-level wins from keeping the
+verification stages CONCURRENT, not from faster primitives — this
+module is that reformulation for the TPU seam:
+
+- submit(items) returns immediately with a WindowHandle future;
+- a STAGING thread runs the host work (SHA-512 sign-bytes hashing via
+  parse_and_hash, signed-digit recode via pack_rlc) for window N+1
+  while window N's RLC dispatch is in flight — hashlib and numpy
+  release the GIL, so a small worker pool genuinely parallelizes the
+  per-window parse+hash across cores (parse_and_hash_parallel);
+- a DEVICE thread dispatches packed windows strictly in submission
+  order, so verdicts resolve in the order callers submitted — the
+  ordering contract blocksync's apply loop and the light client's
+  store loop rely on;
+- depth-K backpressure: submit() blocks once K windows are unresolved,
+  bounding staging memory to K double-buffered windows.
+
+Failure semantics match the serial path exactly: an RLC reject falls
+back to the per-signature verdict kernel (crypto/batch._device_verify
+does both), and a DEVICE ERROR on an in-flight window drains the
+pipeline — the faulted window and everything staged behind it resolve
+through the host path, per-signature, so no caller ever commits on a
+verdict that did not actually verify.  The drain is observable:
+flightrec EV_PIPELINE_DRAIN / EV_DEVICE_FALLBACK events and the
+DeviceMetrics pipeline gauges (in-flight windows, staging depth)
+record the timeline.
+
+The seam discipline matches votestream/trace/flightrec: with no
+pipeline constructed nothing runs; trace spans land under the
+SUBMITTER'S subsystem (blocksync/light/consensus) so the overlap is
+visible per product path, not aggregated away.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..libs.service import BaseService
+
+# depth 2 = classic double buffering (pack N+1 while N is on device);
+# deeper helps only when device time >> host time per window
+DEFAULT_DEPTH = int(os.environ.get("COMETBFT_TPU_PIPELINE_DEPTH", "2"))
+# the host pool parallelizes WITHIN a window (parse_and_hash chunks);
+# hashlib releases the GIL so this scales to real cores
+DEFAULT_HOST_WORKERS = int(os.environ.get(
+    "COMETBFT_TPU_PIPELINE_WORKERS",
+    str(min(4, os.cpu_count() or 1))))
+_MIN_PARALLEL_CHUNK = 256
+
+
+def parse_and_hash_parallel(pubkeys, msgs, sigs, pool=None,
+                            workers: int | None = None):
+    """ed25519.parse_and_hash fanned across a thread pool in chunks.
+
+    Byte-identical to the serial function (pinned by
+    tests/test_dispatch.py): chunking only partitions the index space.
+    Small batches (or pool=None) stay serial — the fan-out overhead
+    beats the hashing below ~256 signatures.
+    """
+    from . import ed25519 as ed
+
+    n = len(pubkeys)
+    nworkers = workers if workers is not None else DEFAULT_HOST_WORKERS
+    if pool is None or nworkers <= 1 or n < 2 * _MIN_PARALLEL_CHUNK:
+        return ed.parse_and_hash(pubkeys, msgs, sigs)
+    chunk = max(_MIN_PARALLEL_CHUNK, -(-n // nworkers))
+    spans = [(i, min(i + chunk, n)) for i in range(0, n, chunk)]
+    futs = [pool.submit(ed.parse_and_hash, pubkeys[a:b], msgs[a:b],
+                        sigs[a:b]) for a, b in spans]
+    out = []
+    for f in futs:
+        out.extend(f.result())
+    return out
+
+
+def _pk_bytes(pk) -> bytes:
+    return pk.bytes() if hasattr(pk, "bytes") else bytes(pk)
+
+
+def _key_type(pk) -> str:
+    return pk.type() if hasattr(pk, "type") else "ed25519"
+
+
+def _verify_one(pk, msg: bytes, sig: bytes) -> bool:
+    """Host single-verify for any item shape the pipeline accepts
+    (raw 32-byte ed25519 pubkeys or key objects); backend errors map
+    to invalid, agreeing with crypto/batch.safe_verify."""
+    from . import batch as cb
+
+    if hasattr(pk, "verify_signature"):
+        return cb.safe_verify(pk, msg, sig)
+    from .votestream import _host_verify
+
+    return _host_verify(_pk_bytes(pk), msg, sig)
+
+
+class WindowHandle:
+    """Future for one submitted window; resolves to (ok, verdicts)
+    in submission order.  `path` records how the verdicts were
+    produced once resolved: device / host / drain."""
+
+    __slots__ = ("_future", "ctx", "subsystem", "path", "n",
+                 "submitted_at", "resolved_at")
+
+    def __init__(self, n: int, subsystem: str, ctx):
+        self._future: Future = Future()
+        self.ctx = ctx
+        self.subsystem = subsystem
+        self.path: str | None = None
+        self.n = n
+        self.submitted_at = time.monotonic()
+        self.resolved_at: float | None = None
+
+    def result(self, timeout: float | None = None):
+        return self._future.result(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def add_done_callback(self, fn) -> None:
+        self._future.add_done_callback(lambda _f: fn(self))
+
+    # internal
+    def _resolve(self, ok: bool, verdicts: list, path: str) -> None:
+        self.path = path
+        self.resolved_at = time.monotonic()
+        if self._future.set_running_or_notify_cancel():
+            self._future.set_result((ok, list(verdicts)))
+
+    def _fail(self, exc: BaseException) -> None:
+        self.resolved_at = time.monotonic()
+        if self._future.set_running_or_notify_cancel():
+            self._future.set_exception(exc)
+
+
+class _Window:
+    __slots__ = ("items", "handle", "threshold", "mode", "pks",
+                 "parsed", "packed", "verifier", "staged", "device_s")
+
+    def __init__(self, items, handle, threshold):
+        self.items = items
+        self.handle = handle
+        self.threshold = threshold
+        self.mode = None          # "ed" | "mixed" | "host"
+        self.pks = None
+        self.parsed = None
+        self.packed = None
+        self.verifier = None
+        self.staged = False
+        self.device_s = 0.0
+
+
+class VerifyPipeline(BaseService):
+    """Depth-K overlapped verify dispatch engine (module docstring)."""
+
+    def __init__(self, depth: int = DEFAULT_DEPTH,
+                 host_workers: int | None = None,
+                 dispatch_fn=None, name: str = "VerifyPipeline"):
+        super().__init__(name)
+        self.depth = max(1, depth)
+        self.host_workers = (host_workers if host_workers is not None
+                             else DEFAULT_HOST_WORKERS)
+        # test/profiling seam: replaces the device-verify call; takes
+        # the _Window, returns (ok, verdicts) or raises (exercising the
+        # drain path exactly as a real device failure would)
+        self._dispatch_fn = dispatch_fn
+        self._cv = threading.Condition()
+        self._windows: list[_Window] = []
+        self._slots = threading.BoundedSemaphore(self.depth)
+        self._pool: ThreadPoolExecutor | None = None
+        self._staging: threading.Thread | None = None
+        self._device: threading.Thread | None = None
+        self._stopping = False
+        self._faulted = False      # draining after a device error
+        # stats (tests + bench introspection)
+        self.submitted = 0
+        self.resolved = 0
+        self.device_windows = 0
+        self.host_windows = 0
+        self.drained_windows = 0
+        self.faults = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._stopping = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.host_workers),
+            thread_name_prefix=f"{self._name}-host")
+        self._staging = threading.Thread(
+            target=self._staging_loop, name=f"{self._name}-staging",
+            daemon=True)
+        self._device = threading.Thread(
+            target=self._device_loop, name=f"{self._name}-device",
+            daemon=True)
+        self._staging.start()
+        self._device.start()
+
+    def on_stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        for th in (self._staging, self._device):
+            if th is not None:
+                th.join(timeout=5)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        # a submit that raced stop() may have left windows behind the
+        # exited threads: answer them on the host, free their slots
+        with self._cv:
+            leftovers, self._windows = list(self._windows), []
+        for w in leftovers:
+            ok, verdicts = self._host_fallback(w)
+            w.handle._resolve(ok, verdicts, "host")
+            try:
+                self._slots.release()
+            except ValueError:  # pragma: no cover
+                pass
+
+    def __enter__(self) -> "VerifyPipeline":
+        if not self.is_running():
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def inflight(self) -> int:
+        """Windows submitted and not yet resolved."""
+        with self._cv:
+            return len(self._windows)
+
+    @property
+    def staged(self) -> int:
+        """Windows packed and waiting on the device thread."""
+        with self._cv:
+            return sum(1 for w in self._windows if w.staged)
+
+    def _gauge(self) -> None:
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            with self._cv:
+                n = len(self._windows)
+                s = sum(1 for w in self._windows if w.staged)
+            dm.pipeline_inflight.set(n)
+            dm.pipeline_staged.set(s)
+
+    # -- API ---------------------------------------------------------------
+
+    def submit(self, items, *, subsystem: str = "pipeline", ctx=None,
+               device_threshold: int | None = None) -> WindowHandle:
+        """Queue one window of (pubkey, msg, sig) items; blocks when
+        `depth` windows are already unresolved (backpressure).  The
+        returned handle resolves — in submission order — to
+        (ok, verdicts) with one bool per item."""
+        if device_threshold is None:
+            from . import batch as cb
+
+            device_threshold = cb.DEVICE_THRESHOLD
+        items = list(items)
+        handle = WindowHandle(len(items), subsystem, ctx)
+        if not items:
+            handle._resolve(False, [], "host")
+            return handle
+        if self._stopping or self._staging is None \
+                or not self.is_running():
+            # late submissions still answer, synchronously on the host
+            # (the votestream submit-after-stop contract)
+            verdicts = [_verify_one(pk, m, s) for pk, m, s in items]
+            handle._resolve(all(verdicts), verdicts, "host")
+            return handle
+        self._slots.acquire()
+        win = _Window(items, handle, device_threshold)
+        with self._cv:
+            self._windows.append(win)
+            self.submitted += 1
+            self._cv.notify_all()
+        self._gauge()
+        return handle
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted window has resolved."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cv:
+            while self._windows:
+                left = None if deadline is None else \
+                    deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(timeout=left if left is not None else 0.1)
+        return True
+
+    # -- staging (host pack) -----------------------------------------------
+
+    def _next_unstaged(self) -> _Window | None:
+        for w in self._windows:
+            if not w.staged:
+                return w
+        return None
+
+    def _staging_loop(self) -> None:
+        from ..libs import trace as libtrace
+
+        while True:
+            with self._cv:
+                while self._next_unstaged() is None \
+                        and not self._stopping:
+                    self._cv.wait(timeout=0.1)
+                if self._stopping and self._next_unstaged() is None:
+                    return
+                win = self._next_unstaged()
+            try:
+                with libtrace.span(win.handle.subsystem, "host_pack",
+                                   inflight=len(self._windows)):
+                    self._stage(win)
+            except Exception:
+                # a staging failure must not wedge the queue: route the
+                # window to the host path for verdicts
+                win.mode = "host"
+            with self._cv:
+                win.staged = True
+                self._cv.notify_all()
+            self._gauge()
+
+    def _stage(self, win: _Window) -> None:
+        """Host work for one window: key-type split, parallel SHA-512
+        parse+hash, RLC packing (signed-digit recode) — everything the
+        device dispatch needs, done while the PREVIOUS window is on
+        device."""
+        items = win.items
+        provider = os.environ.get("COMETBFT_TPU_PROVIDER", "auto")
+        all_ed = all(_key_type(pk) == "ed25519" for pk, _, _ in items)
+        if provider == "cpu" or len(items) < max(1, win.threshold):
+            win.mode = "host"
+            return
+        if not all_ed:
+            # mixed key types: batch.MixedBatchVerifier handles the
+            # per-type split (its sub-batches dispatch concurrently);
+            # the device thread runs verify() so ordering holds
+            from . import batch as cb
+
+            bv = cb.MixedBatchVerifier()
+            for pk, m, s in items:
+                bv.add(pk, m, s)
+            win.mode = "mixed"
+            win.verifier = bv
+            return
+        from . import ed25519 as ed
+
+        pks = [_pk_bytes(pk) for pk, _, _ in items]
+        msgs = [m for _, m, _ in items]
+        sigs = [s for _, _, s in items]
+        win.pks = pks
+        win.parsed = parse_and_hash_parallel(
+            pks, msgs, sigs, pool=self._pool,
+            workers=self.host_workers)
+        n = len(pks)
+        if n >= 2:
+            # pack (aggregation + recode) here so the device thread
+            # only dispatches; None = structural reject, the device
+            # stage localizes with the per-signature kernel
+            win.packed = ed.pack_rlc(pks, [b""] * n, [b""] * n,
+                                     parsed=win.parsed)
+        win.mode = "ed"
+
+    # -- device (ordered dispatch) -------------------------------------
+
+    def _device_loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._windows and self._windows[0].staged:
+                        win = self._windows[0]
+                        break
+                    if self._stopping and not self._windows:
+                        return
+                    # stopping with an unstaged head: the staging loop
+                    # drains every submitted window before exiting
+                    self._cv.wait(timeout=0.05)
+            self._resolve_window(win)
+            with self._cv:
+                if self._windows and self._windows[0] is win:
+                    self._windows.pop(0)
+                if not self._windows:
+                    # queue empty: a drain ends here, device dispatch
+                    # resumes for subsequent submissions
+                    self._faulted = False
+                self.resolved += 1
+                self._cv.notify_all()
+            self._slots.release()
+            self._gauge()
+
+    def _resolve_window(self, win: _Window) -> None:
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+        from ..libs import trace as libtrace
+
+        dm = libmetrics.device_metrics()
+        t0 = time.monotonic()
+        path = "host"
+        ok, verdicts = False, None
+        try:
+            with libtrace.span(win.handle.subsystem, "device",
+                               inflight=len(self._windows)):
+                if self._faulted and win.mode in ("ed", "mixed"):
+                    # draining after a device fault: everything staged
+                    # behind the faulted window resolves on the host
+                    ok, verdicts = self._host_fallback(win)
+                    path = "drain"
+                    self.drained_windows += 1
+                elif win.mode == "host":
+                    ok, verdicts = self._host_fallback(win)
+                    self.host_windows += 1
+                else:
+                    try:
+                        ok, verdicts = self._device_dispatch(win)
+                        path = "device"
+                        self.device_windows += 1
+                    except Exception as e:
+                        # device trouble mid-pipeline: drain.  The host
+                        # path is still correct; the operator must see
+                        # the fault and the drain in the timeline.
+                        self._fault(e, win)
+                        ok, verdicts = self._host_fallback(win)
+                        path = "drain"
+                        self.drained_windows += 1
+            win.device_s = time.monotonic() - t0
+            win.handle._resolve(ok, verdicts, path)
+        except BaseException as e:  # pragma: no cover - defensive
+            win.handle._fail(e)
+            path = "error"
+        finally:
+            if dm is not None:
+                dm.flushes.labels(path).inc()
+                dm.batch_size.labels(path).observe(len(win.items))
+                dm.flush_latency_seconds.observe(
+                    time.monotonic() - t0)
+            flightrec.record(
+                flightrec.EV_VERIFY_FLUSH, path=path,
+                batch=len(win.items),
+                subsystem=win.handle.subsystem,
+                inflight=len(self._windows), staged=self.staged)
+
+    def _device_dispatch(self, win: _Window):
+        if self._dispatch_fn is not None:
+            return self._dispatch_fn(win)
+        if win.mode == "mixed":
+            return win.verifier.verify()
+        from . import batch as cb
+
+        return cb._device_verify(win.pks, win.parsed,
+                                 packed=win.packed)
+
+    def _host_fallback(self, win: _Window):
+        verdicts = [_verify_one(pk, m, s) for pk, m, s in win.items]
+        return all(verdicts) and bool(verdicts), verdicts
+
+    def _fault(self, exc: Exception, win: _Window) -> None:
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+
+        with self._cv:
+            self._faulted = True
+            self.faults += 1
+            staged_behind = sum(1 for w in self._windows if w.staged)
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.pipeline_drains.inc()
+        rec = flightrec.recorder()
+        flightrec.record(flightrec.EV_DEVICE_FALLBACK,
+                         batch=len(win.items),
+                         error=type(exc).__name__)
+        flightrec.record(flightrec.EV_PIPELINE_DRAIN,
+                         batch=len(win.items),
+                         inflight=len(self._windows),
+                         staged=staged_behind,
+                         error=type(exc).__name__)
+        if rec is not None:
+            rec.dump_to_log(
+                "pipeline device dispatch failed, draining: %r" % exc)
+
+
+# -- process-wide default instance ------------------------------------------
+
+_default: VerifyPipeline | None = None
+_default_lock = threading.Lock()
+
+
+def default_pipeline() -> VerifyPipeline:
+    """Lazily-started shared engine: all product paths in a process
+    share one ordered dispatch queue (the axon discipline is one TPU
+    stream per process anyway)."""
+    global _default
+    with _default_lock:
+        if _default is None or not _default.is_running():
+            _default = VerifyPipeline()
+            _default.start()
+        return _default

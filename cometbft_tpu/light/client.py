@@ -78,6 +78,11 @@ class Client:
                  # dispatch cost rewards depth, and the r4b kernels
                  # keep a 384-commit dispatch well under 100 ms
                  sequential_batch_size: int = 384,
+                 # overlapped verify pipeline depth for sequential
+                 # sync (crypto/dispatch.py): fetch + collect window
+                 # w+1 while window w's dispatch is on device; 1 =
+                 # the strictly serial loop
+                 pipeline_depth: int = 2,
                  now_fn=Timestamp.now):
         verifier.validate_trust_level(trust_level)
         trust_options.validate_basic()
@@ -91,6 +96,7 @@ class Client:
         self.store: Store = trusted_store or MemoryStore()
         self.pruning_size = pruning_size
         self.sequential_batch_size = max(1, sequential_batch_size)
+        self.pipeline_depth = max(1, pipeline_depth)
         self._now = now_fn
         self._initialize(trust_options)
 
@@ -194,7 +200,15 @@ class Client:
         into a DeferredSigBatch verified once per window — one RLC
         dispatch covers sequential_batch_size commits over the (mostly
         repeated) validator set.  A bad signature fails the whole
-        window before anything is returned or stored."""
+        window before anything is returned or stored.
+
+        With pipeline_depth >= 2 the overlapped path runs instead:
+        window w+1 fetches AND collects while window w's dispatch is
+        in flight (crypto/dispatch.py) — headers join the trace only
+        after their window's verdict future resolved true."""
+        if self.pipeline_depth >= 2:
+            return self._verify_sequential_pipelined(trusted, target,
+                                                     now)
         import concurrent.futures as cf
 
         from ..types import validation
@@ -237,6 +251,73 @@ class Client:
                 h = wend + 1
                 wend = min(h + self.sequential_batch_size - 1,
                            target.height)
+        return trace
+
+    def _verify_sequential_pipelined(self, trusted: LightBlock,
+                                     target: LightBlock,
+                                     now: Timestamp) -> list[LightBlock]:
+        """The overlapped sequential sync: header-range prefetch AND
+        the next window's host-side checks run while the previous
+        window's signatures are on device (VerifyPipeline, depth =
+        pipeline_depth).  Verdicts resolve in submission order and a
+        window's headers extend the trace only after its verdict
+        future resolved true; any failure raises before the target —
+        or anything past the failed window — is stored."""
+        import concurrent.futures as cf
+        from collections import deque
+
+        from ..crypto.dispatch import VerifyPipeline
+        from ..types import validation
+
+        def fetch_window(start: int, end: int) -> list[LightBlock]:
+            with trace_span("light", "fetch"):
+                return [target if hh == target.height else
+                        self._from_primary(hh)
+                        for hh in range(start, end + 1)]
+
+        trace = [trusted]
+        verified = trusted
+        h = trusted.height + 1
+        bs = self.sequential_batch_size
+        inflight: deque = deque()
+        with cf.ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix="light-prefetch") as ex, \
+                VerifyPipeline(depth=self.pipeline_depth,
+                               name="light-pipeline") as pipe:
+            wend = min(h + bs - 1, target.height)
+            pending = ex.submit(fetch_window, h, wend) \
+                if h <= target.height else None
+            while h <= target.height or inflight:
+                if h <= target.height \
+                        and len(inflight) < self.pipeline_depth:
+                    window = pending.result()
+                    nxt = wend + 1
+                    if nxt <= target.height:
+                        nxt_end = min(nxt + bs - 1, target.height)
+                        pending = ex.submit(fetch_window, nxt, nxt_end)
+                    batch = validation.DeferredSigBatch()
+                    with trace_span("light", "verify_dispatch",
+                                    inflight=len(inflight)), \
+                            trace_span("light", "collect"):
+                        for interim in window:
+                            verifier.verify_adjacent(
+                                verified.signed_header,
+                                interim.signed_header,
+                                interim.validator_set,
+                                self.trusting_period_ns,
+                                now, self.max_clock_drift_ns,
+                                defer_to=batch)
+                            verified = interim
+                    inflight.append(
+                        (window,
+                         batch.verify_async(pipe, subsystem="light")))
+                    h = wend + 1
+                    wend = min(h + bs - 1, target.height)
+                else:
+                    window, verdict = inflight.popleft()
+                    verdict.wait()
+                    trace.extend(window)
         return trace
 
     def _verify_skipping(self, source: Provider, trusted: LightBlock,

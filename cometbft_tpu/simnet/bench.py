@@ -44,15 +44,23 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
                         n_vals: int | None = None,
                         txs_per_block: int = 2,
                         seed: int = 7,
-                        timeout: float = 480.0) -> dict:
+                        timeout: float = 480.0,
+                        pipeline_depth: int | None = None) -> dict:
     """Sync n_blocks through the real blocksync reactor; returns the
-    result dict (blocks_per_sec + stage breakdown) and stores it in
-    `last_blocksync`."""
+    result dict (blocks_per_sec + stage breakdown + pipeline overlap
+    report) and stores it in `last_blocksync`.
+
+    pipeline_depth drives the reactor's overlapped verify pipeline
+    (blocksync/reactor.PIPELINE_DEPTH default): 1 = the serial loop,
+    >= 2 collects/packs window N+1 while window N is on device — the
+    A/B knob for serial-vs-pipelined on the same seed."""
     global last_blocksync
     n_blocks = n_blocks if n_blocks is not None else _env_int(
         "SIMNET_BENCH_BLOCKS", 96)
     n_vals = n_vals if n_vals is not None else _env_int(
         "SIMNET_BENCH_VALS", 64)
+    pipeline_depth = pipeline_depth if pipeline_depth is not None \
+        else _env_int("SIMNET_BENCH_PIPELINE_DEPTH", 0) or None
 
     net = SimNetwork(seed=seed)
     genesis, privs = make_sim_genesis(n_vals=n_vals, seed=seed)
@@ -61,6 +69,8 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
     # converges one block behind the serving tip (sync_target)
     grow_chain(src, privs, n_blocks + 1, txs_per_block=txs_per_block)
     syncer = SimNode("bsync", genesis, net, block_sync=True, seed=seed)
+    if pipeline_depth is not None:
+        syncer.blocksync_reactor.pipeline_depth = pipeline_depth
 
     prev_tracer = libtrace.tracer()
     tr = libtrace.StageTracer(
@@ -90,11 +100,23 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
 
     stages = {k: v for k, v in tr.snapshot().items()
               if k.startswith("blocksync.")}
+    # overlap report: sum-of-stages vs wall-clock (>1.0 = stages ran
+    # concurrently), plus the DIRECT proof — wall-clock during which a
+    # device span overlapped a collect or host_pack span of the next
+    # window (libs/trace.py interval records)
+    stage_sum = sum(v["seconds"] for v in stages.values())
+    device_overlap_s = round(
+        tr.overlap_seconds("blocksync", "device", "collect")
+        + tr.overlap_seconds("blocksync", "device", "host_pack"), 6)
     last_blocksync = {
         "blocks_per_sec": round(n_blocks / dt, 2),
         "blocks": n_blocks,
         "validators": n_vals,
         "seconds": round(dt, 3),
+        "pipeline_depth": (pipeline_depth if pipeline_depth is not None
+                           else syncer.blocksync_reactor.pipeline_depth),
+        "overlap_efficiency": round(stage_sum / dt, 4) if dt else 0.0,
+        "device_overlap_seconds": device_overlap_s,
         "stages": stages,
     }
     return last_blocksync

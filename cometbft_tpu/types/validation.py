@@ -128,6 +128,51 @@ class DeferredSigBatch:
         raise CommitVerificationError(
             "BUG: deferred batch failed with no invalid signatures")
 
+    def verify_async(self, pipeline, subsystem: str = "pipeline"):
+        """Submit the collected entries through an overlapped
+        VerifyPipeline (crypto/dispatch.py) instead of verifying
+        inline; returns a waiter whose .wait() has EXACTLY verify()'s
+        semantics (raises ErrInvalidSignature naming the first failing
+        commit, with .failed_ctx) once the window's verdict future
+        resolves.  The caller keeps collecting the next window while
+        this one is staged/on device."""
+        self._entries, entries = [], self._entries
+        if not entries:
+            return _DeferredVerdict(entries, None)
+        handle = pipeline.submit(
+            [(pub, sign_bytes, sig)
+             for _, _, pub, sign_bytes, sig in entries],
+            subsystem=subsystem, ctx=entries[0][1],
+            device_threshold=self.DEVICE_THRESHOLD)
+        return _DeferredVerdict(entries, handle)
+
+
+class _DeferredVerdict:
+    """In-flight window verdict: .wait() mirrors
+    DeferredSigBatch.verify()'s raise contract."""
+
+    __slots__ = ("_entries", "handle")
+
+    def __init__(self, entries, handle):
+        self._entries = entries
+        self.handle = handle
+
+    def done(self) -> bool:
+        return self.handle is None or self.handle.done()
+
+    def wait(self, timeout: float | None = None) -> None:
+        if self.handle is None:
+            return
+        ok, verdicts = self.handle.result(timeout)
+        if ok:
+            return
+        for (label, ctx, _, _, sig), valid in zip(self._entries,
+                                                  verdicts):
+            if not valid:
+                raise DeferredSigBatch._fail(label, ctx, sig)
+        raise CommitVerificationError(
+            "BUG: deferred window failed with no invalid signatures")
+
 
 def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
                   height: int, commit: Commit) -> None:

@@ -21,8 +21,19 @@ Stages (real package code, realistic object sizes):
 Each stage logs ms/block and the window total; the JSONL feeds the
 PERF.md "blocksync residual bottleneck" table.
 
+--overlap adds the serial-vs-pipelined host-stage A/B (same fixture,
+same methodology): the window is split into sub-windows which run
+collect -> parse+hash -> RLC pack either strictly serially or through
+the overlapped VerifyPipeline (crypto/dispatch.py: parallel SHA-512
+parse+hash in a worker pool, window N+1 collecting while window N
+packs).  The overlap rows carry an overlap-efficiency line
+(sum-of-stages vs wall-clock) plus parse byte-parity and a verdict
+parity sample against the serial path, so serial vs pipelined is an
+apples-to-apples A/B in the same JSONL.
+
 Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
-       flock /tmp/tpu.lock python scripts/profile_blocksync.py [out.jsonl]
+       flock /tmp/tpu.lock python scripts/profile_blocksync.py \
+           [out.jsonl] [--overlap]
 """
 
 from __future__ import annotations
@@ -37,7 +48,9 @@ sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 from _capture_util import already_done, append_log, wedged  # noqa: E402
 
-OUT = sys.argv[1] if len(sys.argv) > 1 else "/tmp/blocksync_profile.jsonl"
+_ARGS = [a for a in sys.argv[1:] if a != "--overlap"]
+OVERLAP = "--overlap" in sys.argv[1:]
+OUT = _ARGS[0] if _ARGS else "/tmp/blocksync_profile.jsonl"
 
 import os
 
@@ -264,6 +277,119 @@ def main():
         log(stage="abci_finalize",
             ms_per_block=round(1000 * dt / WINDOW, 2),
             window_s=round(dt, 3), n_txs=N_TXS)
+
+    # -- overlap A/B (--overlap): serial vs pipelined host stages ------
+    if OVERLAP and "overlap" not in done:
+        log(stage="overlap", start=True)
+        from cometbft_tpu.crypto import dispatch as vdispatch
+        from cometbft_tpu.libs import trace as libtrace
+
+        sub = int(os.environ.get("PROFILE_SUBWINDOWS", "4"))
+        depth = int(os.environ.get("PROFILE_PIPELINE_DEPTH", "2"))
+        per = max(1, WINDOW // sub)
+        groups = [list(range(i, min(i + per, WINDOW)))
+                  for i in range(0, WINDOW, per)]
+
+        def collect_group(idxs):
+            b = DeferredSigBatch()
+            for j in idxs:
+                blk, bid = blocks[j]
+                vals.verify_commit_light(chain_id, bid,
+                                         commits[j].height, commits[j],
+                                         defer_to=b)
+            return b._entries
+
+        # serial arm: collect -> parse+hash -> pack, one sub-window at
+        # a time, single-threaded — the shape the serial reactor pays
+        t0 = time.time()
+        for g in groups:
+            entries = collect_group(g)
+            gpks = [p.bytes() for _, _, p, _, _ in entries]
+            gmsgs = [m for _, _, _, m, _ in entries]
+            gsigs = [s for _, _, _, _, s in entries]
+            parsed_g = ed.parse_and_hash(gpks, gmsgs, gsigs)
+            ed.pack_rlc(gpks, [b""] * len(gpks), [b""] * len(gpks),
+                        parsed=parsed_g)
+        dt_serial = time.time() - t0
+        log(stage="overlap_serial",
+            ms_per_block=round(1000 * dt_serial / WINDOW, 2),
+            window_s=round(dt_serial, 3), subwindows=len(groups))
+
+        # pipelined arm: same sub-windows through the overlapped
+        # engine — parallel parse+hash in the worker pool, window N+1
+        # collecting while window N packs.  The device dispatch is
+        # stubbed to a constant verdict: this A/B measures the HOST
+        # stages (the serial profile's device stage measures the TPU)
+        tr = libtrace.StageTracer()
+        prev_tracer = libtrace.tracer()
+        libtrace.set_tracer(tr)
+        pipe = vdispatch.VerifyPipeline(
+            depth=depth,
+            dispatch_fn=lambda w: (True, [True] * len(w.items)),
+            name="profile-pipeline")
+        pipe.start()
+        try:
+            t0 = time.time()
+            handles = []
+            for g in groups:
+                entries = collect_group(g)
+                handles.append(pipe.submit(
+                    [(p, m, s) for _, _, p, m, s in entries],
+                    subsystem="blocksync", device_threshold=2))
+            for hd in handles:
+                hd.result()
+            dt_pipe = time.time() - t0
+        finally:
+            pipe.stop()
+            libtrace.set_tracer(prev_tracer)
+        snap = tr.snapshot()
+        stage_sum = sum(v["seconds"] for v in snap.values())
+        log(stage="overlap_pipelined",
+            ms_per_block=round(1000 * dt_pipe / WINDOW, 2),
+            window_s=round(dt_pipe, 3), depth=depth,
+            workers=pipe.host_workers)
+
+        # parity: parallel parse+hash must be byte-identical to the
+        # serial function on the full entry set ...
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            par = vdispatch.parse_and_hash_parallel(
+                pks, msgs, sigs_raw, pool=pool, workers=4)
+        parse_parity = par == ed.parse_and_hash(pks, msgs, sigs_raw)
+        # ... and a corrupted-sample verdict A/B: the pipeline's host
+        # lane must localize the same failing index the serial
+        # DeferredSigBatch path blames
+        sample = 128
+        spks = pks[:sample]
+        smsgs = msgs[:sample]
+        ssigs = list(sigs_raw[:sample])
+        ssigs[7] = ssigs[7][:4] + bytes([ssigs[7][4] ^ 1]) + ssigs[7][5:]
+        from cometbft_tpu.crypto.batch import safe_verify
+        serial_verdicts = [
+            safe_verify(ed.PubKey(pk), m, s)
+            for pk, m, s in zip(spks, smsgs, ssigs)]
+        vpipe = vdispatch.VerifyPipeline(depth=2, name="parity-pipe")
+        vpipe.start()
+        try:
+            _, pipe_verdicts = vpipe.submit(
+                list(zip(spks, smsgs, ssigs)),
+                device_threshold=1 << 30).result(timeout=120)
+        finally:
+            vpipe.stop()
+        verdict_parity = (serial_verdicts == pipe_verdicts
+                          and pipe_verdicts[7] is False)
+
+        log(stage="overlap",
+            serial_host_ms_per_block=round(
+                1000 * dt_serial / WINDOW, 2),
+            pipelined_host_ms_per_block=round(
+                1000 * dt_pipe / WINDOW, 2),
+            pipelined_vs_serial=round(dt_pipe / dt_serial, 3),
+            overlap_efficiency=round(stage_sum / dt_pipe, 3)
+            if dt_pipe else 0.0,
+            parse_parity=bool(parse_parity),
+            verdict_parity=bool(verdict_parity),
+            subwindows=len(groups), depth=depth)
 
     log(stage="done", total_s=round(time.time() - t_start, 1))
 
