@@ -1,0 +1,21 @@
+"""chaos: deterministic nemesis engine + invariant checkers over
+simnet (docs/CHAOS.md).
+
+A seeded engine drives simnet clusters through scheduled fault plans —
+partitions, lossy/dup/reorder links, node crash-restart with WAL
+replay, byzantine validators, device-fault bursts into the verify
+pipeline, clock skew — and checks global invariants (agreement, commit
+validity, height monotonicity, evidence-eventually-committed, bounded
+liveness) after every step.  Any failure replays from its seed alone:
+``python scripts/chaos_soak.py --seed S``.
+"""
+
+from .cluster import ChaosCluster, DeviceFaultController  # noqa: F401
+from .engine import NemesisEngine, ScenarioResult  # noqa: F401
+from .injectors import INJECTORS  # noqa: F401
+from .invariants import (  # noqa: F401
+    Agreement, BoundedLiveness, Checker, CommitValidity,
+    EvidenceCommitted, HeightMonotonic, Violation, default_checkers,
+)
+from .plan import Goal, Plan, Step, Trigger  # noqa: F401
+from .scenarios import SCENARIOS, bench_chaos, run_scenario  # noqa: F401

@@ -1,0 +1,271 @@
+"""NemesisEngine: executes a fault plan over a ChaosCluster, runs the
+invariant checkers after every step and on a poll cadence, and turns
+the run into (a) a DETERMINISTIC fingerprint record — the jsonl line a
+seed replay must reproduce bit-for-bit — and (b) recovery-time
+metrics (time-to-first-commit after heal, blocks/s under a device
+fault burst) that bench.py surfaces as ``chaos_*`` extras.
+
+On any invariant violation the engine dumps every node's flight
+recorder to the log AND writes a jsonl artifact next to the verdict
+(violations + per-node recorder timelines), so the question "what led
+here?" is answered by the artifact, not by a rerun.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from .injectors import INJECTORS
+from .invariants import BoundedLiveness, EvidenceCommitted
+
+_log = logging.getLogger(__name__)
+
+
+class ScenarioResult:
+    def __init__(self, name: str, seed: int):
+        self.name = name
+        self.seed = seed
+        self.goal_reached = False
+        self.violations: list[dict] = []
+        self.fingerprint: dict = {}
+        self.timing: dict = {}
+        self.context: dict = {}
+        self.artifacts: list[str] = []
+
+    @property
+    def ok(self) -> bool:
+        return self.goal_reached and not self.violations
+
+    def to_dict(self) -> dict:
+        return {"scenario": self.name, "seed": self.seed,
+                "ok": self.ok, "goal_reached": self.goal_reached,
+                "violations": self.violations,
+                "fingerprint": self.fingerprint,
+                "timing": self.timing,
+                "artifacts": self.artifacts}
+
+
+class NemesisEngine:
+    def __init__(self, cluster, plan, checkers, artifact_dir=None,
+                 metrics=None, poll: float = 0.02):
+        self.cluster = cluster
+        self.plan = plan
+        self.checkers = checkers
+        self.artifact_dir = artifact_dir
+        self.metrics = metrics
+        self.poll = poll
+        self.result = ScenarioResult(plan.name, cluster.seed)
+        self._burst: tuple[float, int, str] | None = None
+
+    # -- plumbing ----------------------------------------------------------
+    @staticmethod
+    def _applied_height(node) -> int:
+        st = node.state_store.load()
+        return st.last_block_height if st is not None else 0
+
+    def _goal_met(self) -> bool:
+        g = self.plan.end_goal
+        for name in g.nodes:
+            node = self.cluster.nodes.get(name)
+            if node is None or node.height() < g.height or \
+                    self._applied_height(node) < g.height:
+                return False
+        if g.require_evidence:
+            for chk in self.checkers:
+                if isinstance(chk, EvidenceCommitted):
+                    return chk.found_at is not None
+        return True
+
+    def _await_trigger(self, trigger, deadline: float) -> bool:
+        if trigger.node is not None:
+            while time.monotonic() < deadline:
+                node = self.cluster.nodes.get(trigger.node)
+                if node is not None and \
+                        node.height() >= trigger.height:
+                    return True
+                self._run_checkers()
+                time.sleep(self.poll)
+            return False
+        if trigger.after_s:
+            until = time.monotonic() + trigger.after_s
+            while time.monotonic() < min(until, deadline):
+                self._run_checkers()
+                time.sleep(self.poll)
+        return time.monotonic() < deadline
+
+    def _run_checkers(self, final: bool = False) -> None:
+        for chk in self.checkers:
+            for v in chk.check(self.cluster, final=final):
+                rec = v.to_dict()
+                self.result.violations.append(rec)
+                if self.metrics is not None:
+                    self.metrics.invariant_violations.labels(
+                        v.invariant).inc()
+                _log.warning("chaos invariant violation: %s", rec)
+
+    # -- the run -----------------------------------------------------------
+    def setup(self) -> list:
+        """Fire the plan's pre-start steps (call BEFORE the cluster
+        starts); returns their descriptions for the fingerprint."""
+        executed = []
+        for step in self.plan.setup_steps:
+            info = INJECTORS[step.action](self.cluster, **step.kwargs)
+            d = step.describe()
+            d["setup"] = True
+            executed.append(d)
+            if info:
+                self.result.context[step.action] = info
+            if self.metrics is not None:
+                self.metrics.faults_injected.labels(step.action).inc()
+            self._note_step(step, info)
+        self._setup_executed = executed
+        return executed
+
+    def run(self) -> ScenarioResult:
+        res = self.result
+        goal = self.plan.end_goal
+        t0 = time.monotonic()
+        deadline = t0 + goal.timeout
+        executed = list(getattr(self, "_setup_executed", []))
+        for step in self.plan.steps:
+            if not self._await_trigger(step.trigger, deadline):
+                res.violations.append({
+                    "invariant": "schedule",
+                    "detail": f"step {step.action!r} trigger never "
+                              "fired before the scenario deadline"})
+                break
+            info = INJECTORS[step.action](self.cluster, **step.kwargs)
+            executed.append(step.describe())
+            if info:
+                res.context[step.action] = info
+            if self.metrics is not None:
+                self.metrics.faults_injected.labels(step.action).inc()
+            self._note_step(step, info)
+            self._run_checkers()
+
+        while time.monotonic() < deadline and not self._goal_met():
+            self._run_checkers()
+            time.sleep(self.poll)
+        res.goal_reached = self._goal_met()
+        if not res.goal_reached:
+            res.violations.append({
+                "invariant": "goal",
+                "detail": f"goal {goal.describe()} not reached within "
+                          f"{goal.timeout:.0f}s; heights "
+                          f"{self.cluster.heights()}"})
+        self._run_checkers(final=True)
+        self._collect_timing(t0)
+        self._fingerprint(executed)
+        if res.violations:
+            self._write_artifact()
+        return res
+
+    # -- step side effects -------------------------------------------------
+    def _note_step(self, step, info) -> None:
+        if step.action == "heal":
+            for chk in self.checkers:
+                if isinstance(chk, BoundedLiveness):
+                    chk.note_heal(self.cluster)
+        elif step.action == "byzantine_double_sign" and info:
+            for chk in self.checkers:
+                if isinstance(chk, EvidenceCommitted):
+                    chk.arm(info["address"])
+        elif step.action == "device_fault" and info:
+            node = self.cluster.nodes.get(info["node"])
+            self._burst = (time.monotonic(),
+                           self._applied_height(node) if node else 0,
+                           info["node"])
+
+    def _collect_timing(self, t0: float) -> None:
+        timing = self.result.timing
+        timing["wall_seconds"] = round(time.monotonic() - t0, 3)
+        recov = [r for chk in self.checkers
+                 if isinstance(chk, BoundedLiveness)
+                 for r in chk.recovery_seconds]
+        if recov:
+            # time from the LAST heal to its first new commit — the
+            # headline recovery metric
+            timing["recovery_seconds"] = round(recov[-1], 4)
+            timing["recovery_seconds_all"] = [round(r, 4) for r in recov]
+            if self.metrics is not None:
+                self.metrics.recovery_seconds.set(recov[-1])
+        if self._burst is not None:
+            t_arm, h_arm, name = self._burst
+            node = self.cluster.nodes.get(name)
+            if node is not None:
+                dh = self._applied_height(node) - h_arm
+                dt = time.monotonic() - t_arm
+                if dt > 0 and dh >= 0:
+                    rate = round(dh / dt, 3)
+                    timing["faulted_blocks_per_sec"] = rate
+                    if self.metrics is not None:
+                        self.metrics.faulted_blocks_per_sec.set(rate)
+        ctl_stats = {
+            n: {"windows_seen": c.windows_seen,
+                "faults_fired": c.faults_fired}
+            for n, c in self.cluster.device_controllers.items()}
+        if ctl_stats:
+            timing["device"] = ctl_stats
+
+    # -- reporting ---------------------------------------------------------
+    def _fingerprint(self, executed) -> None:
+        """The seed-replayable record.  Deterministic plans (blocksync
+        over grow_chain history) pin heights, app hashes, and the goal
+        block hash; live-consensus plans pin only schedule + invariant
+        facts (block timestamps come from wall clocks, so their hashes
+        are not a function of the seed — docs/CHAOS.md)."""
+        res = self.result
+        fp = {"scenario": self.plan.name, "seed": self.cluster.seed,
+              "steps": executed,
+              "goal_reached": res.goal_reached,
+              "violation_count": len(res.violations)}
+        if self.plan.deterministic:
+            fp["heights"] = {
+                n: self._applied_height(node)
+                for n, node in sorted(self.cluster.nodes.items())}
+            fp["app_hashes"] = dict(sorted(
+                self.cluster.app_hashes().items()))
+            g = self.plan.end_goal
+            fp["goal_block_hash"] = {
+                n: self.cluster.block_hash(n, g.height)
+                for n in sorted(g.nodes) if n in self.cluster.nodes}
+            # the app hash AFTER applying the goal block, per node —
+            # the cross-node agreement the acceptance combo asserts.
+            # A node parked exactly at the goal reads its state; a
+            # node past it reads header(goal+1).app_hash, which
+            # attests the same block
+            fp["app_hash_at_goal"] = {}
+            for n, node in sorted(self.cluster.nodes.items()):
+                if self._applied_height(node) == g.height:
+                    fp["app_hash_at_goal"][n] = node.app_hash().hex()
+                else:
+                    meta = node.block_store.load_block_meta(
+                        g.height + 1)
+                    if meta is not None:
+                        fp["app_hash_at_goal"][n] = \
+                            meta.header.app_hash.hex()
+        res.fingerprint = fp
+
+    def _write_artifact(self) -> None:
+        if self.artifact_dir is None:
+            return
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        path = os.path.join(
+            self.artifact_dir,
+            f"{self.plan.name}_seed{self.cluster.seed}_violations.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "scenario",
+                                **self.result.to_dict()}) + "\n")
+            for v in self.result.violations:
+                f.write(json.dumps({"kind": "violation", **v}) + "\n")
+            for name, dump in self.cluster.flightrec_dumps().items():
+                f.write(json.dumps({"kind": "flightrec", "node": name,
+                                    **dump}) + "\n")
+        self.result.artifacts.append(path)
+        for name, node in self.cluster.nodes.items():
+            node.flight_recorder.dump_to_log(
+                f"chaos scenario {self.plan.name!r} violated an "
+                f"invariant (node {name}, artifact {path})", _log)

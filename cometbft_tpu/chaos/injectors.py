@@ -1,0 +1,260 @@
+"""Named fault injectors over a ChaosCluster.
+
+Each injector is a function ``(cluster, **kwargs) -> dict | None``
+registered in INJECTORS; plans reference them by name
+(chaos/plan.py) and the engine fires them in order.  Anything an
+injector returns lands in the scenario's context (engine.py) for
+checkers and reports.
+
+Injector families (ISSUE 4 tentpole):
+
+- network: partition / heal / link conditioning (delay, jitter, drop,
+  dup, reorder — the simnet transport seam);
+- process: crash / restart with store + WAL survival
+  (chaos/cluster.py);
+- byzantine: double-sign equivocation (feeding the evidence pool) and
+  lockless 'amnesia' voting; a forged-commit byzantine SERVER lying on
+  the blocksync wire;
+- device: armable fault bursts into the chaos verify pipeline
+  (drain-exercising, or the deliberately broken 'forge' mode);
+- clock: skew on a validator's consensus ticker.
+
+The two BROKEN injectors — device_fault(mode='forge') and
+disable_evidence — exist so the invariant checkers can be proven
+non-vacuous (the self-test satellite): a chaos framework whose oracle
+never fires on a planted bug is theater.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..consensus import messages as cmsgs
+from ..consensus.reactor import VOTE_CHANNEL
+from ..types.block import BlockID, CommitSig, PartSetHeader
+from ..types.timestamp import Timestamp
+from ..types.vote import PREVOTE_TYPE, Vote
+
+INJECTORS: dict = {}
+
+
+def injector(fn):
+    INJECTORS[fn.__name__] = fn
+    return fn
+
+
+# -- network -----------------------------------------------------------------
+
+@injector
+def partition(cluster, groups):
+    """Split the network into the named groups (lists of node names)."""
+    cluster.network.partition(*[set(g) for g in groups])
+    return {"groups": [sorted(g) for g in groups]}
+
+
+@injector
+def heal(cluster):
+    cluster.network.heal()
+
+
+@injector
+def redial(cluster):
+    """Re-attempt every recorded topology edge — the post-heal step
+    for plans that partitioned before any connection existed (dials
+    to already-connected peers are deduped by the switch)."""
+    cluster.redial()
+
+
+@injector
+def set_link(cluster, a, b, **cond):
+    cluster.network.set_link(a, b, **cond)
+
+
+@injector
+def set_default_link(cluster, **cond):
+    cluster.network.set_default_link(**cond)
+
+
+# -- process -----------------------------------------------------------------
+
+@injector
+def crash(cluster, node):
+    cluster.crash(node)
+
+
+@injector
+def restart(cluster, node):
+    cluster.restart(node)
+
+
+# -- clock -------------------------------------------------------------------
+
+@injector
+def clock_skew(cluster, node, factor):
+    """Multiply every consensus timeout the node schedules: >1 runs
+    its round clock slow, <1 fast.  Honest-majority consensus must
+    keep committing (the skewed node escalates rounds, catches up via
+    gossip)."""
+    cluster.nodes[node].consensus_state.ticker.skew = float(factor)
+    return {"node": node, "factor": float(factor)}
+
+
+# -- device ------------------------------------------------------------------
+
+@injector
+def device_fault(cluster, node, windows=2, mode="drain"):
+    """Arm a burst of device faults on the node's chaos verify
+    pipeline (install_chaos_device must have run at cluster build).
+    mode='drain' raises like a real device error — the pipeline must
+    drain the faulted window and everything staged behind it through
+    the host path; mode='forge' is the BROKEN oracle-proving variant
+    that skips the drain and claims every signature valid."""
+    ctl = cluster.device_controllers[node]
+    ctl.arm(windows, mode=mode)
+    return {"node": node, "windows": int(windows), "mode": mode}
+
+
+# -- byzantine ---------------------------------------------------------------
+
+def _conflict_block_id(seed: int, height: int, round_: int) -> BlockID:
+    """Deterministic fake BlockID for an equivocating vote."""
+    h = hashlib.sha256(
+        f"chaos-equivocation/{seed}/{height}/{round_}".encode()).digest()
+    return BlockID(h, PartSetHeader(1, hashlib.sha256(h).digest()))
+
+
+@injector
+def byzantine_double_sign(cluster, node):
+    """Equivocate: after every honest non-nil prevote, sign a
+    conflicting prevote with the RAW validator key (the FilePV would
+    refuse) and gossip it to every peer — any honest peer still
+    inside the round converts the pair to DuplicateVoteEvidence
+    (tests/test_byzantine.py established the idiom; this is the
+    evidence-pool feed for the evidence-eventually-committed
+    invariant)."""
+    n = cluster.nodes[node]
+    cs = n.consensus_state
+    priv = cluster.privs[cluster._specs[node]["index"]]
+    seed = cluster.seed
+    orig_sign = cs._sign_add_vote
+
+    def byz_sign_add_vote(msg_type, hash_, header, block=None):
+        orig_sign(msg_type, hash_, header, block)
+        if msg_type != PREVOTE_TYPE or not hash_:
+            return
+        addr = cs.priv_validator_pub_key.address()
+        val_idx, _ = cs.validators.get_by_address(addr)
+        conflicting = Vote(
+            type=PREVOTE_TYPE, height=cs.height, round=cs.round,
+            block_id=_conflict_block_id(seed, cs.height, cs.round),
+            timestamp=Timestamp.now(),
+            validator_address=addr, validator_index=val_idx)
+        conflicting.signature = priv.sign(
+            conflicting.sign_bytes(cs.state.chain_id))
+        msg = cmsgs.wrap_message(cmsgs.VoteMessage(conflicting))
+        for peer in n.switch.peers.list():
+            peer.try_send(VOTE_CHANNEL, msg)
+
+    cs._sign_add_vote = byz_sign_add_vote
+
+    # the byzantine node must not crash on its own equivocation
+    # echoing back through gossip (honest nodes keep the panic)
+    orig_try = cs._try_add_vote
+
+    def byz_try_add_vote(vote, peer_id):
+        try:
+            return orig_try(vote, peer_id)
+        except Exception:
+            return False
+
+    cs._try_add_vote = byz_try_add_vote
+    return {"node": node,
+            "address": priv.pub_key().address().hex()}
+
+
+@injector
+def byzantine_amnesia(cluster, node):
+    """Amnesia: forget the POL lock at every round entry, so the node
+    freely prevotes whatever the new round proposes.  One amnesiac
+    among 3f+1 honest-majority validators must not break agreement —
+    exactly what the agreement checker watches."""
+    cs = cluster.nodes[node].consensus_state
+    orig = cs.enter_new_round
+
+    def amnesiac_enter_new_round(height, round_):
+        cs.locked_round = -1
+        cs.locked_block = None
+        cs.locked_block_parts = None
+        orig(height, round_)
+
+    cs.enter_new_round = amnesiac_enter_new_round
+    return {"node": node}
+
+
+@injector
+def disable_evidence(cluster):
+    """BROKEN ON PURPOSE: drop every conflicting-vote report on every
+    node, so double-sign equivocation can never become committed
+    evidence.  The evidence-eventually-committed checker MUST trip on
+    a scenario that pairs this with byzantine_double_sign — the
+    oracle-isn't-vacuous self-test."""
+    for n in cluster.nodes.values():
+        if n.evidence_pool is not None:
+            n.evidence_pool.report_conflicting_votes = \
+                lambda vote_a, vote_b: None
+    return {"broken": True}
+
+
+def _forge_commit(commit, seed: int):
+    """Copy of `commit` with validator 0's signature deterministically
+    corrupted (flag still COMMIT, so the power tally passes and ONLY
+    signature verification can catch it)."""
+    sigs = list(commit.signatures)
+    for i, cs_ in enumerate(sigs):
+        if cs_.for_block() and cs_.signature:
+            bad = bytes([cs_.signature[0] ^ (0x5A ^ (seed & 0xFF) or 0xA5)]) \
+                + cs_.signature[1:]
+            sigs[i] = CommitSig(cs_.block_id_flag, cs_.validator_address,
+                                cs_.timestamp, bad)
+            break
+    from ..types.block import Commit
+    return Commit(height=commit.height, round=commit.round,
+                  block_id=commit.block_id, signatures=sigs)
+
+
+@injector
+def forged_commit_server(cluster, node, height, once=True):
+    """Make `node` a lying blocksync server: when asked for block
+    height+1 it serves a copy whose LastCommit (the commit that
+    attests `height`) carries a forged signature.  The syncer uses
+    exactly that commit to verify block `height` — an honest verify
+    path must reject, evict, and refetch (with once=True the retry
+    gets the truth, so the scenario completes); a broken (forge-mode)
+    device path accepts it, stores the garbage commit as the seen
+    commit of `height`, and the commit-validity invariant catches it."""
+    from ..blocksync import messages as bm
+    from ..blocksync.reactor import BLOCKSYNC_CHANNEL
+    from ..types.block import Block
+
+    reactor = cluster.nodes[node].blocksync_reactor
+    orig = reactor._respond_to_block_request
+    lie_at = int(height) + 1
+    seed = cluster.seed
+    lies = {"left": 1 if once else (1 << 30)}
+
+    def lying_respond(peer, h):
+        if h != lie_at or lies["left"] <= 0:
+            return orig(peer, h)
+        block = reactor.store.load_block(h)
+        if block is None or block.last_commit is None:
+            return orig(peer, h)
+        lies["left"] -= 1
+        forged = Block(header=block.header, data=block.data,
+                       evidence=block.evidence,
+                       last_commit=_forge_commit(block.last_commit,
+                                                 seed))
+        peer.try_send(BLOCKSYNC_CHANNEL,
+                      bm.wrap(bm.BlockResponse(forged, None)))
+
+    reactor._respond_to_block_request = lying_respond
+    return {"node": node, "forged_commit_height": int(height)}

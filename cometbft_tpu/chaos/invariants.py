@@ -1,0 +1,246 @@
+"""Global invariant checkers the nemesis engine runs after every step
+and at scenario end.
+
+Checkers are INCREMENTAL (per-node height cursors) so polling them
+every engine tick stays cheap, and STATEFUL only in ways that survive
+a node crash-restart (cursors key on node name; the stores themselves
+persist through the chaos cluster).
+
+The set (ISSUE 4 tentpole):
+
+- agreement        — no two nodes commit different blocks at a height;
+- commit-validity  — every committed height's seen commit re-verifies
+  via types/validation.verify_commit (ALL signatures — the early-exit
+  light variant could skip a forged straggler) against the stored
+  validator set and block hash;
+- height-monotonic — a node's store height never regresses (including
+  across crash-restart);
+- evidence-eventually-committed — observed double-sign equivocation
+  must land as committed DuplicateVoteEvidence on an honest node by
+  scenario end;
+- bounded-liveness — after a heal, the cluster's max height must grow
+  within a budget (and the time it took IS the recovery metric).
+
+A violation is a structured record; the engine dumps every node's
+flight recorder next to it (the jsonl artifact + dump-to-log), so the
+timeline that led to the violation ships with the verdict.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from ..types.evidence import DuplicateVoteEvidence
+from ..types.validation import CommitVerificationError, verify_commit
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+    node: str | None = None
+    height: int | None = None
+
+    def to_dict(self) -> dict:
+        d = {"invariant": self.invariant, "detail": self.detail}
+        if self.node is not None:
+            d["node"] = self.node
+        if self.height is not None:
+            d["height"] = self.height
+        return d
+
+
+class Checker:
+    name = "checker"
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        raise NotImplementedError
+
+
+class Agreement(Checker):
+    """First committer of a height pins the canonical block hash;
+    every other node must match it."""
+
+    name = "agreement"
+
+    def __init__(self):
+        self._canon: dict[int, tuple[str, str]] = {}
+        self._cursor: dict[str, int] = {}
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        out = []
+        for name, node in cluster.nodes.items():
+            top = node.height()
+            h = self._cursor.get(name, max(node.block_store.base(), 1) - 1)
+            while h < top:
+                h += 1
+                meta = node.block_store.load_block_meta(h)
+                if meta is None:
+                    h -= 1
+                    break
+                digest = meta.header.hash().hex()
+                got = self._canon.get(h)
+                if got is None:
+                    self._canon[h] = (name, digest)
+                elif got[1] != digest:
+                    out.append(Violation(
+                        self.name, node=name, height=h,
+                        detail=f"block hash {digest[:16]} disagrees "
+                               f"with {got[0]}'s {got[1][:16]}"))
+            self._cursor[name] = h
+        return out
+
+
+class CommitValidity(Checker):
+    """Every committed LastCommit re-verifies on the host — the oracle
+    that catches a verify pipeline claiming verdicts it never earned
+    (the forge-mode broken injector)."""
+
+    name = "commit_validity"
+
+    def __init__(self):
+        self._cursor: dict[str, int] = {}
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        chain_id = cluster.genesis.chain_id
+        out = []
+        for name, node in cluster.nodes.items():
+            top = node.height()
+            h = self._cursor.get(name, max(node.block_store.base(), 1) - 1)
+            while h < top:
+                h += 1
+                commit = node.block_store.load_seen_commit(h)
+                meta = node.block_store.load_block_meta(h)
+                if commit is None or meta is None:
+                    h -= 1
+                    break
+                if commit.block_id.hash != meta.header.hash():
+                    out.append(Violation(
+                        self.name, node=name, height=h,
+                        detail="seen commit signs "
+                               f"{commit.block_id.hash.hex()[:16]}, "
+                               "store holds "
+                               f"{meta.header.hash().hex()[:16]}"))
+                    continue
+                try:
+                    vals = node.state_store.load_validators(h)
+                    verify_commit(chain_id, vals, commit.block_id, h,
+                                  commit)
+                except CommitVerificationError as e:
+                    out.append(Violation(
+                        self.name, node=name, height=h,
+                        detail=f"committed LastCommit does not "
+                               f"re-verify: {e}"))
+                except Exception as e:  # noqa: BLE001 - oracle must not die
+                    out.append(Violation(
+                        self.name, node=name, height=h,
+                        detail=f"validity re-check errored: {e!r}"))
+            self._cursor[name] = h
+        return out
+
+
+class HeightMonotonic(Checker):
+    name = "height_monotonic"
+
+    def __init__(self):
+        self._last: dict[str, int] = {}
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        out = []
+        for name, node in cluster.nodes.items():
+            h = node.height()
+            prev = self._last.get(name, 0)
+            if h < prev:
+                out.append(Violation(
+                    self.name, node=name, height=h,
+                    detail=f"height regressed {prev} -> {h}"))
+            self._last[name] = max(h, prev)
+        return out
+
+
+class EvidenceCommitted(Checker):
+    """Arm with the equivocator's address (the double-sign injector
+    returns it); by scenario end some honest node must have the
+    DuplicateVoteEvidence in a committed block."""
+
+    name = "evidence_committed"
+
+    def __init__(self, address_hex: str | None = None):
+        self.address_hex = address_hex
+        self.found_at: tuple[str, int] | None = None
+
+    def arm(self, address_hex: str) -> None:
+        self.address_hex = address_hex
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        if self.address_hex is None:
+            return []
+        addr = bytes.fromhex(self.address_hex)
+        if self.found_at is None:
+            for name, node in cluster.nodes.items():
+                store = node.block_store
+                for h in range(max(store.base(), 1), store.height() + 1):
+                    block = store.load_block(h)
+                    if block is None:
+                        continue
+                    for ev in block.evidence:
+                        if isinstance(ev, DuplicateVoteEvidence) and \
+                                ev.vote_a.validator_address == addr:
+                            self.found_at = (name, h)
+                            return []
+        if self.found_at is None and final:
+            return [Violation(
+                self.name,
+                detail="double-sign equivocation by "
+                       f"{self.address_hex[:16]} observed but no "
+                       "DuplicateVoteEvidence committed by scenario "
+                       "end")]
+        return []
+
+
+class BoundedLiveness(Checker):
+    """After a heal the cluster's max height must grow within
+    `budget_s` seconds; the measured time-to-first-commit is the
+    chaos_recovery_seconds metric."""
+
+    name = "bounded_liveness"
+
+    def __init__(self, budget_s: float = 60.0):
+        self.budget_s = budget_s
+        self._pending: tuple[float, int] | None = None
+        self.recovery_seconds: list[float] = []
+        self._tripped = False
+
+    @staticmethod
+    def _progress(cluster) -> int:
+        # SUM of heights, not max: a syncer catching up behind a
+        # static serving tip is progress too
+        heights = cluster.heights()
+        return sum(heights.values()) if heights else 0
+
+    def note_heal(self, cluster) -> None:
+        self._pending = (time.monotonic(), self._progress(cluster))
+        self._tripped = False
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        if self._pending is None:
+            return []
+        t0, h0 = self._pending
+        top = self._progress(cluster)
+        if top > h0:
+            self.recovery_seconds.append(time.monotonic() - t0)
+            self._pending = None
+            return []
+        if not self._tripped and time.monotonic() - t0 > self.budget_s:
+            self._tripped = True
+            return [Violation(
+                self.name,
+                detail=f"no commit within {self.budget_s:.0f}s of "
+                       f"heal (height sum stuck at {top})")]
+        return []
+
+
+def default_checkers(liveness_budget_s: float = 60.0) -> list[Checker]:
+    return [Agreement(), CommitValidity(), HeightMonotonic(),
+            BoundedLiveness(liveness_budget_s)]

@@ -1,0 +1,152 @@
+"""Nemesis schedule DSL: an ordered, seed-independent plan of fault
+steps over a simnet cluster.
+
+A Plan is a LIST of steps, executed strictly in order by the engine;
+each step waits for its trigger, then fires one named injector
+(chaos/injectors.py).  Two trigger kinds:
+
+- ``at(seconds)``   — seconds after the PREVIOUS step fired (wall
+  pacing; only use for heal/settle delays where exact placement does
+  not matter for determinism);
+- ``when(node, height)`` — the named node's block store reaches the
+  height (progress pacing; the deterministic way to place a fault
+  "mid-sync", since it keys on chain state, not scheduler luck).
+
+The plan also carries the GOAL — the completion condition the engine
+waits for after the last step — and a ``deterministic`` flag: plans
+whose final chain state is a pure function of the seed (blocksync over
+grow_chain history) fingerprint heights + app hashes; live-consensus
+plans cannot (block timestamps come from wall clocks) and fingerprint
+only invariant-level facts.  docs/CHAOS.md documents the split.
+
+``describe()`` returns the full step list as plain dicts — part of the
+scenario fingerprint, so a replayed seed provably executed the same
+schedule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Trigger:
+    """When a step fires: after `after_s` seconds (relative to the
+    previous step), or when `node` reaches `height`, or immediately
+    (both None)."""
+    after_s: float | None = None
+    node: str | None = None
+    height: int | None = None
+
+    def describe(self) -> dict:
+        if self.node is not None:
+            return {"when": {"node": self.node, "height": self.height}}
+        if self.after_s is not None:
+            return {"after_s": self.after_s}
+        return {"immediate": True}
+
+
+@dataclass
+class Step:
+    action: str                  # injector name (chaos/injectors.py)
+    trigger: Trigger
+    kwargs: dict = field(default_factory=dict)
+
+    def describe(self) -> dict:
+        d = {"action": self.action, **self.trigger.describe()}
+        if self.kwargs:
+            d["kwargs"] = {k: _plain(v) for k, v in self.kwargs.items()}
+        return d
+
+
+def _plain(v):
+    """Fingerprint-safe rendering of step kwargs (sets have no stable
+    JSON form; frozensets of node names sort cleanly)."""
+    if isinstance(v, (set, frozenset)):
+        return sorted(v)
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    if isinstance(v, bytes):
+        return v.hex()
+    return v
+
+
+@dataclass
+class Goal:
+    """Completion condition: every node in `nodes` reaches `height`
+    (applied, not just stored — SimNode.wait_for_height semantics)
+    within `timeout` seconds.  require_evidence additionally holds the
+    goal open until the EvidenceCommitted checker has seen committed
+    equivocation evidence (byzantine scenarios end on proof, not on a
+    height guess)."""
+    nodes: list
+    height: int
+    timeout: float = 120.0
+    require_evidence: bool = False
+
+    def describe(self) -> dict:
+        d = {"nodes": list(self.nodes), "height": self.height}
+        if self.require_evidence:
+            d["require_evidence"] = True
+        return d
+
+
+class Plan:
+    """Builder: Plan("name").when("syncer", 8, "partition", ...)
+    .at(0.4, "heal").goal(["syncer"], 24)."""
+
+    def __init__(self, name: str, deterministic: bool = True):
+        self.name = name
+        self.deterministic = deterministic
+        self.setup_steps: list[Step] = []
+        self.steps: list[Step] = []
+        self._goal: Goal | None = None
+
+    # -- step builders -----------------------------------------------------
+    def setup(self, action: str, **kwargs) -> "Plan":
+        """Fire BEFORE the cluster starts — the only race-free
+        placement for faults that must precede the first packet
+        (byzantine servers, armed device bursts, partitions at
+        birth): a sub-second sync outruns any post-start step."""
+        self.setup_steps.append(Step(action, Trigger(), kwargs))
+        return self
+
+    def now(self, action: str, **kwargs) -> "Plan":
+        self.steps.append(Step(action, Trigger(), kwargs))
+        return self
+
+    def at(self, seconds: float, action: str, **kwargs) -> "Plan":
+        self.steps.append(Step(action, Trigger(after_s=seconds), kwargs))
+        return self
+
+    def when(self, trigger_node: str, trigger_height: int, action: str,
+             **kwargs) -> "Plan":
+        """Fire `action` once trigger_node's store reaches
+        trigger_height (names avoid colliding with injector kwargs —
+        device_fault et al. take their own `node`)."""
+        self.steps.append(
+            Step(action, Trigger(node=trigger_node,
+                                 height=trigger_height), kwargs))
+        return self
+
+    def goal(self, nodes, height: int, timeout: float = 120.0,
+             require_evidence: bool = False) -> "Plan":
+        self._goal = Goal(list(nodes), height, timeout,
+                          require_evidence)
+        return self
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def end_goal(self) -> Goal:
+        if self._goal is None:
+            raise ValueError(f"plan {self.name!r} has no goal")
+        return self._goal
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "deterministic": self.deterministic,
+            "setup": [s.describe() for s in self.setup_steps],
+            "steps": [s.describe() for s in self.steps],
+            "goal": self.end_goal.describe(),
+        }

@@ -1,0 +1,368 @@
+"""Named chaos scenarios: cluster topology + fault plan + invariant
+set, runnable from one seed (scripts/chaos_soak.py drives these; the
+catalog is documented in docs/CHAOS.md).
+
+Scenario taxonomy:
+
+- deterministic=True scenarios sync a grow_chain history through the
+  real blocksync stack — the final heights/app-hashes/goal-block-hash
+  fingerprint is a pure function of the seed, which is what the soak's
+  --check-determinism mode (and the acceptance criterion) compares
+  across two runs of the same seed;
+- live-consensus scenarios (clock skew, validator crash-restart with
+  WAL replay, byzantine equivocation) commit wall-clock-timestamped
+  blocks, so their fingerprint pins only the schedule and the
+  invariant verdicts;
+- broken=True scenarios deliberately plant a bug (forge-mode device
+  faults + a forged-commit server; evidence handling disabled under
+  double-sign) and are EXPECTED to produce violations — the self-test
+  proving the invariant oracle is not vacuous.
+"""
+
+from __future__ import annotations
+
+from .cluster import ChaosCluster
+from .engine import NemesisEngine, ScenarioResult
+from .invariants import (
+    Agreement, BoundedLiveness, CommitValidity, EvidenceCommitted,
+    HeightMonotonic, default_checkers,
+)
+from .plan import Plan
+
+SCENARIOS: dict = {}
+
+# the most recent bench_chaos() result dict (bench.py attaches it as
+# chaos detail, mirroring simnet.bench.last_blocksync)
+last_chaos: dict | None = None
+
+
+def scenario(deterministic=True, tier="fast", broken=False):
+    def wrap(fn):
+        SCENARIOS[fn.__name__] = {
+            "fn": fn, "deterministic": deterministic, "tier": tier,
+            "broken": broken, "doc": (fn.__doc__ or "").strip()}
+        return fn
+    return wrap
+
+
+def run_scenario(name: str, seed: int, artifact_dir=None,
+                 workdir=None, metrics=None, **kwargs) -> ScenarioResult:
+    fn = SCENARIOS[name]["fn"]
+    return fn(seed, artifact_dir=artifact_dir, workdir=workdir,
+              metrics=metrics, **kwargs)
+
+
+def _run(cluster, plan, checkers, artifact_dir, metrics) -> ScenarioResult:
+    engine = NemesisEngine(cluster, plan, checkers,
+                           artifact_dir=artifact_dir, metrics=metrics)
+    try:
+        engine.setup()          # pre-start faults (race-free placement)
+        cluster.start_all()
+        return engine.run()
+    finally:
+        cluster.stop_all()
+
+
+# -- deterministic blocksync scenarios ---------------------------------------
+
+@scenario(deterministic=True)
+def partition_heal(seed, blocks=24, artifact_dir=None, workdir=None,
+                   metrics=None, timeout=90.0):
+    """Syncer partitioned mid-sync, healed after a beat: bounded
+    liveness measures time-to-first-commit after heal; agreement +
+    validity + monotonicity hold throughout."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_server("src1", blocks)
+    c.add_syncer("syncer")
+    c.dial("syncer", "src0")
+    c.dial("syncer", "src1")
+    # partition at birth (setup = race-free placement: the cut is in
+    # force before the first dial), heal after a beat, then redial the
+    # edges the cut refused at start
+    plan = (Plan("partition_heal")
+            .setup("partition", groups=[["src0", "src1"], ["syncer"]])
+            .at(0.5, "heal")
+            .now("redial")
+            .goal(["syncer"], blocks, timeout=timeout))
+    return _run(c, plan, default_checkers(liveness_budget_s=45),
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=True)
+def lossy_dup_reorder(seed, blocks=24, artifact_dir=None, workdir=None,
+                      metrics=None, timeout=90.0):
+    """Duplicated + pairwise-reordered + dropped frames on the sync
+    link: the protocol's own dedup/retry machinery must converge to
+    the identical chain (transport faults never corrupt state, only
+    delay it)."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_server("src1", blocks)
+    c.add_syncer("syncer")
+    c.dial("syncer", "src0")
+    c.dial("syncer", "src1")
+    plan = (Plan("lossy_dup_reorder")
+            .setup("set_link", a="src0", b="syncer", latency=0.001,
+                   jitter=0.001, drop=0.03, dup=0.05, reorder=0.05)
+            .goal(["syncer"], blocks, timeout=timeout))
+    return _run(c, plan, default_checkers(liveness_budget_s=45),
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=True)
+def device_fault_drain(seed, blocks=24, artifact_dir=None,
+                       workdir=None, metrics=None, timeout=90.0):
+    """A burst of device faults mid-sync: the verify pipeline must
+    drain the faulted windows through the host path without losing or
+    misordering a block, and the blocks/s across the burst is the
+    degradation metric bench.py reports."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer")
+    c.dial("syncer", "src0")
+    # armed before the first window dispatches (a 24-block sync is 1-2
+    # verify windows — any post-start step would fire after the fact)
+    plan = (Plan("device_fault_drain")
+            .setup("device_fault", node="syncer", windows=2,
+                   mode="drain")
+            .goal(["syncer"], blocks, timeout=timeout))
+    return _run(c, plan, default_checkers(liveness_budget_s=45),
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=True)
+def forged_commit_recovery(seed, blocks=24, artifact_dir=None,
+                           workdir=None, metrics=None, timeout=90.0):
+    """A byzantine server serves ONE forged LastCommit: the honest
+    verify path must reject it, evict the suppliers, and re-converge
+    on the truth from the redial — zero violations, full height."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_server("src1", blocks)
+    c.add_syncer("syncer")
+    c.dial("syncer", "src0")
+    c.dial("syncer", "src1")
+    plan = (Plan("forged_commit_recovery")
+            .setup("forged_commit_server", node="src0",
+                   height=max(2, blocks // 3), once=True)
+            .goal(["syncer"], blocks, timeout=timeout))
+    return _run(c, plan, default_checkers(liveness_budget_s=45),
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=True)
+def partition_devicefault_crash(seed, blocks=32, artifact_dir=None,
+                                workdir=None, metrics=None,
+                                timeout=120.0):
+    """The acceptance combo: device-fault burst mid-pipeline, then a
+    partition, a syncer crash INSIDE the partition, heal, restart.
+    The restarted node recovers its stores, replays the app through
+    the production Handshaker, redials, and finishes the sync — same
+    app hash as every honest node at the goal height."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_server("src1", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer")
+    c.dial("syncer", "src0")
+    c.dial("syncer", "src1")
+    plan = (Plan("partition_devicefault_crash")
+            .setup("device_fault", node="syncer", windows=2,
+                   mode="drain")
+            .when("syncer", max(3, blocks // 4), "partition",
+                  groups=[["src0", "src1"], ["syncer"]])
+            .at(0.2, "crash", node="syncer")
+            .at(0.2, "heal")
+            .at(0.1, "restart", node="syncer")
+            .now("redial")
+            .goal(["syncer"], blocks, timeout=timeout))
+    return _run(c, plan, default_checkers(liveness_budget_s=60),
+                artifact_dir, metrics)
+
+
+# -- live-consensus scenarios ------------------------------------------------
+
+@scenario(deterministic=False)
+def clock_skew_consensus(seed, target=4, artifact_dir=None,
+                         workdir=None, metrics=None, timeout=120.0):
+    """One validator's round clock runs 4x slow: the honest majority
+    keeps committing, the skewed node catches up via gossip, and
+    agreement/validity hold on every committed height."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.network.set_default_link(latency=0.001)
+    for i in range(4):
+        c.add_validator(f"val{i}", i, wal=False)
+    c.connect_all()
+    plan = (Plan("clock_skew_consensus", deterministic=False)
+            .now("clock_skew", node="val0", factor=4.0)
+            .goal([f"val{i}" for i in range(4)], target,
+                  timeout=timeout))
+    return _run(c, plan,
+                [Agreement(), CommitValidity(), HeightMonotonic()],
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=False)
+def crash_restart_validator(seed, target=6, artifact_dir=None,
+                            workdir=None, metrics=None, timeout=180.0):
+    """Crash a WAL-backed validator mid-run and restart it: the WAL
+    tail replays through catchup_replay, the FilePV last-sign state
+    prevents self-equivocation, the app re-handshakes, and the node
+    rejoins consensus to the goal height."""
+    c = ChaosCluster(seed, n_vals=4, workdir=workdir)
+    c.network.set_default_link(latency=0.001)
+    for i in range(4):
+        c.add_validator(f"val{i}", i, wal=workdir is not None)
+    c.connect_all()
+    plan = (Plan("crash_restart_validator", deterministic=False)
+            .when("val3", 2, "crash", node="val3")
+            .at(0.5, "restart", node="val3")
+            .goal([f"val{i}" for i in range(4)], target,
+                  timeout=timeout))
+    return _run(c, plan,
+                [Agreement(), CommitValidity(), HeightMonotonic()],
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=False, tier="slow")
+def byzantine_double_sign_evidence(seed, artifact_dir=None,
+                                   workdir=None, metrics=None,
+                                   timeout=600.0):
+    """A validator double-signs prevotes every height: honest nodes
+    convert the conflict to DuplicateVoteEvidence and a proposer
+    commits it — the goal holds open until the committed evidence is
+    observed (evidence-eventually-committed, positively)."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.network.set_default_link(latency=0.001)
+    for i in range(4):
+        c.add_validator(f"val{i}", i, wal=False)
+    c.connect_all()
+    plan = (Plan("byzantine_double_sign_evidence", deterministic=False)
+            .now("byzantine_double_sign", node="val0")
+            .goal([f"val{i}" for i in range(1, 4)], 3,
+                  timeout=timeout, require_evidence=True))
+    checkers = [Agreement(), CommitValidity(), HeightMonotonic(),
+                EvidenceCommitted()]
+    return _run(c, plan, checkers, artifact_dir, metrics)
+
+
+@scenario(deterministic=False, tier="slow")
+def amnesia_partition_soak(seed, target=6, artifact_dir=None,
+                           workdir=None, metrics=None, timeout=600.0):
+    """An amnesiac validator (forgets its POL lock every round) plus a
+    partition/heal cycle on jittered links: agreement must survive
+    the combination.  Sized for the 1-core CI box: a 3-of-4 quorum
+    keeps every validator load-bearing, so contention-driven round
+    escalation compounds — the generous timeout asserts safety +
+    eventual liveness, not speed."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.network.set_default_link(latency=0.001, jitter=0.001)
+    for i in range(4):
+        c.add_validator(f"val{i}", i, wal=False)
+    c.connect_all()
+    plan = (Plan("amnesia_partition_soak", deterministic=False)
+            .now("byzantine_amnesia", node="val1")
+            .when("val0", 2, "partition",
+                  groups=[["val0", "val1", "val2"], ["val3"]])
+            .at(1.0, "heal")
+            .goal([f"val{i}" for i in range(4)], target,
+                  timeout=timeout))
+    return _run(c, plan,
+                [Agreement(), CommitValidity(), HeightMonotonic(),
+                 BoundedLiveness(300.0)],
+                artifact_dir, metrics)
+
+
+# -- broken-on-purpose self-tests (the oracle must trip) ---------------------
+
+@scenario(deterministic=True, broken=True)
+def selftest_forge_drain_skip(seed, blocks=16, artifact_dir=None,
+                              workdir=None, metrics=None, timeout=60.0):
+    """BROKEN: a forged-commit server paired with a drain-SKIPPING
+    device-fault mode (windows resolve all-true without verification).
+    The commit-validity checker MUST report the stored forged commit;
+    zero violations here means the oracle is vacuous."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer")
+    c.dial("syncer", "src0")
+    # forge the TIP commit (block blocks+1's LastCommit, attesting
+    # `blocks`): the tip block is only ever consumed as the verifying
+    # `after` of a window — never collected as a window member — so
+    # the forged copy can't trip the part-set structural check against
+    # the NEXT honest commit and evict the liar before the planted bug
+    # lands.  once=False because request/redo timing can burn a single
+    # lie on a response the pool never consumes.
+    bad_h = blocks
+    plan = (Plan("selftest_forge_drain_skip")
+            .setup("forged_commit_server", node="src0", height=bad_h,
+                   once=False)
+            .setup("device_fault", node="syncer", windows=1 << 10,
+                   mode="forge")
+            .goal(["syncer"], bad_h, timeout=timeout))
+    return _run(c, plan,
+                [Agreement(), CommitValidity(), HeightMonotonic()],
+                artifact_dir, metrics)
+
+
+@scenario(deterministic=False, broken=True)
+def selftest_evidence_disabled(seed, target=4, artifact_dir=None,
+                               workdir=None, metrics=None,
+                               timeout=150.0):
+    """BROKEN: double-sign equivocation with every node's conflicting-
+    vote reporting disabled — evidence can never form, and the
+    evidence-eventually-committed checker MUST trip at scenario end."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.network.set_default_link(latency=0.001)
+    for i in range(4):
+        c.add_validator(f"val{i}", i, wal=False)
+    c.connect_all()
+    plan = (Plan("selftest_evidence_disabled", deterministic=False)
+            .now("disable_evidence")
+            .now("byzantine_double_sign", node="val0")
+            .goal([f"val{i}" for i in range(1, 4)], target,
+                  timeout=timeout))
+    checkers = [Agreement(), CommitValidity(), HeightMonotonic(),
+                EvidenceCommitted()]
+    return _run(c, plan, checkers, artifact_dir, metrics)
+
+
+# -- bench surfacing ---------------------------------------------------------
+
+def bench_chaos(seed: int = 29, blocks: int = 24) -> dict:
+    """The two chaos_* bench extras in one record: recovery time after
+    a partition heal (partition_heal scenario) and blocks/s across a
+    device-fault burst (device_fault_drain).  Deterministic scenarios,
+    zero expected violations — a violation fails the bench loudly
+    rather than shipping a number measured on a broken cluster."""
+    global last_chaos
+    r1 = partition_heal(seed, blocks=blocks)
+    r2 = device_fault_drain(seed + 1, blocks=blocks)
+    for r in (r1, r2):
+        if not r.ok:
+            raise RuntimeError(
+                f"chaos bench scenario {r.name!r} failed: "
+                f"violations={r.violations}")
+    last_chaos = {
+        "chaos_recovery_seconds": r1.timing.get("recovery_seconds"),
+        "chaos_faulted_blocks_per_sec":
+            r2.timing.get("faulted_blocks_per_sec"),
+        "partition_heal": r1.to_dict(),
+        "device_fault_drain": r2.to_dict(),
+    }
+    return last_chaos

@@ -27,6 +27,11 @@ class TimeoutTicker(BaseService):
         self._mtx = threading.Lock()
         self._pending: TimeoutInfo | None = None
         self._timer: threading.Timer | None = None
+        # clock-skew multiplier on every scheduled duration: 1.0 is an
+        # honest clock; >1 runs slow (timeouts fire late), <1 fast.
+        # The chaos clock-skew injector (cometbft_tpu/chaos) drives it;
+        # nothing else touches it.
+        self.skew = 1.0
 
     def schedule_timeout(self, ti: TimeoutInfo) -> None:
         """Replace any pending timeout with ti if ti is newer (or always
@@ -42,7 +47,8 @@ class TimeoutTicker(BaseService):
                 self._timer.cancel()
             self._pending = ti
             self._timer = threading.Timer(
-                max(ti.duration_ns, 0) / 1e9, self._fire, args=(ti,))
+                max(ti.duration_ns, 0) / 1e9 * max(self.skew, 0.0),
+                self._fire, args=(ti,))
             self._timer.daemon = True
             self._timer.start()
 

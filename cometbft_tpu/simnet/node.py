@@ -71,6 +71,18 @@ def make_sim_genesis(n_vals: int = 4, chain_id: str = "simnet-chain",
     return genesis, privs
 
 
+class _LocalAppConns:
+    """proxy.AppConns stand-in over one LocalClient: every connection
+    is the same in-proc client (one mutex already serializes access),
+    which is all the Handshaker needs (.query.info / .consensus)."""
+
+    def __init__(self, client):
+        self.consensus = client
+        self.mempool = client
+        self.query = client
+        self.snapshot = client
+
+
 class SimNode:
     """One in-process node on a SimNetwork.
 
@@ -82,40 +94,78 @@ class SimNode:
                   pre-built chains don't churn rounds against stale
                   state.  Blocksync hands off to consensus on catch-up
                   only when active.
+    dbs         — optional (state_db, block_db, evidence_db) MemDBs.
+                  Passing the same triple to a SECOND construction is
+                  the crash-restart path (cometbft_tpu/chaos): the
+                  stores resume where they were and the production
+                  Handshaker replays committed blocks into the fresh
+                  app until app and store agree — the same recovery a
+                  real node runs at startup (consensus/replay.py).
+    wal         — optional consensus WAL (consensus/wal.WAL); the
+                  chaos cluster gives validators one so crash-restart
+                  can catchup_replay the in-flight height.
+    priv_validator — an ed25519 PrivKey, or a prepared FilePV (the
+                  restart path reuses the SAME FilePV so last-sign
+                  state survives the crash, as the state file would).
     """
 
     def __init__(self, name: str, genesis: GenesisDoc,
                  network: SimNetwork, *, priv_validator=None,
                  block_sync: bool = False,
                  consensus_active: bool = False,
-                 seed: int = 0, app=None):
+                 seed: int = 0, app=None, dbs=None, wal=None):
         self.name = name
         self.genesis = genesis
         self.network = network
 
-        state = make_genesis_state(genesis)
-        self.state_store = StateStore(MemDB())
-        self.state_store.bootstrap(state)
-        self.block_store = BlockStore(MemDB())
+        if dbs is None:
+            dbs = (MemDB(), MemDB(), MemDB())
+        self.dbs = dbs
+        state_db, block_db, evidence_db = dbs
+        self.state_store = StateStore(state_db)
+        resumed = self.state_store.load()
+        if resumed is None:
+            state = make_genesis_state(genesis)
+            self.state_store.bootstrap(state)
+        else:
+            state = resumed
+        self.block_store = BlockStore(block_db)
 
         self.app = app if app is not None else KVStoreApplication()
         self.client = LocalClient(self.app)
-        self.client.init_chain(at.InitChainRequest(
-            chain_id=genesis.chain_id,
-            initial_height=state.initial_height))
+        if resumed is None:
+            self.client.init_chain(at.InitChainRequest(
+                chain_id=genesis.chain_id,
+                initial_height=state.initial_height))
+        else:
+            # crash-restart: the in-memory app came back empty while
+            # the stores kept their history — run the REAL recovery
+            # (ABCI handshake replays committed blocks until the app
+            # hash agrees with the state store, replay.go semantics)
+            from ..consensus.replay import Handshaker
+            Handshaker(self.state_store, state, self.block_store,
+                       genesis).handshake(_LocalAppConns(self.client))
+            state = self.state_store.load() or state
         self.mempool = CListMempool(self.client)
         self.event_bus = ev.EventBus()
-        self.evidence_pool = EvidencePool(MemDB(), self.state_store,
+        self.evidence_pool = EvidencePool(evidence_db, self.state_store,
                                           self.block_store)
         self.block_exec = BlockExecutor(
             self.state_store, self.client, self.mempool,
             evidence_pool=self.evidence_pool,
             block_store=self.block_store, event_bus=self.event_bus)
 
-        pv = FilePV(priv_validator) if priv_validator is not None else None
+        if priv_validator is None:
+            pv = None
+        elif isinstance(priv_validator, FilePV):
+            pv = priv_validator      # restart: keep last-sign state
+        else:
+            pv = FilePV(priv_validator)
+        self.priv_validator = pv
+        self.wal = wal
         self.consensus_state = ConsensusState(
             test_consensus_config(), state, self.block_exec,
-            self.block_store, priv_validator=pv,
+            self.block_store, wal=wal, priv_validator=pv,
             event_bus=self.event_bus, evidence_pool=self.evidence_pool,
             mempool=self.mempool)
         # per-node flight recorder (libs/flightrec.py): many nodes share

@@ -42,19 +42,35 @@ _CLOSED = object()          # inbox sentinel: EOF
 
 class LinkSpec:
     """Per-link conditioning: one-way latency (s), uniform jitter (s),
-    drop probability per frame."""
+    drop probability per frame, duplicate probability per frame, and
+    pairwise-reorder probability per frame.
 
-    __slots__ = ("latency", "jitter", "drop")
+    dup re-delivers a whole write() payload; reorder holds a frame in a
+    one-slot buffer and releases it AFTER the next frame from the same
+    sender (a bounded, seeded pairwise swap).  Both operate on whole
+    write() payloads: when a payload is a batch of complete packets of
+    complete messages, the receiver sees duplicate/reordered MESSAGES
+    and the protocol layers dedup (vote sets, block pool) — when a
+    large message spans several payloads, a dup/reorder corrupts its
+    reassembly, the MConnection errors out, and the peer is evicted,
+    which is exactly the byzantine-wire recovery path the chaos
+    scenarios exist to exercise."""
+
+    __slots__ = ("latency", "jitter", "drop", "dup", "reorder")
 
     def __init__(self, latency: float = 0.0, jitter: float = 0.0,
-                 drop: float = 0.0):
+                 drop: float = 0.0, dup: float = 0.0,
+                 reorder: float = 0.0):
         self.latency = latency
         self.jitter = jitter
         self.drop = drop
+        self.dup = dup
+        self.reorder = reorder
 
     @property
     def conditioned(self) -> bool:
-        return self.latency > 0 or self.jitter > 0 or self.drop > 0
+        return self.latency > 0 or self.jitter > 0 or self.drop > 0 \
+            or self.dup > 0 or self.reorder > 0
 
 
 class SimNetwork:
@@ -87,17 +103,19 @@ class SimNetwork:
 
     # -- link conditioning -------------------------------------------------
     def set_default_link(self, latency: float = 0.0, jitter: float = 0.0,
-                         drop: float = 0.0) -> None:
+                         drop: float = 0.0, dup: float = 0.0,
+                         reorder: float = 0.0) -> None:
         with self._mtx:
-            self._default = LinkSpec(latency, jitter, drop)
+            self._default = LinkSpec(latency, jitter, drop, dup, reorder)
 
     def set_link(self, a: str, b: str, latency: float = 0.0,
-                 jitter: float = 0.0, drop: float = 0.0) -> None:
+                 jitter: float = 0.0, drop: float = 0.0,
+                 dup: float = 0.0, reorder: float = 0.0) -> None:
         """Condition the (a, b) link; names may be bare hosts or
         'host:port' keys."""
         with self._mtx:
             self._links[self._pair(a, b)] = LinkSpec(latency, jitter,
-                                                     drop)
+                                                     drop, dup, reorder)
 
     @staticmethod
     def _norm(name: str) -> str:
@@ -187,7 +205,11 @@ class _Link:
             return                       # partitioned: blackholed
         spec = self.network.link_spec(src.local_key, src.remote_key)
         delay = 0.0
+        dup = reorder = False
         if spec.conditioned:
+            # every RNG draw is conditional only on the spec and on
+            # earlier outcomes of THIS send sequence, so the fault
+            # schedule stays a pure function of (seed, sends)
             with self._rng_mtx:
                 if spec.drop > 0 and self._rng.random() < spec.drop:
                     return               # dropped whole frame
@@ -195,13 +217,37 @@ class _Link:
                     delay = spec.latency + self._rng.random() * spec.jitter
                 else:
                     delay = spec.latency
-        src._peer._deliver(data, delay)
+                if spec.dup > 0:
+                    dup = self._rng.random() < spec.dup
+                if spec.reorder > 0:
+                    reorder = self._rng.random() < spec.reorder
+        # pairwise reorder: hold this frame, release it right after the
+        # NEXT frame from the same sender (one-slot buffer — bounded
+        # disorder; frames for one direction come from MConnection's
+        # single send routine, so the slot cannot race)
+        held = src._reorder_hold
+        src._reorder_hold = None
+        if reorder and held is None:
+            src._reorder_hold = (data, delay)
+            return
+        peer = src._peer
+        peer._deliver(data, delay)
+        if dup:
+            peer._deliver(data, delay)   # duplicated whole frame
+        if held is not None:
+            peer._deliver(held[0], held[1])
 
     def close(self) -> None:
         if self._closed.is_set():
             return
         self._closed.set()
         for end in (self.end_a, self.end_b):
+            # flush a held reordered frame ahead of EOF so close
+            # never silently converts a reorder into a drop
+            held = end._reorder_hold
+            end._reorder_hold = None
+            if held is not None and end._peer is not None:
+                end._peer._deliver(held[0], held[1])
             end._deliver(_CLOSED, 0.0)
 
 
@@ -220,6 +266,9 @@ class _SimConn:
         self._sched: queue.Queue = queue.Queue()
         self._pump_started = False
         self._pump_mtx = threading.Lock()
+        # one-slot (frame, delay) buffer for the link's pairwise
+        # reorder fault; written only from this endpoint's sender thread
+        self._reorder_hold: tuple | None = None
 
     # -- receiving side plumbing (called by the OTHER endpoint) -----------
     def _deliver(self, frame, delay: float) -> None:

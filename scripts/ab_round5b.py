@@ -13,6 +13,18 @@ the round-5 evidence — if 131071 wins, the unattended capture measures
 it; if it loses, the pick is unchanged.  relay_watch5.sh's done-marker
 grep still matches (records land after the existing "done" line).
 
+COLD-COMPILE RISK once 131071 steers the capture: the batch-131071
+program's first compile over the relay is the largest this repo has
+shipped (the 65535 shapes already measured >420 s cold), and bench.py
+budgets the whole lock-to-headline stretch with
+BENCH_HEADLINE_ALLOWANCE (default 900 s).  A cold cache + a slow relay
+day can eat most of that on the compile alone, tripping the
+pre-headline watchdog into the carry fallback even though the relay is
+healthy.  Mitigations: this script warms the persistent compilation
+cache (jax_compilation_cache_dir above) for the exact steered shape,
+and operators can raise BENCH_HEADLINE_ALLOWANCE for the first capture
+after a steering flip.
+
 Usage:  env PYTHONPATH=/root/repo:/root/.axon_site \
             flock /tmp/tpu.lock python scripts/ab_round5b.py [results.jsonl]
 """

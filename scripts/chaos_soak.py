@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Chaos soak runner: compose named nemesis scenarios from one seed,
+write the deterministic per-scenario fingerprint jsonl, and report
+recovery metrics (docs/CHAOS.md).
+
+Everything a failure needs to reproduce is (scenario name, seed):
+
+    python scripts/chaos_soak.py --seed 7                  # fast catalog
+    python scripts/chaos_soak.py --seed 7 --scenarios partition_heal
+    python scripts/chaos_soak.py --seed 7 --all            # + slow tier
+    python scripts/chaos_soak.py --seed 7 --self-test      # broken injectors
+    python scripts/chaos_soak.py --seed 7 --check-determinism
+
+The jsonl output holds ONLY seed-reproducible fields (schedule, final
+heights, app hashes, goal block hash, violation count) — two runs of
+the same seed must produce byte-identical lines for deterministic
+scenarios, which --check-determinism verifies by running each twice.
+Timing (wall seconds, recovery seconds, faulted blocks/s) prints to
+the summary instead, because wall clocks are not part of the seed.
+
+Exit code: 0 when every non-broken scenario is clean AND every broken
+(self-test) scenario tripped its checker; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, required=True,
+                    help="base seed; scenario i runs at seed+i")
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated names (default: fast tier)")
+    ap.add_argument("--all", action="store_true",
+                    help="include slow-tier scenarios")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the broken-injector scenarios (violations "
+                         "EXPECTED — proves the oracle isn't vacuous)")
+    ap.add_argument("--check-determinism", action="store_true",
+                    help="run each deterministic scenario twice and "
+                         "compare fingerprints")
+    ap.add_argument("--out", default=None,
+                    help="fingerprint jsonl path (default: "
+                         "chaos_soak_seed<seed>.jsonl in CWD)")
+    ap.add_argument("--artifact-dir", default=None,
+                    help="violation artifact directory (default: a "
+                         "fresh temp dir)")
+    args = ap.parse_args()
+
+    # import late so --help works without the package on path
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import logging
+    logging.basicConfig(level=logging.ERROR)
+    from cometbft_tpu.chaos.scenarios import SCENARIOS, run_scenario
+
+    if args.scenarios:
+        names = [n.strip() for n in args.scenarios.split(",") if n.strip()]
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            print(f"unknown scenarios: {unknown}; catalog: "
+                  f"{sorted(SCENARIOS)}", file=sys.stderr)
+            return 2
+    else:
+        names = [n for n, meta in sorted(SCENARIOS.items())
+                 if meta["broken"] == args.self_test
+                 and (args.all or meta["tier"] == "fast")]
+
+    artifact_dir = args.artifact_dir or tempfile.mkdtemp(
+        prefix="chaos_artifacts_")
+    workdir = tempfile.mkdtemp(prefix="chaos_wal_")
+    out_path = args.out or f"chaos_soak_seed{args.seed}.jsonl"
+
+    rows = []
+    summary = []
+    failed = False
+    for i, name in enumerate(names):
+        meta = SCENARIOS[name]
+        seed = args.seed + i
+        runs = 2 if (args.check_determinism and meta["deterministic"]) \
+            else 1
+        fingerprints = []
+        result = None
+        for _ in range(runs):
+            result = run_scenario(name, seed=seed,
+                                  artifact_dir=artifact_dir,
+                                  workdir=workdir)
+            fingerprints.append(json.dumps(result.fingerprint,
+                                           sort_keys=True))
+        replay_ok = len(set(fingerprints)) == 1
+        rows.append(fingerprints[-1])
+        tripped = bool(result.violations)
+        ok = bool(tripped and result.artifacts) if meta["broken"] \
+            else (result.ok and replay_ok)
+        failed |= not ok
+        summary.append({
+            "scenario": name, "seed": seed, "ok": ok,
+            "broken_expected_violation": meta["broken"],
+            "violations": len(result.violations),
+            "replay_identical": replay_ok if runs == 2 else None,
+            "timing": result.timing,
+            "artifacts": result.artifacts,
+        })
+        print(f"[{'OK' if ok else 'FAIL'}] {name} seed={seed} "
+              f"violations={len(result.violations)} "
+              f"timing={result.timing}", file=sys.stderr)
+
+    with open(out_path, "w") as f:
+        for row in rows:
+            f.write(row + "\n")
+
+    print(json.dumps({
+        "seed": args.seed, "scenarios": summary, "fingerprints": out_path,
+        "artifact_dir": artifact_dir,
+        "chaos_recovery_seconds": next(
+            (s["timing"].get("recovery_seconds") for s in summary
+             if s["timing"].get("recovery_seconds") is not None), None),
+        "chaos_faulted_blocks_per_sec": next(
+            (s["timing"].get("faulted_blocks_per_sec") for s in summary
+             if s["timing"].get("faulted_blocks_per_sec") is not None),
+            None),
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
