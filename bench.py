@@ -221,6 +221,83 @@ def bench_secp(batch: int, iters: int) -> float:
     return batch / dt
 
 
+def bench_mixed(n_ed: int = 9000, n_secp: int = 1000) -> float:
+    """Mixed-keytype commit verify (VERDICT item 5): one 10k-power
+    commit whose validator set mixes ed25519 and secp256k1 keys, routed
+    through crypto/batch.MixedBatchVerifier — the per-type sub-batches
+    dispatch concurrently (ed25519 RLC + secp Straus kernels are
+    independent device programs).  The reference refuses mixed batches
+    outright (types/validation.go:18); this is the measured rate for
+    accepting them."""
+    from cometbft_tpu.crypto import batch as cb
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.crypto import secp256k1 as sk
+
+    ed_keys = [ref.keygen(bytes([i + 1]) * 32) for i in range(64)]
+    sk_keys = [sk.PrivKey.generate(bytes([i & 0xFF, i >> 8] + [7] * 30))
+               for i in range(64)]
+    items = []
+    for i in range(n_ed):
+        seed, pub = ed_keys[i % len(ed_keys)]
+        msg = b"mixed-commit-" + i.to_bytes(8, "little") * 4
+        items.append((ed.PubKey(pub), msg, ref.sign(seed, msg)))
+    for i in range(n_secp):
+        p = sk_keys[i % len(sk_keys)]
+        msg = b"mixed-commit-" + (n_ed + i).to_bytes(8, "little") * 4
+        items.append((p.pub_key(), msg, p.sign(msg)))
+
+    def run_once() -> float:
+        v = cb.MixedBatchVerifier()
+        for pk, msg, sig in items:
+            v.add(pk, msg, sig)
+        t0 = time.perf_counter()
+        ok, verdicts = v.verify()
+        dt = time.perf_counter() - t0
+        assert ok and all(verdicts), "mixed commit verify failed"
+        return dt
+
+    run_once()                       # warm both kernels
+    dt = min(run_once() for _ in range(2))
+    return (n_ed + n_secp) / dt
+
+
+def bench_multichip(n: int | None = None) -> dict:
+    """Mesh-sharded verify scaling on the 8-virtual-device CPU mesh
+    (crypto/mesh.bench_cpu_mesh): sharded-vs-unsharded verdict parity
+    plus scaling-efficiency numbers.  The bench main process is bound
+    to the real TPU backend by sitecustomize, so the CPU-mesh work
+    re-execs in a subprocess with JAX_PLATFORMS=cpu and the
+    virtual-device XLA flag set before the interpreter starts (same
+    pattern as __graft_entry__.dryrun_multichip); the real-chip arm
+    rides the relay ledger."""
+    import json as _json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/cometbft_tpu_jax_cache")
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    if n is not None:
+        env["COMETBFT_TPU_MESH_BENCH_N"] = str(n)
+    # below the extras' 600 s SIGALRM so a slow child is killed by
+    # subprocess.run (TimeoutExpired) instead of leaking past an alarm
+    res = subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu.crypto.mesh"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=580)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"multichip bench subprocess failed (rc={res.returncode}): "
+            f"{(res.stderr or res.stdout).strip()[-500:]}")
+    return _json.loads(res.stdout.splitlines()[-1])
+
+
 def bench_blocksync_e2e() -> dict:
     """Reactor-level end-to-end (VERDICT missing #3): blocks through
     the REAL blocksync/reactor.py -> DeferredSigBatch device verify ->
@@ -718,6 +795,9 @@ def main() -> None:
         ("light_e2e_headers_per_sec", "light_e2e_config"),
         ("chaos_recovery_seconds", "chaos_config"),
         ("chaos_faulted_blocks_per_sec", None),
+        ("mixed_commit_sigs_per_sec", "mixed_commit_config"),
+        ("multichip_sharded_sigs_per_sec", "multichip_config"),
+        ("multichip_scaling_efficiency", None),
     )
     # per-key provenance so CHAINED carries don't launder staleness
     # (review finding): a key already carried/merged in the previous
@@ -1010,6 +1090,49 @@ def main() -> None:
                                             "device_fault_drain")}
         _sync_carried()
         persist()
+
+    # mixed-keytype commit (VERDICT item 5): the per-type sub-batches
+    # reuse kernels already warmed by the ed25519/secp extras above
+    run_extra("mixed_commit_sigs_per_sec",
+              lambda: round(bench_mixed(9000, 1000), 1),
+              "mixed_commit_config",
+              "10k-power mixed commit: 9000 ed25519 + 1000 secp256k1"
+              " through MixedBatchVerifier, per-type sub-batches"
+              " dispatched concurrently (reference refuses mixed"
+              " batches outright)")
+    # mesh-sharded verify scaling (tentpole): runs on the CPU-forced
+    # 8-virtual-device mesh in a subprocess — no TPU relay time; the
+    # real-chip scaling arm rides the relay ledger (docs/PERF.md
+    # Multi-chip).  Parity (sharded vs unsharded verdict bitmaps
+    # byte-identical) is asserted inside the child.
+    _multichip = {"last": None}
+
+    def _bench_multichip_extra():
+        r = bench_multichip()
+        if not r.get("multichip_parity"):
+            raise RuntimeError("sharded/unsharded verdict mismatch")
+        _multichip["last"] = r
+        return round(r["multichip_sharded_sigs_per_sec"], 1)
+
+    run_extra("multichip_sharded_sigs_per_sec",
+              _bench_multichip_extra,
+              "multichip_config",
+              "8-virtual-device CPU mesh (subprocess,"
+              " xla_force_host_platform_device_count): batch-axis"
+              " sharded verdict kernel, sharded-vs-unsharded parity"
+              " asserted; detail carries split-RLC and unsharded arms")
+    _attach_e2e_detail("multichip_sharded_sigs_per_sec",
+                       "multichip_detail", _multichip["last"])
+    if ("multichip_sharded_sigs_per_sec" not in carried_keys
+            and isinstance(extra.get("multichip_sharded_sigs_per_sec"),
+                           (int, float))
+            and isinstance(_multichip["last"], dict)):
+        eff = _multichip["last"].get("multichip_scaling_efficiency")
+        if isinstance(eff, (int, float)):
+            extra["multichip_scaling_efficiency"] = eff
+            carried_keys.discard("multichip_scaling_efficiency")
+            _sync_carried()
+            persist()
 
     # -- deepening tier: strictly-better configs measured by the r4b
     # sweeps; a wedge here can only cost the upgrades, never a metric

@@ -36,6 +36,12 @@ SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 # 1 = the serial path; 2 = double buffering (the default)
 PIPELINE_DEPTH = int(os.environ.get(
     "COMETBFT_TPU_BLOCKSYNC_PIPELINE", "2"))
+# mesh round-robin for the verify pipeline: windows rotate over this
+# many devices (ops/sharding.mesh_device_list semantics — 0 defers to
+# COMETBFT_TPU_MESH_DEVICES, which is off unless set; -1/0-via-env
+# means all local devices)
+MESH_DEVICES = int(os.environ.get(
+    "COMETBFT_TPU_BLOCKSYNC_MESH_DEVICES", "0"))
 
 
 class BlocksyncReactor(Reactor):
@@ -55,6 +61,7 @@ class BlocksyncReactor(Reactor):
         self.synced = not block_sync
         self.metrics = None        # BlockSyncMetrics when the node meters
         self.pipeline_depth = PIPELINE_DEPTH
+        self.mesh_devices = MESH_DEVICES
         self._pipeline = None      # crypto/dispatch.VerifyPipeline
 
     def get_channels(self) -> list:
@@ -81,8 +88,14 @@ class BlocksyncReactor(Reactor):
     def _get_pipeline(self):
         if self._pipeline is None or not self._pipeline.is_running():
             from ..crypto.dispatch import VerifyPipeline
+            from ..ops import sharding
+            devices = sharding.mesh_device_list(self.mesh_devices
+                                                or None)
+            depth = self.pipeline_depth if devices is None else \
+                max(self.pipeline_depth, 2 * len(devices))
             self._pipeline = VerifyPipeline(
-                depth=self.pipeline_depth, name="blocksync-pipeline")
+                depth=depth, name="blocksync-pipeline",
+                devices=devices if devices is not None else ())
             self._pipeline.start()
         return self._pipeline
 
@@ -430,8 +443,11 @@ class BlocksyncReactor(Reactor):
         # broadcasts and switch-to-consensus checks keep their cadence;
         # past the deadline the fill stops and in-flight drains
         deadline = time.monotonic() + SWITCH_TO_CONSENSUS_INTERVAL
+        # pipe.depth >= pipeline_depth: a mesh pipeline raises its
+        # depth to keep every device's rotation slot fed
+        fill_depth = max(self.pipeline_depth, pipe.depth)
         while True:
-            while len(inflight) < self.pipeline_depth \
+            while len(inflight) < fill_depth \
                     and not self._stop_sync.is_set() \
                     and time.monotonic() < deadline:
                 rec = self._collect_ahead(offset)

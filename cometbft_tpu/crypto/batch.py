@@ -87,24 +87,40 @@ class TpuEd25519BatchVerifier(_SigCollector):
 _NO_PACK = object()
 
 
-def _device_verify(pubkeys: list[bytes], parsed,
-                   packed=_NO_PACK) -> tuple[bool, list[bool]]:
+def _device_verify(pubkeys: list[bytes], parsed, packed=_NO_PACK,
+                   device=None) -> tuple[bool, list[bool]]:
     """Shared device dispatch for any Edwards-domain batch: RLC fast
     path first, per-signature kernel for verdict localization on
     failure — the reference's verifyCommitBatch -> verifyCommitSingle
     pattern (/root/reference/types/validation.go:115).  `packed`
     accepts a pack_rlc result computed ahead of time (the overlapped
-    pipeline packs window N+1 while window N is on device)."""
+    pipeline packs window N+1 while window N is on device).
+
+    `device` commits the dispatch to one specific mesh device (the
+    pipeline's round-robin placement, crypto/dispatch.py); with
+    device=None and a configured mesh, a large window instead SPLITS
+    across every device — one RLC program per chip
+    (crypto/mesh.maybe_split_verify), falling back to the
+    batch-axis-sharded per-signature kernel for localization."""
     import numpy as np
 
     from ..ops import ed25519 as dev
+    from ..ops import sharding
 
     n = len(pubkeys)
     if n >= 2:
-        if packed is _NO_PACK:
-            packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n,
-                                 parsed=parsed)
-        if packed is not None and ed.rlc_verify(packed):
+        rlc_ok = None
+        if packed is _NO_PACK and device is None:
+            from . import mesh
+
+            rlc_ok = mesh.maybe_split_verify(pubkeys, parsed)
+        if rlc_ok is None:
+            if packed is _NO_PACK:
+                packed = ed.pack_rlc(pubkeys, [b""] * n, [b""] * n,
+                                     parsed=parsed)
+            rlc_ok = packed is not None and \
+                ed.rlc_verify(packed, device=device)
+        if rlc_ok:
             return True, [True] * n
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
@@ -113,11 +129,19 @@ def _device_verify(pubkeys: list[bytes], parsed,
         if dm is not None:
             dm.rlc_fallbacks.inc()
         flightrec.record(flightrec.EV_RLC_FALLBACK, batch=n)
-    bucket = dev.bucket_size(n)
-    a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
-                                      bucket, parsed=parsed)
-    from ..ops import sharding
-    verdict = np.asarray(sharding.verify_batch_sharded(a, r, s, h))
+    if device is not None:
+        import jax
+
+        bucket = dev.bucket_size(n)
+        a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
+                                          bucket, parsed=parsed)
+        a, r, s, h = (jax.device_put(x, device) for x in (a, r, s, h))
+        verdict = np.asarray(dev.verify_batch_device(a, r, s, h))
+    else:
+        bucket = sharding.auto_bucket(n)
+        a, r, s, h, valid = ed.pack_batch(pubkeys, [b""] * n, [b""] * n,
+                                          bucket, parsed=parsed)
+        verdict = np.asarray(sharding.verify_batch_sharded(a, r, s, h))
     verdict = verdict & valid
     out = verdict[:n].tolist()
     return all(out) and bool(out), out

@@ -146,7 +146,8 @@ class WindowHandle:
 
 class _Window:
     __slots__ = ("items", "handle", "threshold", "mode", "pks",
-                 "parsed", "packed", "verifier", "staged", "device_s")
+                 "parsed", "packed", "verifier", "staged", "device_s",
+                 "device_index", "dispatching", "result")
 
     def __init__(self, items, handle, threshold):
         self.items = items
@@ -159,6 +160,12 @@ class _Window:
         self.verifier = None
         self.staged = False
         self.device_s = 0.0
+        # mesh round-robin state (devices=... pipelines): the assigned
+        # device slot, whether its device thread picked it up, and the
+        # computed (ok, verdicts, path) awaiting in-order publication
+        self.device_index = 0
+        self.dispatching = False
+        self.result = None
 
 
 class VerifyPipeline(BaseService):
@@ -166,7 +173,8 @@ class VerifyPipeline(BaseService):
 
     def __init__(self, depth: int = DEFAULT_DEPTH,
                  host_workers: int | None = None,
-                 dispatch_fn=None, name: str = "VerifyPipeline"):
+                 dispatch_fn=None, name: str = "VerifyPipeline",
+                 devices=None):
         super().__init__(name)
         self.depth = max(1, depth)
         self.host_workers = (host_workers if host_workers is not None
@@ -175,14 +183,33 @@ class VerifyPipeline(BaseService):
         # the _Window, returns (ok, verdicts) or raises (exercising the
         # drain path exactly as a real device failure would)
         self._dispatch_fn = dispatch_fn
+        # mesh round-robin: with >1 devices, windows are assigned
+        # submission-index % n_devices, each device runs its own
+        # dispatch thread, and verdicts still PUBLISH in submission
+        # order (the blocksync/light ordering contract).  None defers
+        # to the COMETBFT_TPU_MESH_DEVICES knob (off unless set); pass
+        # an empty tuple to force single-device.  Callers should size
+        # depth >= 2 * n_devices or the backpressure window starves
+        # the rotation.
+        if devices is None:
+            try:
+                from ..ops import sharding as _sharding
+
+                devices = _sharding.mesh_device_list(None)
+            except Exception:
+                devices = None
+        self.devices = list(devices) if devices is not None \
+            and len(devices) > 1 else None
         self._cv = threading.Condition()
         self._windows: list[_Window] = []
         self._slots = threading.BoundedSemaphore(self.depth)
         self._pool: ThreadPoolExecutor | None = None
         self._staging: threading.Thread | None = None
         self._device: threading.Thread | None = None
+        self._dev_threads: list[threading.Thread] = []
         self._stopping = False
         self._faulted = False      # draining after a device error
+        self._dev_faulted: set[int] = set()   # per-device drain (mesh)
         # stats (tests + bench introspection)
         self.submitted = 0
         self.resolved = 0
@@ -201,17 +228,26 @@ class VerifyPipeline(BaseService):
         self._staging = threading.Thread(
             target=self._staging_loop, name=f"{self._name}-staging",
             daemon=True)
-        self._device = threading.Thread(
-            target=self._device_loop, name=f"{self._name}-device",
-            daemon=True)
         self._staging.start()
-        self._device.start()
+        if self.devices is not None:
+            self._dev_threads = [
+                threading.Thread(
+                    target=self._mesh_device_loop, args=(i,),
+                    name=f"{self._name}-device-{i}", daemon=True)
+                for i in range(len(self.devices))]
+            for th in self._dev_threads:
+                th.start()
+        else:
+            self._device = threading.Thread(
+                target=self._device_loop, name=f"{self._name}-device",
+                daemon=True)
+            self._device.start()
 
     def on_stop(self) -> None:
         with self._cv:
             self._stopping = True
             self._cv.notify_all()
-        for th in (self._staging, self._device):
+        for th in (self._staging, self._device, *self._dev_threads):
             if th is not None:
                 th.join(timeout=5)
         if self._pool is not None:
@@ -259,8 +295,16 @@ class VerifyPipeline(BaseService):
             with self._cv:
                 n = len(self._windows)
                 s = sum(1 for w in self._windows if w.staged)
+                per_dev = None
+                if self.devices is not None:
+                    per_dev = [0] * len(self.devices)
+                    for w in self._windows:
+                        per_dev[w.device_index] += 1
             dm.pipeline_inflight.set(n)
             dm.pipeline_staged.set(s)
+            if per_dev is not None:
+                for i, c in enumerate(per_dev):
+                    dm.pipeline_device_inflight.labels(str(i)).set(c)
 
     # -- API ---------------------------------------------------------------
 
@@ -289,6 +333,8 @@ class VerifyPipeline(BaseService):
         self._slots.acquire()
         win = _Window(items, handle, device_threshold)
         with self._cv:
+            if self.devices is not None:
+                win.device_index = self.submitted % len(self.devices)
             self._windows.append(win)
             self.submitted += 1
             self._cv.notify_all()
@@ -408,58 +454,139 @@ class VerifyPipeline(BaseService):
             self._slots.release()
             self._gauge()
 
-    def _resolve_window(self, win: _Window) -> None:
+    def _compute_verdicts(self, win: _Window, faulted: bool,
+                          device=None, device_index=None):
+        """The path decision + verdict computation shared by the
+        single-device loop and the per-device mesh loops; returns
+        (ok, verdicts, path)."""
+        if faulted and win.mode in ("ed", "mixed"):
+            # draining after a device fault: everything staged
+            # behind the faulted window resolves on the host
+            ok, verdicts = self._host_fallback(win)
+            self.drained_windows += 1
+            return ok, verdicts, "drain"
+        if win.mode == "host":
+            ok, verdicts = self._host_fallback(win)
+            self.host_windows += 1
+            return ok, verdicts, "host"
+        try:
+            ok, verdicts = self._device_dispatch(win, device=device)
+            self.device_windows += 1
+            return ok, verdicts, "device"
+        except Exception as e:
+            # device trouble mid-pipeline: drain.  The host
+            # path is still correct; the operator must see
+            # the fault and the drain in the timeline.
+            self._fault(e, win, device_index=device_index)
+            ok, verdicts = self._host_fallback(win)
+            self.drained_windows += 1
+            return ok, verdicts, "drain"
+
+    def _record_flush(self, win: _Window, path: str, t0: float) -> None:
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
-        from ..libs import trace as libtrace
 
         dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.flushes.labels(path).inc()
+            dm.batch_size.labels(path).observe(len(win.items))
+            dm.flush_latency_seconds.observe(time.monotonic() - t0)
+            if self.devices is not None and path == "device":
+                dm.mesh_dispatches.labels(
+                    str(win.device_index)).inc()
+        flightrec.record(
+            flightrec.EV_VERIFY_FLUSH, path=path,
+            batch=len(win.items),
+            subsystem=win.handle.subsystem,
+            inflight=len(self._windows), staged=self.staged)
+
+    def _resolve_window(self, win: _Window) -> None:
+        from ..libs import trace as libtrace
+
         t0 = time.monotonic()
         path = "host"
-        ok, verdicts = False, None
         try:
             with libtrace.span(win.handle.subsystem, "device",
                                inflight=len(self._windows)):
-                if self._faulted and win.mode in ("ed", "mixed"):
-                    # draining after a device fault: everything staged
-                    # behind the faulted window resolves on the host
-                    ok, verdicts = self._host_fallback(win)
-                    path = "drain"
-                    self.drained_windows += 1
-                elif win.mode == "host":
-                    ok, verdicts = self._host_fallback(win)
-                    self.host_windows += 1
-                else:
-                    try:
-                        ok, verdicts = self._device_dispatch(win)
-                        path = "device"
-                        self.device_windows += 1
-                    except Exception as e:
-                        # device trouble mid-pipeline: drain.  The host
-                        # path is still correct; the operator must see
-                        # the fault and the drain in the timeline.
-                        self._fault(e, win)
-                        ok, verdicts = self._host_fallback(win)
-                        path = "drain"
-                        self.drained_windows += 1
+                ok, verdicts, path = self._compute_verdicts(
+                    win, self._faulted)
             win.device_s = time.monotonic() - t0
             win.handle._resolve(ok, verdicts, path)
         except BaseException as e:  # pragma: no cover - defensive
             win.handle._fail(e)
             path = "error"
         finally:
-            if dm is not None:
-                dm.flushes.labels(path).inc()
-                dm.batch_size.labels(path).observe(len(win.items))
-                dm.flush_latency_seconds.observe(
-                    time.monotonic() - t0)
-            flightrec.record(
-                flightrec.EV_VERIFY_FLUSH, path=path,
-                batch=len(win.items),
-                subsystem=win.handle.subsystem,
-                inflight=len(self._windows), staged=self.staged)
+            self._record_flush(win, path, t0)
 
-    def _device_dispatch(self, win: _Window):
+    # -- mesh round-robin (one dispatch thread per device) ---------------
+
+    def _next_for_device(self, idx: int) -> _Window | None:
+        for w in self._windows:
+            if w.device_index == idx and w.staged \
+                    and not w.dispatching:
+                return w
+        return None
+
+    def _mesh_device_loop(self, idx: int) -> None:
+        from ..libs import trace as libtrace
+
+        while True:
+            with self._cv:
+                while True:
+                    win = self._next_for_device(idx)
+                    if win is not None:
+                        win.dispatching = True
+                        break
+                    if self._stopping and not any(
+                            w.device_index == idx and w.result is None
+                            for w in self._windows):
+                        return
+                    self._cv.wait(timeout=0.05)
+                faulted = idx in self._dev_faulted
+            t0 = time.monotonic()
+            path = "host"
+            try:
+                with libtrace.span(win.handle.subsystem, "device",
+                                   inflight=len(self._windows),
+                                   device=idx):
+                    ok, verdicts, path = self._compute_verdicts(
+                        win, faulted, device=self.devices[idx],
+                        device_index=idx)
+                win.device_s = time.monotonic() - t0
+                win.result = (ok, verdicts, path)
+            except BaseException as e:  # pragma: no cover - defensive
+                win.result = (None, e, "error")
+                path = "error"
+            self._record_flush(win, path, t0)
+            self._publish_resolved(idx)
+
+    def _publish_resolved(self, idx: int) -> None:
+        """Pop and resolve every computed window at the queue head —
+        verdicts PUBLISH in submission order no matter which device
+        finished first."""
+        done: list[_Window] = []
+        with self._cv:
+            while self._windows and self._windows[0].result is not None:
+                done.append(self._windows.pop(0))
+                self.resolved += 1
+            if idx in self._dev_faulted and not any(
+                    w.device_index == idx for w in self._windows):
+                # this device's queue drained: device dispatch resumes
+                # for its subsequent windows
+                self._dev_faulted.discard(idx)
+            if done:
+                self._cv.notify_all()
+        for w in done:
+            ok, verdicts, path = w.result
+            if path == "error":  # pragma: no cover - defensive
+                w.handle._fail(verdicts)
+            else:
+                w.handle._resolve(ok, verdicts, path)
+            self._slots.release()
+        if done:
+            self._gauge()
+
+    def _device_dispatch(self, win: _Window, device=None):
         if self._dispatch_fn is not None:
             return self._dispatch_fn(win)
         if win.mode == "mixed":
@@ -467,23 +594,33 @@ class VerifyPipeline(BaseService):
         from . import batch as cb
 
         return cb._device_verify(win.pks, win.parsed,
-                                 packed=win.packed)
+                                 packed=win.packed, device=device)
 
     def _host_fallback(self, win: _Window):
         verdicts = [_verify_one(pk, m, s) for pk, m, s in win.items]
         return all(verdicts) and bool(verdicts), verdicts
 
-    def _fault(self, exc: Exception, win: _Window) -> None:
+    def _fault(self, exc: Exception, win: _Window,
+               device_index: int | None = None) -> None:
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
 
         with self._cv:
-            self._faulted = True
+            if device_index is None:
+                self._faulted = True
+            else:
+                # mesh mode: only THIS device drains — windows
+                # round-robined onto the other devices keep
+                # dispatching (per-device fault isolation)
+                self._dev_faulted.add(device_index)
             self.faults += 1
             staged_behind = sum(1 for w in self._windows if w.staged)
         dm = libmetrics.device_metrics()
         if dm is not None:
             dm.pipeline_drains.inc()
+            if device_index is not None:
+                dm.pipeline_device_drains.labels(
+                    str(device_index)).inc()
         rec = flightrec.recorder()
         flightrec.record(flightrec.EV_DEVICE_FALLBACK,
                          batch=len(win.items),
@@ -492,6 +629,7 @@ class VerifyPipeline(BaseService):
                          batch=len(win.items),
                          inflight=len(self._windows),
                          staged=staged_behind,
+                         device=device_index,
                          error=type(exc).__name__)
         if rec is not None:
             rec.dump_to_log(

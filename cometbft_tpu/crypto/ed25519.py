@@ -391,12 +391,17 @@ class ATableCache:
         if dm is not None:
             dm.a_table_cache_bytes.set(self._bytes)
 
-    def get(self, a_words: np.ndarray):
-        """(8, K) packed encodings -> (device table, device ok-flag)."""
+    def get(self, a_words: np.ndarray, device=None):
+        """(8, K) packed encodings -> (device table, device ok-flag).
+
+        `device` places the built table on a specific mesh device (and
+        keys the entry by it): each chip in a round-robin dispatch
+        keeps its own resident copy of a hot valset's tables, so a
+        window dispatched to chip i never pulls a table across ICI."""
         from ..libs import metrics as libmetrics
 
         dm = libmetrics.device_metrics()
-        key = a_words.tobytes()
+        key = (a_words.tobytes(), device)
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
@@ -406,6 +411,11 @@ class ATableCache:
                 return self._entries[key][0]
         from ..ops import ed25519 as dev
 
+        if device is not None:
+            import jax
+
+            a_words = jax.device_put(np.ascontiguousarray(a_words),
+                                     device)
         entry = dev.build_a_tables_device(a_words)
         nbytes = self._entry_bytes(entry)
         with self._lock:
@@ -439,7 +449,7 @@ class ATableCache:
     # consensus vote flushes — stay on the fused kernel.
     MIN_K = int(os.environ.get("COMETBFT_TPU_A_CACHE_MIN_K", "64"))
 
-    def get_if_worthwhile(self, a_words: np.ndarray):
+    def get_if_worthwhile(self, a_words: np.ndarray, device=None):
         """Entry if cached; else None — and only SECOND sightings of a
         large-K key trigger a build.  One-shot batches (streaming vote
         flushes have nondeterministic signer subsets/order, so nearly
@@ -455,18 +465,18 @@ class ATableCache:
         # EVERY sighting and still pay the split-dispatch overhead
         if a_words.shape[-1] * BYTES_PER_A_SLOT > self._max_bytes:
             return None
-        key = a_words.tobytes()
+        key = (a_words.tobytes(), device)
         with self._lock:
             if key in self._entries:
                 pass                       # hit: fall through to get()
             else:
-                digest = hashlib.sha256(key).digest()
+                digest = (hashlib.sha256(key[0]).digest(), device)
                 if digest not in self._seen:
                     self._seen[digest] = True
                     while len(self._seen) > 64:
                         self._seen.popitem(last=False)
                     return None            # first sighting: stay fused
-        return self.get(a_words)
+        return self.get(a_words, device=device)
 
 
 _A_TABLE_CACHE = ATableCache(
@@ -475,26 +485,49 @@ _A_TABLE_CACHE = ATableCache(
 USE_A_CACHE = os.environ.get("COMETBFT_TPU_A_CACHE", "1") == "1"
 
 
-def rlc_verify(packed, use_cache: bool | None = None) -> bool:
+def rlc_verify_async(packed, use_cache: bool | None = None,
+                     device=None):
+    """rlc_verify without the host sync: returns the (device-resident)
+    verdict bit array so a caller splitting one window across a mesh
+    (crypto/mesh.split_rlc_verify) can dispatch every chip's RLC
+    program before blocking on any of them.
+
+    `device` commits the packed arrays (and the cached A-table, keyed
+    per device) to that device before dispatch, which is how jit
+    placement works: the program runs where its committed inputs live.
+    None keeps the default-device behavior byte-identical."""
+    from ..ops import ed25519 as dev
+
+    a_words, r_words, a_mag, a_neg, r_mag, r_neg = packed
+    a_np = np.asarray(a_words)
+    entry = None
+    if use_cache is True:
+        entry = _A_TABLE_CACHE.get(a_np, device=device)
+    elif use_cache is None and USE_A_CACHE:
+        entry = _A_TABLE_CACHE.get_if_worthwhile(a_np, device=device)
+    if device is not None:
+        import jax
+
+        r_words, a_mag, a_neg, r_mag, r_neg = (
+            jax.device_put(np.asarray(x), device)
+            for x in (r_words, a_mag, a_neg, r_mag, r_neg))
+        if entry is None:
+            a_words = jax.device_put(a_np, device)
+    if entry is not None:
+        a_tab, a_ok = entry
+        return dev.rlc_verify_device_cached_a(
+            a_tab, a_ok, r_words, a_mag, a_neg, r_mag, r_neg)
+    return dev.rlc_verify_device(a_words, r_words,
+                                 a_mag, a_neg, r_mag, r_neg)
+
+
+def rlc_verify(packed, use_cache: bool | None = None,
+               device=None) -> bool:
     """Dispatch a pack_rlc batch through the A-table cache when it
     pays.  use_cache=True forces the cached kernel (benchmarks /
     callers that KNOW the valset repeats), False forces the fused
     kernel, None (the default policy, COMETBFT_TPU_A_CACHE=0 disables)
     uses a cached table only for valsets seen before — one-shot
     batches keep the single fused dispatch.  Returns the verdict bit."""
-    from ..ops import ed25519 as dev
-
-    a_words, r_words, a_mag, a_neg, r_mag, r_neg = packed
-    entry = None
-    if use_cache is True:
-        entry = _A_TABLE_CACHE.get(np.asarray(a_words))
-    elif use_cache is None and USE_A_CACHE:
-        entry = _A_TABLE_CACHE.get_if_worthwhile(np.asarray(a_words))
-    if entry is not None:
-        a_tab, a_ok = entry
-        out = dev.rlc_verify_device_cached_a(
-            a_tab, a_ok, r_words, a_mag, a_neg, r_mag, r_neg)
-    else:
-        out = dev.rlc_verify_device(a_words, r_words,
-                                    a_mag, a_neg, r_mag, r_neg)
-    return bool(np.asarray(out))
+    return bool(np.asarray(rlc_verify_async(
+        packed, use_cache=use_cache, device=device)))

@@ -83,6 +83,10 @@ class Client:
                  # w+1 while window w's dispatch is on device; 1 =
                  # the strictly serial loop
                  pipeline_depth: int = 2,
+                 # mesh round-robin for the verify pipeline
+                 # (ops/sharding.mesh_device_list semantics: 0 defers
+                 # to COMETBFT_TPU_MESH_DEVICES, off unless set)
+                 mesh_devices: int = 0,
                  now_fn=Timestamp.now):
         verifier.validate_trust_level(trust_level)
         trust_options.validate_basic()
@@ -97,6 +101,7 @@ class Client:
         self.pruning_size = pruning_size
         self.sequential_batch_size = max(1, sequential_batch_size)
         self.pipeline_depth = max(1, pipeline_depth)
+        self.mesh_devices = mesh_devices
         self._now = now_fn
         self._initialize(trust_options)
 
@@ -275,22 +280,29 @@ class Client:
                         self._from_primary(hh)
                         for hh in range(start, end + 1)]
 
+        from ..ops import sharding
+
         trace = [trusted]
         verified = trusted
         h = trusted.height + 1
         bs = self.sequential_batch_size
         inflight: deque = deque()
+        devices = sharding.mesh_device_list(self.mesh_devices or None)
+        depth = self.pipeline_depth if devices is None else \
+            max(self.pipeline_depth, 2 * len(devices))
         with cf.ThreadPoolExecutor(
                 max_workers=1,
                 thread_name_prefix="light-prefetch") as ex, \
-                VerifyPipeline(depth=self.pipeline_depth,
-                               name="light-pipeline") as pipe:
+                VerifyPipeline(depth=depth,
+                               name="light-pipeline",
+                               devices=devices if devices is not None
+                               else ()) as pipe:
             wend = min(h + bs - 1, target.height)
             pending = ex.submit(fetch_window, h, wend) \
                 if h <= target.height else None
             while h <= target.height or inflight:
                 if h <= target.height \
-                        and len(inflight) < self.pipeline_depth:
+                        and len(inflight) < depth:
                     window = pending.result()
                     nxt = wend + 1
                     if nxt <= target.height:

@@ -40,7 +40,8 @@ def _gather_lanes(part, axis: str):
 
 
 def sharded_msm(tab, mags, negs, *, mesh, axis: str = "sig",
-                interpret=False, blk=None, group=None):
+                interpret=False, blk=None, group=None,
+                use_pallas: bool = True):
     """One lane-sharded MSM: per-device window-major Straus kernel on
     the local table/digit shard, all_gather of the accumulator points,
     local tree fold — returns the replicated (4, 20, 1) MSM point.
@@ -50,7 +51,16 @@ def sharded_msm(tab, mags, negs, *, mesh, axis: str = "sig",
     so callers validate with SYNTHETIC few-window digit tensors — the
     kernel's correctness argument is window-count-independent, and the
     full 52/26-window program shape is proven on hardware by the
-    mesh-of-1 smoke (scripts/mosaic_smoke5.py shard1_rlc)."""
+    mesh-of-1 smoke (scripts/mosaic_smoke5.py shard1_rlc).
+
+    use_pallas=False swaps the per-shard Straus scan to the XLA path
+    (ops/ed25519._msm_scan) while keeping the sharding layout, the
+    accumulator-point all_gather, and the group-addition fold — the
+    multi-chip-specific machinery — identical.  That is the budget
+    surface for the driver dryrun: one interpret-mode Pallas compile
+    costs minutes on a single core (the MULTICHIP_r05 rc=124 lesson),
+    and the Pallas kernel body is already proven by the slow-tier
+    interpret parity test and the hardware smoke."""
     from jax.experimental.shard_map import shard_map
 
     from . import ed25519 as dev
@@ -65,10 +75,13 @@ def sharded_msm(tab, mags, negs, *, mesh, axis: str = "sig",
                   P(None, axis)),
         out_specs=P(), check_rep=False)
     def run(tab_l, mags_l, negs_l):
-        b = blk or pm.blk_for(tab_l.shape[-1])
-        part = pm.msm_window_major(tab_l, mags_l, negs_l,
-                                   interpret=interpret, blk=b,
-                                   group=group)
+        if use_pallas:
+            b = blk or pm.blk_for(tab_l.shape[-1])
+            part = pm.msm_window_major(tab_l, mags_l, negs_l,
+                                       interpret=interpret, blk=b,
+                                       group=group)
+        else:
+            part = dev._msm_scan(tab_l, mags_l, negs_l)
         return dev._tree_reduce(_gather_lanes(part, axis), 1)
 
     return run(tab, mags, negs)

@@ -18,6 +18,8 @@ verify step.
 from __future__ import annotations
 
 import functools
+import math
+import os
 
 import jax
 import numpy as np
@@ -31,6 +33,46 @@ def device_count() -> int:
         return len(jax.devices())
     except Exception:
         return 1
+
+
+def mesh_device_list(k: int | None = None):
+    """Devices the DISPATCH layer round-robins windows over
+    (crypto/dispatch.VerifyPipeline, crypto/mesh), or None for the
+    single-device path.
+
+    k > 1 asks for that many devices (clamped to what exists);
+    k == 1 forces single-device; k None/0 defers to the
+    COMETBFT_TPU_MESH_DEVICES env knob, which itself defaults to
+    single-device — multi-device dispatch is OPT-IN, so a process that
+    happens to see a virtual CPU mesh (tests force 8 devices) keeps its
+    existing behavior unless a caller or the operator turns the mesh
+    on.  0 via the env knob means "all local devices"."""
+    if k is None or k == 0:
+        raw = os.environ.get("COMETBFT_TPU_MESH_DEVICES")
+        if raw is None:
+            return None
+        k = int(raw)
+    try:
+        devs = list(jax.devices())
+    except Exception:
+        return None
+    if k <= 0:
+        k = len(devs)
+    k = min(k, len(devs))
+    return devs[:k] if k > 1 else None
+
+
+def auto_bucket(n: int, n_devices: int | None = None) -> int:
+    """Batch bucket for n signatures that the mesh divides evenly:
+    dev.bucket_size rounded up to a multiple of the device count, so a
+    sharded dispatch never sees a ragged shard.  Buckets and meshes are
+    almost always both powers of two, in which case this IS
+    dev.bucket_size."""
+    b = dev.bucket_size(n)
+    nd = n_devices if n_devices is not None else device_count()
+    if nd > 1 and b % nd:
+        b = math.lcm(b, nd)
+    return b
 
 
 @functools.lru_cache(maxsize=1)

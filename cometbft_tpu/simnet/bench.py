@@ -45,7 +45,8 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
                         txs_per_block: int = 2,
                         seed: int = 7,
                         timeout: float = 480.0,
-                        pipeline_depth: int | None = None) -> dict:
+                        pipeline_depth: int | None = None,
+                        mesh_devices: int | None = None) -> dict:
     """Sync n_blocks through the real blocksync reactor; returns the
     result dict (blocks_per_sec + stage breakdown + pipeline overlap
     report) and stores it in `last_blocksync`.
@@ -53,7 +54,12 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
     pipeline_depth drives the reactor's overlapped verify pipeline
     (blocksync/reactor.PIPELINE_DEPTH default): 1 = the serial loop,
     >= 2 collects/packs window N+1 while window N is on device — the
-    A/B knob for serial-vs-pipelined on the same seed."""
+    A/B knob for serial-vs-pipelined on the same seed.
+
+    mesh_devices round-robins the pipeline's windows over that many
+    mesh devices (blocksync/reactor.MESH_DEVICES default; see
+    ops/sharding.mesh_device_list — 0 defers to the
+    COMETBFT_TPU_MESH_DEVICES knob, off unless set)."""
     global last_blocksync
     n_blocks = n_blocks if n_blocks is not None else _env_int(
         "SIMNET_BENCH_BLOCKS", 96)
@@ -61,6 +67,8 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
         "SIMNET_BENCH_VALS", 64)
     pipeline_depth = pipeline_depth if pipeline_depth is not None \
         else _env_int("SIMNET_BENCH_PIPELINE_DEPTH", 0) or None
+    mesh_devices = mesh_devices if mesh_devices is not None \
+        else _env_int("SIMNET_BENCH_MESH_DEVICES", 0)
 
     net = SimNetwork(seed=seed)
     genesis, privs = make_sim_genesis(n_vals=n_vals, seed=seed)
@@ -71,6 +79,8 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
     syncer = SimNode("bsync", genesis, net, block_sync=True, seed=seed)
     if pipeline_depth is not None:
         syncer.blocksync_reactor.pipeline_depth = pipeline_depth
+    if mesh_devices:
+        syncer.blocksync_reactor.mesh_devices = mesh_devices
 
     prev_tracer = libtrace.tracer()
     tr = libtrace.StageTracer(

@@ -95,6 +95,93 @@ class TestStreamingVerifier:
         assert v2.is_running() and v2 is not v1
 
 
+class _StubPipeline:
+    """Captures prewarm submissions; resolves every window True."""
+
+    def __init__(self):
+        self.windows = []
+
+    def submit(self, items, subsystem=None, device_threshold=None):
+        from concurrent.futures import Future
+
+        self.windows.append((list(items), subsystem, device_threshold))
+        h = Future()
+        h.set_result((True, [True] * len(items)))
+        return h
+
+
+class TestPrewarm:
+    def test_warmup_dispatches_dummy_batch(self):
+        """warmup=True: start() compiles+dispatches one dummy device
+        batch (VERDICT item 8 — the 31.9 ms cold p99 outlier was the
+        first flush paying compile+dispatch); the warm batch must use
+        DISTINCT keys so the A-side MSM width matches a real flood."""
+        stub = _StubPipeline()
+        sv = StreamingVerifier(device_threshold=16, pipeline=stub,
+                               warmup=True)
+        sv.start()
+        try:
+            assert sv.warmed.wait(timeout=30)
+            assert len(stub.windows) == 1
+            items, subsystem, thr = stub.windows[0]
+            assert subsystem == "consensus" and thr == 2
+            assert len(items) == 16          # min(device_threshold, 256)
+            assert len({pk for pk, _, _ in items}) == len(items)
+        finally:
+            sv.stop()
+
+    def test_cpu_backend_skips_warm_by_default(self):
+        """On the XLA-CPU test backend the warmup compile IS the only
+        cold cost, so the default policy skips it — warmed is set
+        synchronously at start with no window submitted."""
+        stub = _StubPipeline()
+        sv = StreamingVerifier(pipeline=stub)
+        sv.start()
+        try:
+            assert sv.warmed.is_set()
+            assert stub.windows == []
+        finally:
+            sv.stop()
+
+    def test_env_knob_forces_warm(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_VOTE_PREWARM", "1")
+        stub = _StubPipeline()
+        sv = StreamingVerifier(device_threshold=4, pipeline=stub)
+        sv.start()
+        try:
+            assert sv.warmed.wait(timeout=30)
+            assert len(stub.windows) == 1
+        finally:
+            sv.stop()
+        monkeypatch.setenv("COMETBFT_TPU_VOTE_PREWARM", "0")
+        sv2 = StreamingVerifier(device_threshold=4,
+                                pipeline=_StubPipeline())
+        sv2.start()
+        try:
+            assert sv2.warmed.is_set()
+        finally:
+            sv2.stop()
+
+    def test_warm_start_kills_cold_outlier(self):
+        """The assertable warm-start contract: after warmed, the first
+        REAL flood flush finds the pipeline already exercised — here
+        measured as the stub pipeline having seen the dummy window
+        BEFORE the first real submission arrives."""
+        stub = _StubPipeline()
+        sv = StreamingVerifier(flush_interval=0.002, device_threshold=2,
+                               pipeline=stub, warmup=True)
+        sv.start()
+        try:
+            assert sv.warmed.wait(timeout=30)
+            pk, msg, sig = make_sig()
+            fut = sv.submit(pk, msg, sig)
+            assert fut.result(timeout=5) is True
+            # the prewarm window was first in line
+            assert stub.windows and len(stub.windows[0][0]) >= 2
+        finally:
+            sv.stop()
+
+
 class TestPreverifiedContract:
     def test_exact_triple_match_only(self):
         pk, msg, sig = make_sig()
