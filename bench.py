@@ -1051,17 +1051,32 @@ def main() -> None:
     _attach_e2e_detail("light_e2e_headers_per_sec",
                        "light_e2e_detail", _simbench.last_light)
     run_extra("consensus_e2e_blocks_per_sec",
-              lambda: bench_consensus_e2e()["blocks_per_sec"],
+              lambda: bench_consensus_e2e(
+                  attach_timeline=True)["blocks_per_sec"],
               "consensus_e2e_config",
               "simnet e2e: live multi-validator rounds through the"
               " real consensus reactor (defaults 12 blocks x 4"
               " validators; SIMNET_CONSENSUS_* overrides); detail"
               " carries the per-stage consensus breakdown +"
               " round-latency percentiles + per-node flight-recorder"
-              " summaries")
+              " summaries; timeline attached (simnet/tracing), so the"
+              " proposal->commit critical-path decomposition rides"
+              " along (SIMNET_TRACE_EXPORT writes the Perfetto JSON)")
     _attach_e2e_detail("consensus_e2e_blocks_per_sec",
                        "consensus_e2e_detail",
                        getattr(_simbench, "last_consensus", None))
+    if ("consensus_e2e_blocks_per_sec" not in carried_keys
+            and isinstance(extra.get("consensus_e2e_blocks_per_sec"),
+                           (int, float))
+            and isinstance(getattr(_simbench, "last_consensus", None),
+                           dict)):
+        share = _simbench.last_consensus.get(
+            "critical_path_device_share")
+        if isinstance(share, (int, float)):
+            extra["critical_path_device_share"] = share
+            carried_keys.discard("critical_path_device_share")
+            _sync_carried()
+            persist()
     # chaos recovery metrics: both numbers come from ONE bench_chaos()
     # run (seeded deterministic scenarios, CPU-only — no device time);
     # the second metric and the detail ride the recovery extra's run

@@ -13,6 +13,7 @@ import os
 import threading
 import time
 
+from ..libs import tracetl
 from ..libs.trace import span as trace_span
 from ..p2p.base_reactor import Envelope, Reactor
 from ..p2p.conn.connection import ChannelDescriptor
@@ -60,6 +61,7 @@ class BlocksyncReactor(Reactor):
         self._stop_sync = threading.Event()
         self.synced = not block_sync
         self.metrics = None        # BlockSyncMetrics when the node meters
+        self.timeline = None       # per-node event timeline (tracetl)
         self.pipeline_depth = PIPELINE_DEPTH
         self.mesh_devices = MESH_DEVICES
         self._pipeline = None      # crypto/dispatch.VerifyPipeline
@@ -147,8 +149,13 @@ class BlocksyncReactor(Reactor):
 
     # -- receive -----------------------------------------------------------
     def receive(self, envelope: Envelope) -> None:
-        with trace_span("blocksync", "decode"):
+        with trace_span("blocksync", "decode"), \
+                tracetl.span_for(self, "blocksync", "decode"):
             msg = bm.unwrap(bytes(envelope.message))
+        if envelope.tctx is not None:
+            tl = tracetl.active(self)
+            if tl is not None:
+                tl.recv("blocksync", type(msg).__name__, envelope.tctx)
         peer = envelope.src
         if isinstance(msg, bm.BlockRequest):
             self._respond_to_block_request(peer, msg.height)
@@ -175,8 +182,15 @@ class BlocksyncReactor(Reactor):
             from ..types.block import ExtendedCommit
             ext = ExtendedCommit.from_proto(raw_ext) \
                 if isinstance(raw_ext, (bytes, bytearray)) else raw_ext
+        tctx = None
+        tl = tracetl.active(self)
+        if tl is not None:
+            # causal edge: the requester's recv ties its apply work to
+            # this serve (round 0 — blocksync is height-only)
+            tctx = tl.ctx(height, 0)
+            tl.send("blocksync", "BlockResponse", tctx)
         peer.try_send(BLOCKSYNC_CHANNEL,
-                      bm.wrap(bm.BlockResponse(block, ext)))
+                      bm.wrap(bm.BlockResponse(block, ext)), tctx=tctx)
 
     # -- sync driver -------------------------------------------------------
     def _pool_routine(self) -> None:
@@ -314,7 +328,9 @@ class BlocksyncReactor(Reactor):
                 return progressed, popped, False
             parts, first_id = parts_ids[i]
             try:
-                with trace_span("blocksync", "apply"):
+                with trace_span("blocksync", "apply"), \
+                        tracetl.span_for(self, "blocksync", "apply",
+                                         height=first.header.height):
                     if ext_enabled:
                         first_ext.ensure_extensions(True)
                     self.block_exec.validate_block(self.state, first)
@@ -326,14 +342,18 @@ class BlocksyncReactor(Reactor):
                 return progressed, popped, False
             self.pool.pop_request()
             popped += 1
-            with trace_span("blocksync", "store"):
+            with trace_span("blocksync", "store"), \
+                    tracetl.span_for(self, "blocksync", "store",
+                                     height=first.header.height):
                 if ext_enabled:
                     self.store.save_block(first, parts,
                                           first_ext.to_commit(),
                                           ext_commit=first_ext.to_proto())
                 else:
                     self.store.save_block(first, parts, commits[i])
-            with trace_span("blocksync", "apply"):
+            with trace_span("blocksync", "apply"), \
+                    tracetl.span_for(self, "blocksync", "apply",
+                                     height=first.header.height):
                 self.state = self.block_exec.apply_verified_block(
                     self.state, first_id, first,
                     syncing_to_height=self.pool.max_peer_height())
@@ -396,7 +416,9 @@ class BlocksyncReactor(Reactor):
         try:
             with trace_span("blocksync", "verify_dispatch",
                             offset=offset), \
-                    trace_span("blocksync", "collect", offset=offset):
+                    trace_span("blocksync", "collect", offset=offset), \
+                    tracetl.span_for(self, "blocksync", "collect",
+                                     offset=offset):
                 for i in range(usable):
                     block = blocks[i]
                     collecting_h = block.header.height
@@ -464,7 +486,9 @@ class BlocksyncReactor(Reactor):
                 # HOT PATH: the window's single device dispatch —
                 # later windows are collecting/packing RIGHT NOW
                 with trace_span("blocksync", "device_wait",
-                                inflight=len(inflight) + 1):
+                                inflight=len(inflight) + 1), \
+                        tracetl.span_for(self, "blocksync",
+                                         "device_wait"):
                     rec["verdict"].wait()
             except Exception as e:
                 # abandoned lookahead windows resolve in the

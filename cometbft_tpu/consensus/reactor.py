@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..libs import tracetl
 from ..libs.bits import BitArray
 from ..p2p.base_reactor import Envelope, Reactor
 from ..p2p.conn.connection import ChannelDescriptor
@@ -225,7 +226,21 @@ class ConsensusReactor(Reactor):
         self.wait_sync = wait_sync  # blocksync first; flip via switch_to_consensus
         self._peer_states: dict[str, PeerState] = {}
         self._peer_stops: dict[str, threading.Event] = {}
+        # optional per-node Timeline (libs/tracetl.py): gossip sends
+        # mint trace contexts and receives record the causal edge
+        self.timeline = None
         self.cs.listeners.append(self._on_internal_event)
+
+    def _send_ctx(self, height: int, round_: int, kind: str):
+        """Mint + record a trace context for one gossip send; None
+        (and free) when no timeline is installed."""
+        tl = self.timeline if self.timeline is not None \
+            else tracetl.timeline()
+        if tl is None:
+            return None
+        ctx = tl.ctx(height, round_)
+        tl.send("consensus", kind, ctx)
+        return ctx
 
     # -- reactor API -------------------------------------------------------
     def get_channels(self) -> list:
@@ -250,7 +265,7 @@ class ConsensusReactor(Reactor):
         self.cs.stop()
 
     # -- vote pre-verification (SURVEY §7 streaming accumulator) -----------
-    def _preverify_vote(self, vote) -> None:
+    def _preverify_vote(self, vote, tctx=None) -> None:
         """Submit the vote's signature to the streaming verifier off the
         state thread; VoteSet.add_vote consumes the verdict iff the
         (pubkey, sign_bytes, sig) triple matches what it would verify
@@ -291,7 +306,16 @@ class ConsensusReactor(Reactor):
                 return
             pk = pub.bytes()
             msg = vote.sign_bytes(chain_id)
-            fut = default_verifier().submit(pk, msg, vote.signature)
+            if tctx is None:
+                # no wire context: mint a local one so the verify flush
+                # still cross-references by height/round
+                tl = self.timeline if self.timeline is not None \
+                    else tracetl.timeline()
+                if tl is not None:
+                    tctx = tracetl.make_ctx(tl.node, vote.height,
+                                            vote.round, 0)
+            fut = default_verifier().submit(pk, msg, vote.signature,
+                                            ctx=tctx)
             vote.preverified = Preverified(pk, msg, vote.signature, fut)
         except Exception:
             return       # pre-verification is best-effort; VoteSet re-checks
@@ -330,6 +354,10 @@ class ConsensusReactor(Reactor):
             stop.set()
         self._peer_states.pop(peer.id, None)
 
+    _CHANNEL_KINDS = {STATE_CHANNEL: "state", DATA_CHANNEL: "data",
+                      VOTE_CHANNEL: "vote",
+                      VOTE_SET_BITS_CHANNEL: "vote_set_bits"}
+
     # -- incoming ----------------------------------------------------------
     def receive(self, envelope: Envelope) -> None:
         msg = msgs.unwrap_message(bytes(envelope.message))
@@ -339,6 +367,14 @@ class ConsensusReactor(Reactor):
         if ps is None:
             return
         ch = envelope.channel_id
+        if envelope.tctx is not None:
+            tl = self.timeline if self.timeline is not None \
+                else tracetl.timeline()
+            if tl is not None:
+                # the flow edge's receiving end; message-type precision
+                # comes from the paired send event (same ctx id)
+                tl.recv("consensus", self._CHANNEL_KINDS.get(ch, "msg"),
+                        envelope.tctx)
 
         if ch == STATE_CHANNEL:
             if isinstance(msg, msgs.NewRoundStepMessage):
@@ -377,7 +413,7 @@ class ConsensusReactor(Reactor):
                 v = msg.vote
                 ps.set_has_vote(v.height, v.round, v.type,
                                 v.validator_index)
-                self._preverify_vote(v)
+                self._preverify_vote(v, tctx=envelope.tctx)
                 self.cs.add_peer_message(msg, peer.id)
         elif ch == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, msgs.VoteSetBitsMessage):
@@ -488,7 +524,9 @@ class ConsensusReactor(Reactor):
                 if ok:
                     part = parts.get_part(idx)
                     m = msgs.BlockPartMessage(rs_height, rs_round, part)
-                    if peer.send(DATA_CHANNEL, msgs.wrap_message(m)):
+                    if peer.send(DATA_CHANNEL, msgs.wrap_message(m),
+                                 tctx=self._send_ctx(rs_height, rs_round,
+                                                     "block_part")):
                         ps.set_has_proposal_block_part(rs_height,
                                                        rs_round, idx)
                     continue
@@ -496,7 +534,9 @@ class ConsensusReactor(Reactor):
             # send the proposal itself
             if proposal is not None and not prs_has_proposal:
                 if peer.send(DATA_CHANNEL, msgs.wrap_message(
-                        msgs.ProposalMessage(proposal))):
+                        msgs.ProposalMessage(proposal)),
+                        tctx=self._send_ctx(proposal.height,
+                                            proposal.round, "proposal")):
                     ps.set_has_proposal(proposal)
                 if proposal.pol_round >= 0:
                     with cs._mtx:
@@ -541,7 +581,9 @@ class ConsensusReactor(Reactor):
         if part is None:
             return False
         m = msgs.BlockPartMessage(prs_height, prs_round, part)
-        if peer.send(DATA_CHANNEL, msgs.wrap_message(m)):
+        if peer.send(DATA_CHANNEL, msgs.wrap_message(m),
+                     tctx=self._send_ctx(prs_height, prs_round,
+                                         "block_part")):
             ps.set_has_proposal_block_part(prs_height, prs_round, idx)
             return True
         return False
@@ -599,7 +641,9 @@ class ConsensusReactor(Reactor):
         if vote is None:
             return False
         if peer.send(VOTE_CHANNEL,
-                     msgs.wrap_message(msgs.VoteMessage(vote))):
+                     msgs.wrap_message(msgs.VoteMessage(vote)),
+                     tctx=self._send_ctx(vote.height, vote.round,
+                                         "vote")):
             ps.set_has_vote(vote.height, vote.round, vote.type,
                             vote.validator_index)
             return True
@@ -632,7 +676,8 @@ class ConsensusReactor(Reactor):
                     validator_address=cs_sig.validator_address,
                     validator_index=idx, signature=cs_sig.signature)
         if peer.send(VOTE_CHANNEL,
-                     msgs.wrap_message(msgs.VoteMessage(vote))):
+                     msgs.wrap_message(msgs.VoteMessage(vote)),
+                     tctx=self._send_ctx(height, commit.round, "vote")):
             ps.set_has_vote(height, commit.round, PRECOMMIT_TYPE, idx)
             return True
         return False

@@ -23,6 +23,7 @@ _log = logging.getLogger(__name__)
 
 from ..libs import flightrec
 from ..libs import trace as libtrace
+from ..libs import tracetl
 from ..libs.fail import fail_point
 from ..libs.service import BaseService
 from ..types import events as events_
@@ -100,6 +101,9 @@ class ConsensusState(BaseService):
         # optional FlightRecorder (libs/flightrec.py), assigned by the
         # node/simnet wiring; None keeps every hot path a single test
         self.recorder = None
+        # optional per-node Timeline (libs/tracetl.py); falls back to
+        # the process-wide tracetl seam, no-op when neither is set
+        self.timeline = None
         self._last_commit_monotonic = None
         self._step_start = time.monotonic()
         self._round_start = time.monotonic()
@@ -352,6 +356,7 @@ class ConsensusState(BaseService):
         self._update_round_step(0, STEP_NEW_HEIGHT)
         if not self.replay_mode and self.recorder is not None:
             self.recorder.record(flightrec.EV_NEW_HEIGHT, height=height)
+        self._tl_instant("new_height", height=height)
         if self.commit_time == 0.0:
             self.start_time = time.monotonic() + self.config.timeout_commit
         else:
@@ -419,6 +424,17 @@ class ConsensusState(BaseService):
             duration_ns=int(duration_s * 1e9), height=height,
             round=round_, step=step))
 
+    def _tl_instant(self, name: str, **fields) -> None:
+        """Timeline point event (libs/tracetl.py): per-node instance if
+        the wiring assigned one, else the process-wide seam; free when
+        neither is set and skipped in WAL replay like the recorder."""
+        if self.replay_mode:
+            return
+        tl = self.timeline if self.timeline is not None \
+            else tracetl.timeline()
+        if tl is not None:
+            tl.instant("consensus", name, **fields)
+
     def _update_round_step(self, round_: int, step: int) -> None:
         """Every round/step transition funnels through here — the one
         place step_duration / round_duration / the flight recorder see
@@ -448,6 +464,10 @@ class ConsensusState(BaseService):
                 rec.record(flightrec.EV_STEP, height=self.height,
                            round=round_,
                            step=STEP_NAMES.get(step, str(step)))
+            if round_ != self.round or step != self.step:
+                self._tl_instant("step", height=self.height,
+                                 round=round_,
+                                 step=STEP_NAMES.get(step, str(step)))
         self.round = round_
         self.step = step
 
@@ -537,7 +557,9 @@ class ConsensusState(BaseService):
             if not self.validators.has_address(addr):
                 return
             if self._is_proposer(addr):
-                with libtrace.span("consensus", "propose"):
+                with libtrace.span("consensus", "propose"), \
+                        tracetl.span_for(self, "consensus", "propose",
+                                         height=height, round=round_):
                     self._decide_proposal(height, round_)
         finally:
             self._update_round_step(round_, STEP_PROPOSE)
@@ -609,7 +631,9 @@ class ConsensusState(BaseService):
                 (self.round == round_ and self.step >= STEP_PREVOTE):
             return
         try:
-            with libtrace.span("consensus", "prevote"):
+            with libtrace.span("consensus", "prevote"), \
+                    tracetl.span_for(self, "consensus", "prevote",
+                                     height=height, round=round_):
                 self._do_prevote(height, round_)
         finally:
             self._update_round_step(round_, STEP_PREVOTE)
@@ -726,7 +750,9 @@ class ConsensusState(BaseService):
                 (self.round == round_ and self.step >= STEP_PRECOMMIT):
             return
         try:
-            with libtrace.span("consensus", "precommit"):
+            with libtrace.span("consensus", "precommit"), \
+                    tracetl.span_for(self, "consensus", "precommit",
+                                     height=height, round=round_):
                 self._do_precommit(height, round_)
         finally:
             self._update_round_step(round_, STEP_PRECOMMIT)
@@ -844,7 +870,9 @@ class ConsensusState(BaseService):
     def _finalize_commit(self, height: int) -> None:
         if self.height != height or self.step != STEP_COMMIT:
             return
-        with libtrace.span("consensus", "commit"):
+        with libtrace.span("consensus", "commit"), \
+                tracetl.span_for(self, "consensus", "commit",
+                                 height=height):
             self._do_finalize_commit(height)
 
     def _do_finalize_commit(self, height: int) -> None:
@@ -885,6 +913,10 @@ class ConsensusState(BaseService):
             block, block.header.height)
 
         fail_point("cs-after-apply")
+
+        # timeline: the height's proposal->commit window closes here —
+        # the block is saved, WAL'd, and applied on THIS node
+        self._tl_instant("commit", height=block.header.height)
 
         if self.metrics is not None:
             m = self.metrics
@@ -949,6 +981,10 @@ class ConsensusState(BaseService):
             self.recorder.record(
                 flightrec.EV_PROPOSAL, height=proposal.height,
                 round=proposal.round, pol_round=proposal.pol_round)
+        # timeline: the height's proposal->commit window opens at the
+        # EARLIEST of these instants across the cluster
+        self._tl_instant("proposal", height=proposal.height,
+                         round=proposal.round)
         self._notify_listeners("proposal", proposal)
 
     def _add_proposal_block_part(self, msg: msgs.BlockPartMessage,

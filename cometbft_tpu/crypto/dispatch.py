@@ -210,6 +210,10 @@ class VerifyPipeline(BaseService):
         self._stopping = False
         self._faulted = False      # draining after a device error
         self._dev_faulted: set[int] = set()   # per-device drain (mesh)
+        # per-object timeline override (libs/tracetl.py): lets a harness
+        # attribute this pipeline's host_pack/device spans to one node's
+        # timeline; None defers to the process seam
+        self.timeline = None
         # stats (tests + bench introspection)
         self.submitted = 0
         self.resolved = 0
@@ -364,6 +368,7 @@ class VerifyPipeline(BaseService):
 
     def _staging_loop(self) -> None:
         from ..libs import trace as libtrace
+        from ..libs import tracetl
 
         while True:
             with self._cv:
@@ -375,7 +380,10 @@ class VerifyPipeline(BaseService):
                 win = self._next_unstaged()
             try:
                 with libtrace.span(win.handle.subsystem, "host_pack",
-                                   inflight=len(self._windows)):
+                                   inflight=len(self._windows)), \
+                        tracetl.span_for(
+                            self, win.handle.subsystem, "host_pack",
+                            **tracetl.ctx_fields(win.handle.ctx)):
                     self._stage(win)
             except Exception:
                 # a staging failure must not wedge the queue: route the
@@ -485,6 +493,7 @@ class VerifyPipeline(BaseService):
     def _record_flush(self, win: _Window, path: str, t0: float) -> None:
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
+        from ..libs import tracetl
 
         dm = libmetrics.device_metrics()
         if dm is not None:
@@ -498,16 +507,21 @@ class VerifyPipeline(BaseService):
             flightrec.EV_VERIFY_FLUSH, path=path,
             batch=len(win.items),
             subsystem=win.handle.subsystem,
-            inflight=len(self._windows), staged=self.staged)
+            inflight=len(self._windows), staged=self.staged,
+            **tracetl.ctx_fields(win.handle.ctx))
 
     def _resolve_window(self, win: _Window) -> None:
         from ..libs import trace as libtrace
+        from ..libs import tracetl
 
         t0 = time.monotonic()
         path = "host"
         try:
             with libtrace.span(win.handle.subsystem, "device",
-                               inflight=len(self._windows)):
+                               inflight=len(self._windows)), \
+                    tracetl.span_for(
+                        self, win.handle.subsystem, "device",
+                        **tracetl.ctx_fields(win.handle.ctx)):
                 ok, verdicts, path = self._compute_verdicts(
                     win, self._faulted)
             win.device_s = time.monotonic() - t0
@@ -529,6 +543,7 @@ class VerifyPipeline(BaseService):
 
     def _mesh_device_loop(self, idx: int) -> None:
         from ..libs import trace as libtrace
+        from ..libs import tracetl
 
         while True:
             with self._cv:
@@ -548,7 +563,11 @@ class VerifyPipeline(BaseService):
             try:
                 with libtrace.span(win.handle.subsystem, "device",
                                    inflight=len(self._windows),
-                                   device=idx):
+                                   device=idx), \
+                        tracetl.span_for(
+                            self, win.handle.subsystem, "device",
+                            device=idx,
+                            **tracetl.ctx_fields(win.handle.ctx)):
                     ok, verdicts, path = self._compute_verdicts(
                         win, faulted, device=self.devices[idx],
                         device_index=idx)
@@ -604,6 +623,7 @@ class VerifyPipeline(BaseService):
                device_index: int | None = None) -> None:
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
+        from ..libs import tracetl
 
         with self._cv:
             if device_index is None:
@@ -622,15 +642,16 @@ class VerifyPipeline(BaseService):
                 dm.pipeline_device_drains.labels(
                     str(device_index)).inc()
         rec = flightrec.recorder()
+        ctxf = tracetl.ctx_fields(win.handle.ctx)
         flightrec.record(flightrec.EV_DEVICE_FALLBACK,
                          batch=len(win.items),
-                         error=type(exc).__name__)
+                         error=type(exc).__name__, **ctxf)
         flightrec.record(flightrec.EV_PIPELINE_DRAIN,
                          batch=len(win.items),
                          inflight=len(self._windows),
                          staged=staged_behind,
                          device=device_index,
-                         error=type(exc).__name__)
+                         error=type(exc).__name__, **ctxf)
         if rec is not None:
             rec.dump_to_log(
                 "pipeline device dispatch failed, draining: %r" % exc)

@@ -59,7 +59,8 @@ class StreamingVerifier(BaseService):
         # real accelerator is attached
         self.warmup = warmup
         self.warmed = threading.Event()
-        self._pending: list[tuple[bytes, bytes, bytes, Future]] = []
+        # (pubkey, msg, sig, future, trace_ctx_or_None)
+        self._pending: list[tuple] = []
         self._cv = threading.Condition()
         self._thread: threading.Thread | None = None
         self._stopping = False
@@ -136,16 +137,19 @@ class StreamingVerifier(BaseService):
 
     # -- API ---------------------------------------------------------------
 
-    def submit(self, pubkey: bytes, msg: bytes, sig: bytes) -> Future:
+    def submit(self, pubkey: bytes, msg: bytes, sig: bytes,
+               ctx=None) -> Future:
         """Queue one signature; the future resolves to a bool verdict.
         The caller keeps (pubkey, msg, sig) to check the verdict applies
-        to what it meant to verify."""
+        to what it meant to verify.  ``ctx`` is an optional trace
+        context (libs/tracetl.py) tagging the flush events with the
+        consensus height/round that triggered the verify."""
         fut: Future = Future()
         with self._cv:
             if self._stopping or self._thread is None:
                 fut.set_result(_host_verify(pubkey, msg, sig))
                 return fut
-            self._pending.append((pubkey, msg, sig, fut))
+            self._pending.append((pubkey, msg, sig, fut, ctx))
             self._cv.notify()
         return fut
 
@@ -188,6 +192,7 @@ class StreamingVerifier(BaseService):
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
         from ..libs import trace as libtrace
+        from ..libs import tracetl
 
         t0 = time.monotonic()
         if len(batch) >= self.device_threshold:
@@ -198,7 +203,9 @@ class StreamingVerifier(BaseService):
                 # COLLECTING the next flood batch while this window
                 # packs/dispatches — the flood path no longer stalls on
                 # a synchronous device round-trip.
-                with libtrace.span("consensus", "verify_dispatch"):
+                with libtrace.span("consensus", "verify_dispatch"), \
+                        tracetl.span_for(self, "consensus",
+                                         "verify_dispatch"):
                     self._flush_device(batch)
                 return
             except Exception as e:
@@ -214,8 +221,9 @@ class StreamingVerifier(BaseService):
                     rec.dump_to_log(
                         "device verify flush failed: %r" % e)
         path = "host"
-        with libtrace.span("consensus", "verify_dispatch"):
-            for pk, msg, sig, fut in batch:
+        with libtrace.span("consensus", "verify_dispatch"), \
+                tracetl.span_for(self, "consensus", "verify_dispatch"):
+            for pk, msg, sig, fut, _ in batch:
                 if not fut.set_running_or_notify_cancel():
                     continue
                 try:
@@ -228,7 +236,8 @@ class StreamingVerifier(BaseService):
             dm.batch_size.labels(path).observe(len(batch))
             dm.flush_latency_seconds.observe(time.monotonic() - t0)
         flightrec.record(flightrec.EV_VERIFY_FLUSH, path=path,
-                         batch=len(batch), inflight=0, staged=0)
+                         batch=len(batch), inflight=0, staged=0,
+                         **tracetl.ctx_fields(_batch_ctx(batch)))
 
     def _flush_device(self, batch) -> None:
         """Submit the flood batch through the overlapped pipeline and
@@ -243,8 +252,9 @@ class StreamingVerifier(BaseService):
         pipe = self._pipeline if self._pipeline is not None \
             else default_pipeline()
         handle = pipe.submit(
-            [(pk, msg, sig) for pk, msg, sig, _ in batch],
-            subsystem="consensus", device_threshold=2)
+            [(pk, msg, sig) for pk, msg, sig, _, _ in batch],
+            subsystem="consensus", device_threshold=2,
+            ctx=_batch_ctx(batch))
 
         def _resolve(h):
             try:
@@ -252,15 +262,24 @@ class StreamingVerifier(BaseService):
             except Exception:           # pragma: no cover - defensive
                 verdicts = None
             if verdicts is None:
-                for pk, msg, sig, fut in batch:
+                for pk, msg, sig, fut, _ in batch:
                     if fut.set_running_or_notify_cancel():
                         fut.set_result(_host_verify(pk, msg, sig))
                 return
-            for (_, _, _, fut), ok in zip(batch, verdicts):
+            for (_, _, _, fut, _), ok in zip(batch, verdicts):
                 if fut.set_running_or_notify_cancel():
                     fut.set_result(bool(ok))
 
         handle.add_done_callback(_resolve)
+
+
+def _batch_ctx(batch):
+    """First non-None trace context in the batch: a flush is one event,
+    and the oldest submission is the one whose latency it bounds."""
+    for entry in batch:
+        if entry[4] is not None:
+            return entry[4]
+    return None
 
 
 def _host_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:

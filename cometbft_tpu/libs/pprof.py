@@ -20,7 +20,8 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
-_ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec")
+_ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec",
+              "tracetl")
 
 
 def _dump_threads() -> str:
@@ -127,6 +128,13 @@ class PprofServer:
                         self._text("no flight recorder installed", 404)
                     else:
                         self._text(rec.dump_text())
+                elif name == "tracetl":
+                    from . import tracetl as _tl
+                    tl = _tl.timeline()
+                    if tl is None:
+                        self._text("no timeline installed", 404)
+                    else:
+                        self._text(tl.dump_text())
                 else:
                     self._text("unknown profile", 404)
 

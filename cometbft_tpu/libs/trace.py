@@ -50,11 +50,13 @@ class StageTracer:
         self._mtx = threading.Lock()
         self._totals: dict[tuple[str, str], list] = {}
         self._intervals: list = []      # (sub, stage, t0, t1, fields)
+        self.dropped_intervals = 0      # ring overflow, no longer silent
         self.metrics = metrics
 
     def record(self, subsystem: str, stage: str, seconds: float,
                end: float | None = None, fields=None) -> None:
         t1 = end if end is not None else time.perf_counter()
+        overflow = 0
         with self._mtx:
             t = self._totals.setdefault((subsystem, stage), [0, 0.0])
             t[0] += 1
@@ -62,11 +64,14 @@ class StageTracer:
             self._intervals.append(
                 (subsystem, stage, t1 - seconds, t1, fields))
             if len(self._intervals) > MAX_INTERVALS:
-                del self._intervals[:len(self._intervals)
-                                    - MAX_INTERVALS]
+                overflow = len(self._intervals) - MAX_INTERVALS
+                del self._intervals[:overflow]
+                self.dropped_intervals += overflow
         if self.metrics is not None:
             self.metrics.stage_duration_seconds.labels(
                 subsystem, stage).observe(seconds)
+            if overflow:
+                self.metrics.intervals_dropped.add(overflow)
 
     def intervals(self, subsystem: str | None = None,
                   stage: str | None = None) -> list[dict]:

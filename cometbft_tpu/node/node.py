@@ -332,6 +332,16 @@ class Node(BaseService):
         self.flight_recorder = FlightRecorder()
         self.consensus_state.recorder = self.flight_recorder
 
+        # cross-node event timeline (libs/tracetl.py): same always-on
+        # discipline and the same reach-through (consensus_state
+        # .timeline), dumpable via the tracetl RPC route and
+        # /debug/pprof/tracetl
+        from ..libs import tracetl as libtracetl
+        self.timeline = libtracetl.Timeline(node=self.node_key.id[:8])
+        self.consensus_state.timeline = self.timeline
+        self.consensus_reactor.timeline = self.timeline
+        self.blocksync_reactor.timeline = self.timeline
+
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
         self.metrics_server = None
@@ -379,6 +389,8 @@ class Node(BaseService):
             # process-wide seam
             from ..libs import flightrec as libflightrec
             libflightrec.set_recorder(self.flight_recorder)
+            # ... and their timeline spans through tracetl's seam
+            libtracetl.set_timeline(self.timeline)
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 

@@ -17,6 +17,8 @@ class Envelope:
     src: object = None        # Peer (inbound)
     message: object = None    # decoded message (or raw bytes)
     channel_id: int = 0
+    tctx: object = None       # trace context (libs/tracetl.py) when the
+    #                           wire carried one; None everywhere else
 
 
 class Reactor(BaseService):

@@ -100,9 +100,13 @@ class _Channel:
 
     def __init__(self, desc: ChannelDescriptor):
         self.desc = desc
-        self.send_queue: queue.Queue[bytes] = queue.Queue(
+        # queue of (msg_bytes, trace_ctx_or_None): the context rides
+        # next to the message through packetization so the EOF packet
+        # of THIS message — and nothing else — carries it on the wire
+        self.send_queue: queue.Queue[tuple] = queue.Queue(
             desc.send_queue_capacity)
         self.sending: bytes | None = None
+        self.sending_ctx = None
         self.sent_pos = 0
         self.recently_sent = 0       # exponentially decayed
         self.recv_buf = b""
@@ -110,21 +114,25 @@ class _Channel:
     def is_send_pending(self) -> bool:
         return self.sending is not None or not self.send_queue.empty()
 
-    def next_packet(self) -> bytes:
-        """Pop the next <=1024-byte packet of the in-flight message."""
+    def next_packet(self) -> tuple:
+        """Pop the next <=1024-byte packet of the in-flight message;
+        -> (packet, eof, trace_ctx) — ctx is meaningful only at eof."""
         if self.sending is None:
-            self.sending = self.send_queue.get_nowait()
+            self.sending, self.sending_ctx = self.send_queue.get_nowait()
             self.sent_pos = 0
         chunk = self.sending[self.sent_pos:
                              self.sent_pos + MAX_PACKET_MSG_PAYLOAD_SIZE]
         self.sent_pos += len(chunk)
         eof = self.sent_pos >= len(self.sending)
         pkt = _pack_msg(self.desc.id, eof, chunk)
+        ctx = None
         if eof:
+            ctx = self.sending_ctx
             self.sending = None
+            self.sending_ctx = None
             self.sent_pos = 0
         self.recently_sent += len(pkt)
-        return pkt
+        return pkt, eof, ctx
 
     def recv_packet(self, eof: bool, data: bytes) -> bytes | None:
         """Append a packet; return the whole message when eof."""
@@ -147,9 +155,23 @@ class MConnection(BaseService):
                  pong_timeout: float = PONG_TIMEOUT,
                  flush_throttle: float = FLUSH_THROTTLE):
         """conn: a SecretConnection-like object (write/read/close);
-        on_receive(channel_id, msg_bytes); on_error(exc)."""
+        on_receive(channel_id, msg_bytes[, tctx]); on_error(exc)."""
         super().__init__("MConnection")
         self._conn = conn
+        # trace-context carry (libs/tracetl.py): conns that can ship a
+        # per-message context list with each frame (the simnet conn)
+        # expose write_with_ctx/pop_recv_ctx; everything else (real
+        # TCP + SecretConnection, chaos wrappers) degrades to plain
+        # writes and contexts simply do not travel
+        self._write_with_ctx = getattr(conn, "write_with_ctx", None)
+        self._pop_recv_ctx = getattr(conn, "pop_recv_ctx", None)
+        try:
+            import inspect
+            params = inspect.signature(on_receive).parameters
+            self._recv_takes_ctx = len(params) >= 3 or any(
+                p.kind == p.VAR_POSITIONAL for p in params.values())
+        except (TypeError, ValueError):
+            self._recv_takes_ctx = False
         # optional P2PMetrics (libs/metrics.py), assigned by the switch:
         # per-channel framed-byte counters at the wire seam
         self.metrics = None
@@ -183,29 +205,31 @@ class MConnection(BaseService):
 
     # -- sending -----------------------------------------------------------
     def send(self, channel_id: int, msg_bytes: bytes,
-             timeout: float = 10.0) -> bool:
+             timeout: float = 10.0, tctx=None) -> bool:
         """Queue a message; False if the channel queue stays full
-        (connection.go Send)."""
+        (connection.go Send).  `tctx` is an optional trace context
+        delivered to the remote reactor with the message."""
         if not self.is_running():
             return False
         ch = self._channels.get(channel_id)
         if ch is None:
             return False
         try:
-            ch.send_queue.put(msg_bytes, timeout=timeout)
+            ch.send_queue.put((msg_bytes, tctx), timeout=timeout)
         except queue.Full:
             return False
         self._send_signal.set()
         return True
 
-    def try_send(self, channel_id: int, msg_bytes: bytes) -> bool:
+    def try_send(self, channel_id: int, msg_bytes: bytes,
+                 tctx=None) -> bool:
         if not self.is_running():
             return False
         ch = self._channels.get(channel_id)
         if ch is None:
             return False
         try:
-            ch.send_queue.put_nowait(msg_bytes)
+            ch.send_queue.put_nowait((msg_bytes, tctx))
         except queue.Full:
             return False
         self._send_signal.set()
@@ -252,6 +276,7 @@ class MConnection(BaseService):
                 # drain packets, decaying counters; batch <= throttle
                 deadline = time.monotonic() + self._flush_throttle
                 batch = []
+                batch_ctxs = []          # one entry per msg-EOF packet
                 batch_bytes = 0
                 rate_limited = False
                 while True:
@@ -264,8 +289,10 @@ class MConnection(BaseService):
                     ch = self._select_channel()
                     if ch is None:
                         break
-                    pkt = ch.next_packet()
+                    pkt, eof, ctx = ch.next_packet()
                     batch.append(pkt)
+                    if eof:
+                        batch_ctxs.append(ctx)
                     batch_bytes += len(pkt)
                     self._send_monitor.update(len(pkt))
                     if self.metrics is not None:
@@ -275,13 +302,11 @@ class MConnection(BaseService):
                             "%#x" % ch.desc.id).add(4 + len(pkt))
                     if time.monotonic() >= deadline or \
                             batch_bytes > 64 * 1024:
-                        self._conn.write(b"".join(
-                            struct.pack(">I", len(p)) + p for p in batch))
-                        batch, batch_bytes = [], 0
+                        self._flush_batch(batch, batch_ctxs)
+                        batch, batch_ctxs, batch_bytes = [], [], 0
                         deadline = time.monotonic() + self._flush_throttle
                 if batch:
-                    self._conn.write(b"".join(
-                        struct.pack(">I", len(p)) + p for p in batch))
+                    self._flush_batch(batch, batch_ctxs)
                 # decay sent counters (connection.go: 0.8 every 2s; we
                 # decay proportionally per wakeup)
                 for ch in self._channels.values():
@@ -294,6 +319,18 @@ class MConnection(BaseService):
                     self._send_signal.set()
         except Exception as e:
             self._stop_for_error(e)
+
+    def _flush_batch(self, batch: list, ctxs: list) -> None:
+        """Write one frame of complete packets.  A ctx-capable conn
+        gets the per-EOF context list WITH the frame (Nones included:
+        the receiver pops exactly one entry per completed message, so
+        the list must stay aligned even when most sends carry no ctx)."""
+        data = b"".join(struct.pack(">I", len(p)) + p for p in batch)
+        w = self._write_with_ctx
+        if w is not None:
+            w(data, ctxs)
+        else:
+            self._conn.write(data)
 
     # -- receiving ---------------------------------------------------------
     def _recv_routine(self) -> None:
@@ -335,7 +372,12 @@ class MConnection(BaseService):
                 "%#x" % ch_id).add(4 + len(payload))
         msg = ch.recv_packet(eof, data)
         if msg is not None:
-            self._on_receive(ch_id, msg)
+            pop = self._pop_recv_ctx
+            tctx = pop() if pop is not None else None
+            if self._recv_takes_ctx:
+                self._on_receive(ch_id, msg, tctx)
+            else:
+                self._on_receive(ch_id, msg)
 
     def _stop_for_error(self, e: Exception) -> None:
         if self.is_running():

@@ -32,12 +32,16 @@ class Peer(BaseService):
         self.mconn.stop()
 
     def send(self, channel_id: int, msg_bytes: bytes,
-             timeout: float = 10.0) -> bool:
-        """Blocking send onto the channel queue (peer.go Send)."""
-        return self.mconn.send(channel_id, msg_bytes, timeout=timeout)
+             timeout: float = 10.0, tctx=None) -> bool:
+        """Blocking send onto the channel queue (peer.go Send).
+        `tctx` is an optional trace context (libs/tracetl.py) carried
+        to the remote reactor's Envelope when the wire supports it."""
+        return self.mconn.send(channel_id, msg_bytes, timeout=timeout,
+                               tctx=tctx)
 
-    def try_send(self, channel_id: int, msg_bytes: bytes) -> bool:
-        return self.mconn.try_send(channel_id, msg_bytes)
+    def try_send(self, channel_id: int, msg_bytes: bytes,
+                 tctx=None) -> bool:
+        return self.mconn.try_send(channel_id, msg_bytes, tctx=tctx)
 
     # per-peer key/value store (reactors stash PeerState here)
     def set(self, key: str, value) -> None:

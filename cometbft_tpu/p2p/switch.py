@@ -135,12 +135,12 @@ class Switch(BaseService):
                        socket_addr: str = "") -> Peer:
         peer_ref: list = [None]
 
-        def on_receive(ch_id: int, msg_bytes: bytes) -> None:
+        def on_receive(ch_id: int, msg_bytes: bytes, tctx=None) -> None:
             reactor = self.reactors_by_ch.get(ch_id)
             if reactor is None:
                 raise SwitchError(f"no reactor for channel {ch_id:#x}")
             reactor.receive(Envelope(src=peer_ref[0], message=msg_bytes,
-                                     channel_id=ch_id))
+                                     channel_id=ch_id, tctx=tctx))
 
         def on_error(e: Exception) -> None:
             if peer_ref[0] is not None:

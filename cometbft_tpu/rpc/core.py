@@ -295,6 +295,23 @@ class Environment:
                 out["events"] = out["events"][-n:] if n else []
         return out
 
+    def tracetl_handler(self, limit=None) -> dict:
+        """Dump the node's event timeline (libs/tracetl.py): stage
+        spans, instants, and the cross-node send/recv context edges.
+        `limit` keeps only the newest N events."""
+        tl = getattr(self.consensus_state, "timeline", None)
+        if tl is None:
+            from ..libs import tracetl as _tl
+            tl = _tl.timeline()
+        if tl is None:
+            raise RPCError(-32603, "timeline unavailable")
+        out = tl.dump()
+        if limit:
+            n = int(limit)
+            if n >= 0:
+                out["events"] = out["events"][-n:] if n else []
+        return out
+
     # -- abci --------------------------------------------------------------
     def abci_info(self) -> dict:
         res = self.app_conns.query.info(at.InfoRequest())
@@ -651,6 +668,7 @@ ROUTES = {
     "consensus_state": "consensus_state_handler",
     "dump_consensus_state": "dump_consensus_state_handler",
     "flightrec": "flightrec_handler",
+    "tracetl": "tracetl_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

@@ -142,7 +142,9 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
 def bench_consensus_e2e(n_blocks: int | None = None,
                         n_vals: int | None = None,
                         seed: int = 13,
-                        timeout: float = 300.0) -> dict:
+                        timeout: float = 300.0,
+                        attach_timeline: bool | None = None,
+                        trace_export: str | None = None) -> dict:
     """Live multi-validator consensus over conditioned links: real
     rounds (propose -> prevote -> precommit -> commit) through the
     real reactors, votes pre-verified through the streaming-verifier
@@ -151,12 +153,25 @@ def bench_consensus_e2e(n_blocks: int | None = None,
     device), a round-latency histogram, and per-node flight-recorder
     summaries — the round-level observability record next to the
     blocksync/light e2e extras.  Stores the result in
-    `last_consensus`."""
+    `last_consensus`.
+
+    attach_timeline (SIMNET_TRACE_TIMELINE=1) installs a
+    simnet/tracing.TraceSession over the cluster and adds the
+    proposal->commit critical-path decomposition
+    (`critical_path_device_share` + per-segment summary) to the
+    result; trace_export (SIMNET_TRACE_EXPORT=path) additionally
+    writes the merged Perfetto trace_event JSON there."""
     global last_consensus
     n_blocks = n_blocks if n_blocks is not None else _env_int(
         "SIMNET_CONSENSUS_BLOCKS", 12)
     n_vals = n_vals if n_vals is not None else _env_int(
         "SIMNET_CONSENSUS_VALS", 4)
+    if attach_timeline is None:
+        attach_timeline = os.environ.get(
+            "SIMNET_TRACE_TIMELINE", "0") == "1"
+    if trace_export is None:
+        trace_export = os.environ.get("SIMNET_TRACE_EXPORT") or None
+    attach_timeline = attach_timeline or trace_export is not None
 
     net = SimNetwork(seed=seed)
     net.set_default_link(latency=0.001)
@@ -165,10 +180,15 @@ def bench_consensus_e2e(n_blocks: int | None = None,
                      consensus_active=True, seed=seed)
              for i, p in enumerate(privs)]
 
+    session = None
+    if attach_timeline:
+        from .tracing import TraceSession
+        session = TraceSession().install(nodes)
     prev_tracer = libtrace.tracer()
     tr = libtrace.StageTracer(
         metrics=prev_tracer.metrics if prev_tracer else None)
     libtrace.set_tracer(tr)
+    trace = None
     try:
         for n in nodes:
             n.start()
@@ -188,6 +208,9 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         lats = sorted(lat for n in nodes for lat in n.round_latencies())
         for n in nodes:
             n.stop()
+        if session is not None:
+            trace = session.export()
+            session.uninstall()
     if not all(n.height() >= n_blocks for n in nodes):
         raise RuntimeError(
             "consensus e2e stalled at "
@@ -209,6 +232,14 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         },
         "recorders": summaries,
     }
+    if trace is not None:
+        from ..libs import tracetl
+        if trace_export:
+            tracetl.write_trace(trace_export, trace)
+        cp = tracetl.critical_path(trace)
+        last_consensus["critical_path"] = cp["summary"]
+        last_consensus["critical_path_device_share"] = \
+            cp["summary"]["device_share"]
     return last_consensus
 
 

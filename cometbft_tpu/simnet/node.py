@@ -174,6 +174,9 @@ class SimNode:
         from ..libs.flightrec import FlightRecorder
         self.flight_recorder = FlightRecorder()
         self.consensus_state.recorder = self.flight_recorder
+        # per-node event timeline (libs/tracetl.py), installed by
+        # simnet/tracing.TraceSession; None = uninstrumented
+        self.timeline = None
         # an inactive consensus reactor still gossips/receives (real
         # wiring) but never starts the state machine
         self.consensus_reactor = ConsensusReactor(

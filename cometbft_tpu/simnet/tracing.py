@@ -1,0 +1,123 @@
+"""TraceSession: per-node event timelines over a simnet cluster.
+
+Many SimNodes share one process, so the tracetl process seam alone
+cannot attribute events to nodes.  The session gives every node its own
+Timeline and hangs it on the node-owned objects that carry a `timeline`
+attribute override (consensus state, consensus reactor, blocksync
+reactor), plus one shared "crypto" timeline installed as the process
+seam for the layers below node wiring (crypto/dispatch staging/device
+threads, votestream flushes) — those are process-global engines, so
+their spans land in a cluster-wide pseudo-node rather than being
+misattributed to whichever node installed last.
+
+export() merges everything into one Chrome/Perfetto trace_event JSON
+(tracetl.perfetto_trace): one "process" per node, flow events for every
+cross-node trace-context edge the simnet wire carried.  Flight-recorder
+events are folded in per node at export time (clock-compatible — see
+tracetl's module docstring), incrementally by seq so repeated exports
+never double-ingest.
+
+Usage::
+
+    with TraceSession().install(nodes) as ts:
+        ... run the cluster ...
+        trace = ts.export()
+    tracetl.write_trace("run.trace.json", trace)
+    cp = tracetl.critical_path(trace)
+"""
+
+from __future__ import annotations
+
+from ..libs import tracetl
+
+# node-owned objects that honor a per-object `timeline` override
+_NODE_SLOTS = ("consensus_state", "consensus_reactor",
+               "blocksync_reactor")
+
+
+class TraceSession:
+    """Attach/detach timelines on a set of SimNodes; export merged."""
+
+    def __init__(self, capacity: int = tracetl.DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self.timelines: dict[str, tracetl.Timeline] = {}
+        self.crypto_timeline: tracetl.Timeline | None = None
+        self._nodes: list = []
+        self._saved: list[tuple] = []       # (obj, prev timeline attr)
+        self._prev_seam: tracetl.Timeline | None = None
+        self._installed = False
+        self._flightrec_seq: dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self, nodes) -> "TraceSession":
+        if self._installed:
+            raise RuntimeError("TraceSession already installed")
+        self._nodes = list(nodes)
+        for node in self._nodes:
+            tl = tracetl.Timeline(node=node.name, capacity=self.capacity)
+            self.timelines[node.name] = tl
+            node.timeline = tl
+            for slot in _NODE_SLOTS:
+                obj = getattr(node, slot, None)
+                if obj is None:
+                    continue
+                self._saved.append((obj, getattr(obj, "timeline", None)))
+                obj.timeline = tl
+        self.crypto_timeline = tracetl.Timeline(
+            node="crypto", capacity=self.capacity)
+        self._prev_seam = tracetl.timeline()
+        tracetl.set_timeline(self.crypto_timeline)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for obj, prev in self._saved:
+            obj.timeline = prev
+        self._saved = []
+        for node in self._nodes:
+            if getattr(node, "timeline", None) in self.timelines.values():
+                node.timeline = None
+        tracetl.set_timeline(self._prev_seam)
+        self._prev_seam = None
+        self._installed = False
+
+    def __enter__(self) -> "TraceSession":
+        if not self._installed:
+            raise RuntimeError("call install(nodes) before entering")
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
+    # -- export ------------------------------------------------------------
+    def _fold_flightrec(self) -> None:
+        """Merge each node's flight-recorder events into its timeline,
+        incrementally by seq (safe to call per export)."""
+        for node in self._nodes:
+            rec = getattr(node, "flight_recorder", None)
+            if rec is None:
+                continue
+            tl = self.timelines[node.name]
+            last = self._flightrec_seq.get(node.name, -1)
+            new = [e for e in rec.events() if e["seq"] > last]
+            if new:
+                tl.ingest_flightrec(new)
+                self._flightrec_seq[node.name] = new[-1]["seq"]
+
+    def export(self, include_flightrec: bool = True) -> dict:
+        """The merged multi-node Perfetto trace (tracetl.perfetto_trace
+        shape).  Works during and after the run."""
+        if include_flightrec:
+            self._fold_flightrec()
+        merged = dict(self.timelines)
+        if self.crypto_timeline is not None \
+                and len(self.crypto_timeline):
+            merged["crypto"] = self.crypto_timeline
+        return tracetl.perfetto_trace(merged)
+
+    def critical_path(self, include_flightrec: bool = True) -> dict:
+        """Convenience: export + proposal->commit decomposition."""
+        return tracetl.critical_path(self.export(include_flightrec))

@@ -29,6 +29,7 @@ run, matching transport.upgrade's gate order).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import queue
 import random
@@ -269,6 +270,12 @@ class _SimConn:
         # one-slot (frame, delay) buffer for the link's pairwise
         # reorder fault; written only from this endpoint's sender thread
         self._reorder_hold: tuple | None = None
+        # trace contexts (libs/tracetl.py) delivered with frames but
+        # not yet claimed by a completed message.  Touched only by the
+        # reader thread (read() stashes, pop_recv_ctx() claims), so no
+        # lock; bounded so a non-popping consumer cannot leak
+        self._recv_ctxs: collections.deque = collections.deque(
+            maxlen=4096)
 
     # -- receiving side plumbing (called by the OTHER endpoint) -----------
     def _deliver(self, frame, delay: float) -> None:
@@ -307,11 +314,32 @@ class _SimConn:
         self._link.send(self, data)
         return len(data)
 
+    def write_with_ctx(self, data: bytes, ctxs: list) -> int:
+        """Ship the frame together with its per-message trace-context
+        list: one _Link.send, so drops/dups/reorders condition frame
+        and contexts as a unit and the receiver's per-message FIFO
+        stays aligned under every fault the link can inject."""
+        self._link.send(self, (data, tuple(ctxs)))
+        return len(data)
+
+    def pop_recv_ctx(self):
+        """Claim the next delivered trace context (None when the frame
+        carried none for this message or ctxs are not flowing)."""
+        try:
+            return self._recv_ctxs.popleft()
+        except IndexError:
+            return None
+
     def read(self) -> bytes:
         item = self._inbox.get()
         if item is _CLOSED:
             self._inbox.put(_CLOSED)     # every later read also EOFs
             return b""
+        if type(item) is tuple:          # (frame, trace-context list)
+            data, ctxs = item
+            if ctxs:
+                self._recv_ctxs.extend(ctxs)
+            return data
         return item
 
     def close(self) -> None:

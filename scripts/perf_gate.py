@@ -1,0 +1,174 @@
+"""Performance regression gate over the committed BENCH_r*.json
+trajectory.
+
+Each round's bench record (bench.py output, committed as
+BENCH_r<NN>.json) carries a headline metric (`parsed.value`) and the
+per-subsystem extras (`parsed.extra`: blocksync_blocks_per_sec,
+light_client_headers_per_sec, critical_path_device_share, ...).  The
+gate compares the LATEST record against the median of the last N prior
+records per metric and exits non-zero when any higher-is-better metric
+fell more than --tolerance below its trajectory (or a lower-is-better
+one rose above it).  Metrics need at least --min-points prior data
+points to gate — a metric that first appears this round passes
+trivially, so adding a new bench extra never blocks the round that
+introduces it.
+
+Usage:
+    python scripts/perf_gate.py --check-only
+        gate the newest committed BENCH_r*.json against the rest
+    python scripts/perf_gate.py --current BENCH_live.json
+        gate a fresh (uncommitted) record against the whole trajectory
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metrics where smaller is the improvement
+LOWER_IS_BETTER = {"chaos_recovery_seconds"}
+# non-metric extras (configs, notes, lists) are skipped by the numeric
+# filter; these numerics are ratios/counters, not rates to gate on
+SKIP = {"rlc_batch", "headline_passes", "vs_baseline"}
+
+
+def load_record(path: str) -> dict | None:
+    """Flatten one bench JSON into {metric: float}; None when the round
+    produced no parsed result (rc != 0 runs are committed too)."""
+    with open(path) as f:
+        rec = json.load(f)
+    parsed = rec.get("parsed")
+    if not isinstance(parsed, dict) or parsed.get("value") is None:
+        return None
+    out = {"headline": float(parsed["value"])}
+    for k, v in (parsed.get("extra") or {}).items():
+        if k in SKIP:
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def trajectory(root: str) -> list[tuple[str, dict]]:
+    """(path, metrics) for every parseable BENCH_r*.json, round order."""
+    paths = sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
+                          .group(1)))
+    out = []
+    for p in paths:
+        m = load_record(p)
+        if m is not None:
+            out.append((p, m))
+    return out
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def gate(current: dict, history: list[dict], tolerance: float,
+         last_n: int, min_points: int) -> list[dict]:
+    """Compare `current` against the trajectory; returns a report row
+    per metric with status ok / regressed / skipped."""
+    rows = []
+    for metric, value in sorted(current.items()):
+        prior = [h[metric] for h in history if metric in h][-last_n:]
+        if len(prior) < min_points:
+            rows.append({"metric": metric, "value": value,
+                         "status": "skipped",
+                         "reason": f"{len(prior)} prior point(s)"})
+            continue
+        base = _median(prior)
+        if base == 0:
+            rows.append({"metric": metric, "value": value,
+                         "status": "skipped", "reason": "zero baseline"})
+            continue
+        if metric in LOWER_IS_BETTER:
+            regressed = value > base * (1.0 + tolerance)
+        else:
+            regressed = value < base * (1.0 - tolerance)
+        rows.append({"metric": metric, "value": value,
+                     "baseline": round(base, 4),
+                     "delta_pct": round((value / base - 1.0) * 100, 2),
+                     "status": "regressed" if regressed else "ok"})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trajectory regression gate")
+    ap.add_argument("--root", default=ROOT,
+                    help="directory holding BENCH_r*.json")
+    ap.add_argument("--check-only", action="store_true",
+                    help="gate the newest committed record against the "
+                         "prior ones (no fresh bench run needed)")
+    ap.add_argument("--current", metavar="PATH",
+                    help="gate this record (e.g. BENCH_live.json) "
+                         "against the whole committed trajectory")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed fractional drop below the trajectory "
+                         "median (default 0.15)")
+    ap.add_argument("--last-n", type=int, default=3,
+                    help="trajectory window: median of the last N "
+                         "prior values (default 3)")
+    ap.add_argument("--min-points", type=int, default=2,
+                    help="prior data points a metric needs before it "
+                         "gates (default 2)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    traj = trajectory(args.root)
+    if args.current:
+        current = load_record(args.current)
+        if current is None:
+            print(f"perf_gate: {args.current} has no parsed result",
+                  file=sys.stderr)
+            return 2
+        history = [m for _, m in traj]
+        label = args.current
+    else:
+        if not args.check_only:
+            print("perf_gate: pass --check-only or --current PATH",
+                  file=sys.stderr)
+            return 2
+        if not traj:
+            print("perf_gate: no parseable BENCH_r*.json found",
+                  file=sys.stderr)
+            return 2
+        label, current = traj[-1]
+        history = [m for _, m in traj[:-1]]
+
+    rows = gate(current, history, args.tolerance, args.last_n,
+                args.min_points)
+    regressions = [r for r in rows if r["status"] == "regressed"]
+    if args.json:
+        print(json.dumps({"record": os.path.basename(label),
+                          "rows": rows,
+                          "regressed": len(regressions)}, indent=2))
+    else:
+        print(f"perf_gate: {os.path.basename(label)} vs last "
+              f"{args.last_n} (tolerance {args.tolerance:.0%})")
+        for r in rows:
+            if r["status"] == "skipped":
+                print(f"  - {r['metric']:<36} {r['value']:>14.2f}  "
+                      f"skipped ({r['reason']})")
+            else:
+                print(f"  - {r['metric']:<36} {r['value']:>14.2f}  "
+                      f"{r['status']} ({r['delta_pct']:+.1f}% vs "
+                      f"{r['baseline']})")
+        print(f"perf_gate: {len(regressions)} regression(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

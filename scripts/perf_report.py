@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -111,7 +112,55 @@ def build_section() -> str:
     return "\n".join(lines)
 
 
+def critical_path_report(paths: list[str]) -> None:
+    """--critical-path mode: print the proposal->commit decomposition
+    (scripts/trace_report.py summary shape, or a raw TraceSession
+    export) next to the committed headline trajectory, so the device
+    share trend reads in one place."""
+    import glob
+    import re
+
+    heads = []
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+            v = (rec.get("parsed") or {}).get("value")
+            share = ((rec.get("parsed") or {}).get("extra") or {}) \
+                .get("critical_path_device_share")
+        except (json.JSONDecodeError, OSError):
+            continue
+        n = re.search(r"r(\d+)", os.path.basename(p))
+        if v is not None:
+            heads.append((n.group(1) if n else "?", v, share))
+    if heads:
+        print("headline trajectory (BENCH_r*.json):")
+        for rnd, v, share in heads:
+            share_s = f"  device_share={share:.1%}" \
+                if isinstance(share, (int, float)) else ""
+            print(f"  r{rnd}: {fmt(v)} sigs/s{share_s}")
+        print()
+    for path in paths:
+        with open(path) as f:
+            data = json.load(f)
+        if "traceEvents" in data:       # raw export: decompose here
+            sys.path.insert(0, ROOT)
+            from cometbft_tpu.libs import tracetl
+            data = tracetl.critical_path(data)["summary"]
+        print(f"{os.path.basename(path)}: "
+              f"{data.get('heights', 0)} heights, "
+              f"wall {data.get('wall_seconds_total', 0.0):.3f}s, "
+              f"device share {data.get('device_share', 0.0):.1%}")
+        for seg, s in sorted((data.get("segments") or {}).items()):
+            print(f"  - {seg:<10} total={s['total_seconds']:.4f}s "
+                  f"p50={s['p50']:.4f}s p99={s['p99']:.4f}s")
+
+
 def main() -> None:
+    if "--critical-path" in sys.argv[1:]:
+        args = [a for a in sys.argv[1:] if a != "--critical-path"]
+        critical_path_report(args)
+        return
     with open(PERF) as f:
         text = f.read()
     section = build_section()

@@ -1,0 +1,67 @@
+"""Decompose an exported multi-node timeline into per-height
+proposal->commit critical-path segments.
+
+Input is the Perfetto trace_event JSON that simnet/tracing.TraceSession
+(or bench_consensus_e2e with SIMNET_TRACE_EXPORT) writes; the
+decomposition itself is libs/tracetl.critical_path — a prioritized
+sweep PARTITION of each committed height's window over every node's
+merged spans, so the gossip/collect/host_pack/device/apply segments sum
+to the measured wall time exactly.
+
+Usage:
+    python scripts/trace_report.py run.trace.json
+        summary JSON (heights, per-segment totals + p50/p99,
+        device_share) on stdout
+    python scripts/trace_report.py run.trace.json --jsonl heights.jsonl
+        additionally writes one JSON line per committed height
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cometbft_tpu.libs import tracetl  # noqa: E402
+
+
+def report(trace: dict) -> dict:
+    return tracetl.critical_path(trace)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="proposal->commit critical-path decomposition "
+                    "of an exported timeline trace")
+    ap.add_argument("trace", help="Perfetto trace_event JSON "
+                    "(simnet/tracing.TraceSession export)")
+    ap.add_argument("--jsonl", metavar="PATH",
+                    help="write one JSON line per committed height")
+    ap.add_argument("--summary-out", metavar="PATH",
+                    help="write the aggregate summary JSON here "
+                         "(default: stdout)")
+    args = ap.parse_args(argv)
+
+    with open(args.trace) as f:
+        trace = json.load(f)
+    cp = report(trace)
+
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for rec in cp["per_height"]:
+                f.write(json.dumps(rec) + "\n")
+    out = json.dumps(cp["summary"], indent=2, sort_keys=True)
+    if args.summary_out:
+        with open(args.summary_out, "w") as f:
+            f.write(out + "\n")
+    else:
+        print(out)
+    return 0 if cp["summary"]["heights"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -366,6 +366,73 @@ class TestDumpEndpoints:
         with pytest.raises(RPCError):
             env2.flightrec_handler()
 
+    def test_verify_flush_and_drain_carry_trace_context(self):
+        """A trace context submitted with a verify window surfaces as
+        origin/height/round on EV_VERIFY_FLUSH, EV_DEVICE_FALLBACK and
+        EV_PIPELINE_DRAIN — the cross-reference that lets an operator
+        join the flight recorder onto the tracetl timeline."""
+        from cometbft_tpu.crypto import dispatch as vd
+        from cometbft_tpu.libs import tracetl
+        from tests.test_dispatch import make_items
+
+        prev = flightrec.recorder()
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        ctx = tracetl.make_ctx("val7", 42, 1, 9)
+
+        def boom(win):
+            raise RuntimeError("injected device fault")
+
+        try:
+            with vd.VerifyPipeline(depth=2, dispatch_fn=boom) as pipe:
+                h = pipe.submit(make_items(4, seed=2),
+                                subsystem="consensus", ctx=ctx,
+                                device_threshold=1)
+                ok, verdicts = h.result(timeout=60)
+        finally:
+            flightrec.set_recorder(prev)
+        assert ok and all(verdicts)       # drained to host verdicts
+        evs = rec.events()
+        by_kind = {}
+        for e in evs:
+            by_kind.setdefault(e["kind"], []).append(e)
+        for kind in (flightrec.EV_VERIFY_FLUSH,
+                     flightrec.EV_DEVICE_FALLBACK,
+                     flightrec.EV_PIPELINE_DRAIN):
+            assert by_kind.get(kind), f"no {kind} event"
+            for e in by_kind[kind]:
+                assert e["origin"] == "val7"
+                assert e["height"] == 42 and e["round"] == 1
+
+    def test_votestream_host_flush_carries_trace_context(self):
+        from cometbft_tpu.crypto.votestream import StreamingVerifier
+        from cometbft_tpu.libs import tracetl
+
+        prev = flightrec.recorder()
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        priv = PrivKey.generate(b"\x21" * 32)
+        msg = b"ctx-carrying-vote"
+        try:
+            sv = StreamingVerifier(flush_interval=0.005,
+                                   device_threshold=1 << 30,
+                                   warmup=False)
+            sv.start()
+            try:
+                fut = sv.submit(priv.pub_key().bytes(), msg,
+                                priv.sign(msg),
+                                ctx=tracetl.make_ctx("val1", 7, 0, 1))
+                assert fut.result(timeout=10) is True
+            finally:
+                sv.stop()
+        finally:
+            flightrec.set_recorder(prev)
+        flushes = [e for e in rec.events()
+                   if e["kind"] == flightrec.EV_VERIFY_FLUSH]
+        assert flushes
+        assert flushes[0]["origin"] == "val1"
+        assert flushes[0]["height"] == 7 and flushes[0]["round"] == 0
+
     def test_pprof_flightrec_endpoint(self):
         from cometbft_tpu.libs.pprof import PprofServer
         prev = flightrec.recorder()

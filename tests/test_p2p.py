@@ -211,8 +211,9 @@ class TestMConnection:
         # queue before starting the sender so selection happens together
         # (whitebox: try_send refuses while stopped, as the reference does)
         for i in range(20):
-            ma._channels[0x01].send_queue.put_nowait(b"low%d" % i)
-            ma._channels[0x02].send_queue.put_nowait(b"high%d" % i)
+            # queue entries are (msg_bytes, trace_ctx_or_None)
+            ma._channels[0x01].send_queue.put_nowait((b"low%d" % i, None))
+            ma._channels[0x02].send_queue.put_nowait((b"high%d" % i, None))
         mb.start()
         ma.start()
         ma._send_signal.set()

@@ -246,6 +246,27 @@ class TestCheckMetrics:
                      "message_receive_bytes_total"):
             assert ("p2p", want) in names, want
 
+    def test_trace_ring_overflow_counter_is_linted(self, monkeypatch):
+        """The StageTracer ring-overflow counter
+        (trace_intervals_dropped_total) is registered AND observed —
+        the lint proves libs/trace.py actually drives it on eviction,
+        so silent interval loss shows on dashboards."""
+        mod = self._load()
+        metrics = {(m["subsystem"], m["name"]): m
+                   for m in mod.registered_metrics()}
+        m = metrics.get(("trace", "intervals_dropped_total"))
+        assert m is not None and m["kind"] == "counter"
+        assert m["attr"] == "intervals_dropped"
+        assert mod.run_checks() == []
+        # and the counter really counts: overflow a tiny ring
+        from cometbft_tpu.libs import trace as libtrace
+        monkeypatch.setattr(libtrace, "MAX_INTERVALS", 2)
+        tr = libtrace.StageTracer()
+        for i in range(5):
+            tr.record("s", "st", 0.5)
+        assert tr.dropped_intervals == 3
+        assert len(tr.intervals()) == 2
+
     def test_parser_flags_bad_bundles(self, tmp_path):
         mod = self._load()
         bad = tmp_path / "m.py"
@@ -260,6 +281,95 @@ class TestCheckMetrics:
         full = [f"{m['subsystem']}_{m['name']}" for m in metrics]
         assert full.count("c_dup") == 2
         assert not mod.SNAKE.match("CamelCase")
+
+
+class TestPerfGate:
+    """scripts/perf_gate.py: the bench-trajectory regression gate runs
+    as a tier-1 test so a perf cliff fails CI before a round lands."""
+
+    @staticmethod
+    def _load():
+        import importlib.util
+        import pathlib
+        path = pathlib.Path(__file__).resolve().parent.parent / \
+            "scripts" / "perf_gate.py"
+        spec = importlib.util.spec_from_file_location("perf_gate", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @staticmethod
+    def _write(dirpath, name, value, extra=None):
+        import json
+        (dirpath / name).write_text(json.dumps(
+            {"n": 1, "rc": 0,
+             "parsed": {"metric": "sigs_per_sec", "value": value,
+                        "unit": "sigs/s", "extra": extra or {}}}))
+
+    def test_committed_trajectory_gates_clean(self, capsys):
+        """The repo's own BENCH_r*.json history must pass its own
+        gate — this is the check the driver runs every round."""
+        mod = self._load()
+        assert mod.main(["--check-only"]) == 0
+        out = capsys.readouterr().out
+        assert "0 regression(s)" in out
+
+    def test_gate_flags_regression_and_direction(self):
+        mod = self._load()
+        history = [{"headline": 100.0, "chaos_recovery_seconds": 10.0}
+                   for _ in range(3)]
+        rows = mod.gate({"headline": 80.0,
+                         "chaos_recovery_seconds": 20.0,
+                         "brand_new_metric": 5.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        # higher-is-better fell 20% > 15% tolerance
+        assert by["headline"]["status"] == "regressed"
+        # lower-is-better ROSE — also a regression
+        assert by["chaos_recovery_seconds"]["status"] == "regressed"
+        # a metric with no history never blocks the round adding it
+        assert by["brand_new_metric"]["status"] == "skipped"
+        ok = mod.gate({"headline": 90.0}, history, tolerance=0.15,
+                      last_n=3, min_points=2)
+        assert ok[0]["status"] == "ok"      # -10% inside tolerance
+
+    def test_median_window_absorbs_one_outlier(self):
+        mod = self._load()
+        history = [{"headline": v} for v in
+                   (100.0, 5.0, 100.0, 100.0)]     # one bad round
+        rows = mod.gate({"headline": 95.0}, history,
+                        tolerance=0.15, last_n=3, min_points=2)
+        assert rows[0]["status"] == "ok"
+        assert rows[0]["baseline"] == 100.0        # median, not mean
+
+    def test_current_record_cli(self, tmp_path):
+        mod = self._load()
+        for i, v in enumerate((100.0, 102.0, 98.0), start=1):
+            self._write(tmp_path, f"BENCH_r0{i}.json", v,
+                        extra={"blocksync_blocks_per_sec": 50.0,
+                               "rlc_batch": 131071})
+        bad = tmp_path / "BENCH_live.json"
+        self._write(tmp_path, "BENCH_live.json", 50.0)
+        assert mod.main(["--root", str(tmp_path),
+                         "--current", str(bad)]) == 1
+        good = tmp_path / "BENCH_good.json"
+        self._write(tmp_path, "BENCH_good.json", 99.0)
+        assert mod.main(["--root", str(tmp_path),
+                         "--current", str(good), "--json"]) == 0
+        # config numerics (rlc_batch) never gate
+        traj = mod.trajectory(str(tmp_path))
+        assert all("rlc_batch" not in m for _, m in traj)
+
+    def test_usage_errors_exit_2(self, tmp_path):
+        import json
+        mod = self._load()
+        assert mod.main(["--root", str(tmp_path)]) == 2   # no mode
+        assert mod.main(["--root", str(tmp_path),
+                         "--check-only"]) == 2            # no records
+        unparsed = tmp_path / "BENCH_broken.json"
+        unparsed.write_text(json.dumps({"rc": 124, "parsed": None}))
+        assert mod.main(["--current", str(unparsed)]) == 2
 
 
 class TestMultichipDryrunBudget:

@@ -1,0 +1,477 @@
+"""Cross-node event timeline (libs/tracetl.py), the simnet
+TraceSession (simnet/tracing.py), and the critical-path decomposition
+(scripts/trace_report.py): ring semantics, the no-op seam contract,
+Perfetto export shape, causal flow edges over the simnet wire, and the
+proposal->commit segment partition.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.libs import tracetl
+
+
+class TestTimelineRing:
+    """Same bounded-ring discipline as the flight recorder."""
+
+    def test_records_and_orders_events(self):
+        tl = tracetl.Timeline(node="n0", capacity=16)
+        tl.span("consensus", "propose", 1.0, 1.5, round=0)
+        tl.instant("consensus", "commit", t=2.0, height=3)
+        ctx = tl.ctx(3, 0)
+        tl.send("consensus", "BlockPart", ctx, part=1)
+        tl.recv("consensus", "BlockPart", ctx)
+        evs = tl.events()
+        assert [e["ph"] for e in evs] == ["span", "instant", "send",
+                                         "recv"]
+        assert [e["seq"] for e in evs] == [0, 1, 2, 3]
+        assert evs[0]["dur"] == pytest.approx(0.5)
+        assert evs[0]["round"] == 0
+        assert evs[1]["height"] == 3
+        assert evs[2]["ctx"] == list(ctx) == evs[3]["ctx"]
+        assert evs[2]["part"] == 1
+
+    def test_wraparound_counts_dropped(self):
+        tl = tracetl.Timeline(node="n0", capacity=4)
+        for i in range(10):
+            tl.instant("s", "e", t=float(i), i=i)
+        assert tl.recorded == 10 and tl.dropped == 6 and len(tl) == 4
+        evs = tl.events()
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]   # oldest kept
+        assert [e["seq"] for e in evs] == [6, 7, 8, 9]
+        d = tl.dump()
+        assert d["node"] == "n0" and d["dropped"] == 6
+        assert d["capacity"] == 4 and len(d["events"]) == 4
+        tl.clear()
+        assert tl.recorded == 0 and len(tl.events()) == 0
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            tracetl.Timeline(capacity=0)
+
+    def test_dump_text_readable(self):
+        tl = tracetl.Timeline(node="val2", capacity=8)
+        tl.instant("consensus", "proposal", t=0.25, height=9)
+        text = tl.dump_text()
+        assert "timeline val2: 1 recorded" in text
+        assert "consensus.proposal" in text and "height=9" in text
+
+    def test_ctx_minting_unique_and_attributed(self):
+        tl = tracetl.Timeline(node="val0")
+        a, b = tl.ctx(5, 0), tl.ctx(5, 0)
+        assert a[0] == b[0] == "val0"
+        assert a[:3] == ("val0", 5, 0)
+        assert a[3] != b[3]             # per-node seq disambiguates
+
+
+class TestCtxHelpers:
+    def test_ctx_fields_flattens(self):
+        ctx = tracetl.make_ctx("val1", 7, 2, 44)
+        assert tracetl.ctx_fields(ctx) == {
+            "origin": "val1", "height": 7, "round": 2}
+
+    def test_ctx_fields_rejects_non_contexts(self):
+        # None, short tuples, lists: all degrade to no fields, never
+        # raise — these flow through hot paths on every flush
+        for bad in (None, (), ("a", 1), ["a", 1, 2, 3], "x", 7):
+            assert tracetl.ctx_fields(bad) == {}
+
+
+class TestSeam:
+    """The cost contract: uninstalled == no-op, per-object attribute
+    beats the process-wide seam (multi-node attribution in one
+    process)."""
+
+    def test_span_for_without_timeline_is_null(self):
+        prev = tracetl.timeline()
+        tracetl.set_timeline(None)
+        try:
+            span = tracetl.span_for(object(), "s", "stage")
+            assert span is tracetl._NULL_SPAN
+            with span:
+                pass
+            tracetl.instant("s", "e", x=1)      # no-raise, no record
+        finally:
+            tracetl.set_timeline(prev)
+
+    def test_owner_attribute_overrides_seam(self):
+        class Owner:
+            pass
+
+        seam_tl = tracetl.Timeline(node="seam")
+        own_tl = tracetl.Timeline(node="own")
+        owner = Owner()
+        prev = tracetl.timeline()
+        tracetl.set_timeline(seam_tl)
+        try:
+            assert tracetl.active(owner) is seam_tl
+            owner.timeline = own_tl
+            assert tracetl.active(owner) is own_tl
+            with tracetl.span_for(owner, "s", "stage", k=1):
+                pass
+            with tracetl.span_for(None, "s", "other"):
+                pass
+        finally:
+            tracetl.set_timeline(prev)
+        assert [e["name"] for e in own_tl.events()] == ["stage"]
+        assert own_tl.events()[0]["k"] == 1
+        assert [e["name"] for e in seam_tl.events()] == ["other"]
+
+    def test_ingest_intervals_and_flightrec(self):
+        tl = tracetl.Timeline(node="n")
+        tl.ingest_intervals([
+            {"subsystem": "blocksync", "stage": "apply",
+             "start": 1.0, "end": 1.25, "height": 4}])
+        tl.ingest_flightrec([
+            {"seq": 0, "t": 1.1, "kind": "new_height", "height": 4}])
+        evs = tl.events()
+        assert evs[0]["ph"] == "span" and evs[0]["height"] == 4
+        assert evs[0]["dur"] == pytest.approx(0.25)
+        assert evs[1]["ph"] == "instant"
+        assert evs[1]["name"] == "new_height" and evs[1]["t"] == 1.1
+
+
+def _mini_timelines():
+    """Two hand-built node timelines with one cross-node edge."""
+    a = tracetl.Timeline(node="a")
+    b = tracetl.Timeline(node="b")
+    ctx = a.ctx(1, 0)
+    a.instant("consensus", "proposal", t=10.0, height=1)
+    a.span("consensus", "propose", 10.0, 10.1, height=1)
+    a.send("consensus", "BlockPart", ctx)
+    b.recv("consensus", "BlockPart", ctx)
+    b.span("crypto", "device", 10.2, 10.3, height=1)
+    b.instant("consensus", "commit", t=10.5, height=1)
+    return a, b
+
+
+class TestPerfettoExport:
+    def test_export_shape(self):
+        a, b = _mini_timelines()
+        trace = tracetl.perfetto_trace({"a": a, "b": b})
+        assert trace["displayTimeUnit"] == "ms"
+        assert trace["metadata"]["nodes"] == ["a", "b"]
+        assert trace["metadata"]["dropped"] == {"a": 0, "b": 0}
+        evs = trace["traceEvents"]
+        procs = {e["args"]["name"]: e["pid"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert procs == {"a": 1, "b": 2}
+        # one thread_name metadata row per (pid, subsystem)
+        threads = [(e["pid"], e["args"]["name"]) for e in evs
+                   if e["ph"] == "M" and e["name"] == "thread_name"]
+        assert (1, "consensus") in threads and (2, "crypto") in threads
+        # spans become X slices with µs durations
+        spans = [e for e in evs if e["ph"] == "X"
+                 and e["name"] == "propose"]
+        assert spans and spans[0]["dur"] == pytest.approx(1e5)
+        # instants carry their args
+        inst = [e for e in evs if e["ph"] == "i"]
+        assert {e["name"] for e in inst} == {"proposal", "commit"}
+        assert all(e["s"] == "t" for e in inst)
+        # all timestamps rebased to the earliest event
+        assert min(e["ts"] for e in evs if "ts" in e) == 0.0
+
+    def test_flow_edge_binds_send_to_recv(self):
+        a, b = _mini_timelines()
+        trace = tracetl.perfetto_trace([a, b])    # iterable form too
+        evs = trace["traceEvents"]
+        starts = [e for e in evs if e["ph"] == "s"]
+        finishes = [e for e in evs if e["ph"] == "f"]
+        assert len(starts) == 1 and len(finishes) == 1
+        assert starts[0]["id"] == finishes[0]["id"]
+        assert starts[0]["pid"] != finishes[0]["pid"]   # cross-node
+        assert finishes[0]["bp"] == "e"
+        # the send/recv X slices are named by direction
+        names = {e["name"] for e in evs if e["ph"] == "X"}
+        assert "send:BlockPart" in names and "recv:BlockPart" in names
+
+    def test_write_trace_round_trips(self, tmp_path):
+        a, b = _mini_timelines()
+        trace = tracetl.perfetto_trace({"a": a, "b": b})
+        path = tmp_path / "t.json"
+        tracetl.write_trace(str(path), trace)
+        assert json.loads(path.read_text()) == trace
+
+
+class TestCriticalPathSweep:
+    def _trace(self, spans, proposals, commits):
+        """Build a minimal decomposable trace: spans are (name, lo,
+        hi) in seconds; proposals/commits are {height: t}."""
+        evs = []
+        for name, lo, hi in spans:
+            evs.append({"ph": "X", "name": name, "cat": "s", "pid": 1,
+                        "tid": 1, "ts": lo * 1e6,
+                        "dur": (hi - lo) * 1e6, "args": {}})
+        for h, t in proposals.items():
+            evs.append({"ph": "i", "name": "proposal", "ts": t * 1e6,
+                        "pid": 1, "tid": 1, "args": {"height": h}})
+        for h, t in commits.items():
+            evs.append({"ph": "i", "name": "commit", "ts": t * 1e6,
+                        "pid": 1, "tid": 1, "args": {"height": h}})
+        return {"traceEvents": evs}
+
+    def test_partition_sums_to_wall_exactly(self):
+        trace = self._trace(
+            [("collect", 0.0, 0.4), ("device", 0.3, 0.5),
+             ("apply", 0.8, 0.9)],
+            proposals={1: 0.0}, commits={1: 1.0})
+        cp = tracetl.critical_path(trace)
+        row = cp["per_height"][0]
+        assert row["height"] == 1
+        assert row["wall_seconds"] == pytest.approx(1.0)
+        segs = row["segments"]
+        # device outranks collect in the overlap [0.3, 0.4]
+        assert segs["device"] == pytest.approx(0.2)
+        assert segs["collect"] == pytest.approx(0.3)
+        assert segs["apply"] == pytest.approx(0.1)
+        assert segs["gossip"] == pytest.approx(0.4)   # residual
+        assert sum(segs.values()) == pytest.approx(row["wall_seconds"])
+        assert cp["summary"]["device_share"] == pytest.approx(0.2)
+
+    def test_window_is_earliest_proposal_to_latest_commit(self):
+        # spans outside the window are clipped; heights without a
+        # proposal (or with commit <= proposal) are skipped
+        trace = self._trace(
+            [("device", -1.0, 0.25)],
+            proposals={1: 0.0, 2: 5.0}, commits={1: 0.5, 2: 4.0})
+        cp = tracetl.critical_path(trace)
+        assert [r["height"] for r in cp["per_height"]] == [1]
+        assert cp["per_height"][0]["segments"]["device"] == \
+            pytest.approx(0.25)
+
+    def test_deterministic(self):
+        trace = self._trace(
+            [("host_pack", 0.1, 0.3), ("store", 0.2, 0.6)],
+            proposals={1: 0.0}, commits={1: 1.0})
+        assert tracetl.critical_path(trace) == \
+            tracetl.critical_path(trace)
+
+
+# -- the live cluster run ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster_trace(tmp_path_factory):
+    """One seeded 4-validator consensus run with the TraceSession
+    attached; every cluster-level assertion reads this export."""
+    from cometbft_tpu.simnet import bench as simbench
+    path = tmp_path_factory.mktemp("trace") / "run.trace.json"
+    res = simbench.bench_consensus_e2e(
+        n_blocks=3, n_vals=4, seed=13, timeout=120,
+        attach_timeline=True, trace_export=str(path))
+    with open(path) as f:
+        trace = json.load(f)
+    return {"result": res, "trace": trace, "path": str(path)}
+
+
+class TestClusterTrace:
+    def test_bench_carries_critical_path(self, cluster_trace):
+        res = cluster_trace["result"]
+        assert res["blocks"] == 3
+        cp = res["critical_path"]
+        assert cp["heights"] >= 3
+        assert set(cp["segments"]) == set(tracetl.SEGMENTS)
+        assert 0.0 <= res["critical_path_device_share"] <= 1.0
+
+    def test_export_schema(self, cluster_trace):
+        trace = cluster_trace["trace"]
+        assert trace["displayTimeUnit"] == "ms"
+        nodes = trace["metadata"]["nodes"]
+        assert {"cval0", "cval1", "cval2", "cval3"} <= set(nodes)
+        for e in trace["traceEvents"]:
+            assert e["ph"] in ("M", "X", "i", "s", "f"), e
+            if e["ph"] == "X":
+                assert e["dur"] >= 0 and "ts" in e
+            elif e["ph"] in ("s", "f"):
+                assert e["cat"] == "causal" and "id" in e
+
+    def test_cross_node_flow_edge_per_committed_height(
+            self, cluster_trace):
+        """The acceptance bar: every committed height has at least one
+        causal edge whose send and recv sit on DIFFERENT nodes."""
+        trace = cluster_trace["trace"]
+        sends, recvs = {}, {}
+        for e in trace["traceEvents"]:
+            if e["ph"] == "s":
+                sends[e["id"]] = e["pid"]
+            elif e["ph"] == "f":
+                recvs.setdefault(e["id"], set()).add(e["pid"])
+        commits = {e["args"]["height"]
+                   for e in trace["traceEvents"]
+                   if e["ph"] == "i" and e["name"] == "commit"
+                   and isinstance((e.get("args") or {}).get("height"),
+                                  int)}
+        assert len(commits) >= 3
+        # flow id is origin/height/round/seq — parse the height back
+        cross_heights = set()
+        for fid, spid in sends.items():
+            if any(rpid != spid for rpid in recvs.get(fid, ())):
+                cross_heights.add(int(fid.split("/")[1]))
+        missing = {h for h in commits if h > 0} - cross_heights
+        assert not missing, f"no cross-node edge for heights {missing}"
+
+    def test_segment_sum_matches_wall(self, cluster_trace):
+        cp = tracetl.critical_path(cluster_trace["trace"])
+        assert cp["per_height"]
+        for row in cp["per_height"]:
+            # the sweep is a partition: exact up to rounding
+            assert sum(row["segments"].values()) == pytest.approx(
+                row["wall_seconds"], rel=1e-6, abs=1e-4)
+
+    def test_decomposition_deterministic(self, cluster_trace):
+        trace = cluster_trace["trace"]
+        assert tracetl.critical_path(trace) == \
+            tracetl.critical_path(trace)
+
+    def test_trace_report_cli(self, cluster_trace, tmp_path, capsys):
+        import importlib.util
+        import pathlib
+        path = pathlib.Path(__file__).resolve().parent.parent / \
+            "scripts" / "trace_report.py"
+        spec = importlib.util.spec_from_file_location(
+            "trace_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        jsonl = tmp_path / "heights.jsonl"
+        rc = mod.main([cluster_trace["path"],
+                       "--jsonl", str(jsonl)])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["heights"] >= 3
+        assert set(summary["segments"]) == set(tracetl.SEGMENTS)
+        rows = [json.loads(l) for l in
+                jsonl.read_text().splitlines() if l]
+        assert len(rows) == summary["heights"]
+        assert all("wall_seconds" in r for r in rows)
+
+
+class TestTraceSessionLifecycle:
+    def test_install_uninstall_restores(self):
+        from cometbft_tpu.simnet.tracing import TraceSession
+
+        class Slot:
+            timeline = None
+
+        class FakeNode:
+            def __init__(self, name):
+                self.name = name
+                self.consensus_state = Slot()
+                self.consensus_reactor = Slot()
+                self.blocksync_reactor = None     # tolerated
+                self.flight_recorder = None
+
+        prev_seam = tracetl.timeline()
+        marker = tracetl.Timeline(node="marker")
+        tracetl.set_timeline(marker)
+        nodes = [FakeNode("s0"), FakeNode("s1")]
+        try:
+            sess = TraceSession(capacity=64).install(nodes)
+            with sess:
+                assert nodes[0].consensus_state.timeline \
+                    is sess.timelines["s0"]
+                assert nodes[1].consensus_reactor.timeline \
+                    is sess.timelines["s1"]
+                # process seam redirected to the crypto pseudo-node
+                assert tracetl.timeline() is sess.crypto_timeline
+                with pytest.raises(RuntimeError):
+                    sess.install(nodes)           # double install
+            # __exit__ put everything back
+            assert tracetl.timeline() is marker
+            assert nodes[0].consensus_state.timeline is None
+            assert nodes[0].timeline is None
+        finally:
+            tracetl.set_timeline(prev_seam)
+
+    def test_export_folds_flightrec_once(self):
+        from cometbft_tpu.libs.flightrec import FlightRecorder
+        from cometbft_tpu.simnet.tracing import TraceSession
+
+        class Slot:
+            timeline = None
+
+        class FakeNode:
+            name = "f0"
+            consensus_state = Slot()
+            consensus_reactor = None
+            blocksync_reactor = None
+            flight_recorder = FlightRecorder()
+
+        node = FakeNode()
+        node.flight_recorder.record("new_height", height=1)
+        prev_seam = tracetl.timeline()
+        try:
+            sess = TraceSession().install([node])
+            first = sess.export()
+            second = sess.export()      # must not double-ingest
+        finally:
+            sess.uninstall()
+            tracetl.set_timeline(prev_seam)
+        def count(trace):
+            return sum(1 for e in trace["traceEvents"]
+                       if e.get("name") == "new_height")
+        assert count(first) == 1 and count(second) == 1
+
+
+class TestEndpoints:
+    def test_rpc_tracetl_route(self):
+        from cometbft_tpu.rpc.core import Environment, ROUTES, RPCError
+
+        tl = tracetl.Timeline(node="rpc-node")
+        for i in range(5):
+            tl.instant("consensus", "step", t=float(i), i=i)
+
+        class _CS:
+            timeline = tl
+
+        env = Environment(consensus_state=_CS())
+        assert ROUTES["tracetl"] == "tracetl_handler"
+        out = env.tracetl_handler()
+        assert out["node"] == "rpc-node"
+        assert out["recorded"] == 5 and len(out["events"]) == 5
+        assert env.tracetl_handler(limit=2)["events"][-1]["i"] == 4
+        assert len(env.tracetl_handler(limit=2)["events"]) == 2
+        # HTTP query params arrive as strings; "0" means none
+        assert env.tracetl_handler(limit="0")["events"] == []
+
+        class _Bare:
+            timeline = None
+
+        prev = tracetl.timeline()
+        tracetl.set_timeline(None)
+        try:
+            with pytest.raises(RPCError):
+                Environment(consensus_state=_Bare()).tracetl_handler()
+            # seam fallback: a process-wide timeline serves the route
+            tracetl.set_timeline(tl)
+            out = Environment(consensus_state=_Bare()).tracetl_handler()
+            assert out["node"] == "rpc-node"
+        finally:
+            tracetl.set_timeline(prev)
+
+    def test_pprof_tracetl_endpoint(self):
+        from cometbft_tpu.libs.pprof import PprofServer
+
+        prev = tracetl.timeline()
+        tl = tracetl.Timeline(node="pprof-node")
+        tl.instant("consensus", "proposal", t=1.0, height=2)
+        tracetl.set_timeline(tl)
+        srv = PprofServer("127.0.0.1:0")
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{srv.bound_addr}/debug/pprof/tracetl",
+                    timeout=5) as resp:
+                body = resp.read().decode()
+            assert "timeline pprof-node: 1 recorded" in body
+            assert "consensus.proposal" in body and "height=2" in body
+            # uninstalled -> 404, not a crash
+            tracetl.set_timeline(None)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{srv.bound_addr}/debug/pprof/tracetl",
+                    timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+            tracetl.set_timeline(prev)
