@@ -343,6 +343,44 @@ def bench_consensus_e2e() -> dict:
     return simbench.bench_consensus_e2e()
 
 
+def bench_commit_reverify(n_sigs: int | None = None,
+                          iters: int | None = None) -> float:
+    """Warm-cache commit re-verify rate: what the H+1 LastCommit
+    re-validation costs once the process-wide signature-verdict cache
+    (crypto/sigcache.py) holds every verdict.  The first pass is the
+    first-seen verify (populates the cache); the timed passes measure
+    partition() over the same triples — pure SHA-256 keying + striped
+    LRU hits, no device dispatch, no curve math.  Sizes via
+    SIGCACHE_BENCH_SIGS / SIGCACHE_BENCH_ITERS (defaults 1024 x 50)."""
+    from cometbft_tpu.crypto import sigcache
+    from cometbft_tpu.crypto.batch import safe_verify
+    from cometbft_tpu.crypto.ed25519 import PrivKey
+
+    n_sigs = n_sigs if n_sigs is not None else int(
+        os.environ.get("SIGCACHE_BENCH_SIGS", "1024"))
+    iters = iters if iters is not None else int(
+        os.environ.get("SIGCACHE_BENCH_ITERS", "50"))
+    prev = sigcache._enabled_override
+    sigcache.set_enabled(True)
+    sigcache.reset()
+    try:
+        items = []
+        for i in range(n_sigs):
+            priv = PrivKey.generate(i.to_bytes(2, "little") + b"\x07" * 30)
+            msg = b"commit-reverify" + i.to_bytes(4, "little")
+            items.append((priv.pub_key(), msg, priv.sign(msg)))
+        assert all(safe_verify(pk, m, s) for pk, m, s in items)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            verdicts, miss_idx = sigcache.partition(items, label="bench")
+            assert not miss_idx and all(verdicts)
+        dt = time.perf_counter() - t0
+        return n_sigs * iters / dt
+    finally:
+        sigcache.set_enabled(prev)
+        sigcache.reset()
+
+
 def bench_chaos() -> dict:
     """Recovery metrics from the chaos nemesis engine (docs/CHAOS.md):
     seeded deterministic fault scenarios over simnet — a partition/heal
@@ -1077,6 +1115,25 @@ def main() -> None:
             carried_keys.discard("critical_path_device_share")
             _sync_carried()
             persist()
+        # the verdict-cache hit rate of the SAME e2e run (higher is
+        # better — perf_gate treats it like every non-LOWER_IS_BETTER
+        # metric); > 0 means the H+1 LastCommit re-validation and
+        # duplicate vote gossip resolved without re-verifying
+        rate = _simbench.last_consensus.get("verdict_cache_hit_rate")
+        if isinstance(rate, (int, float)):
+            extra["verdict_cache_hit_rate"] = rate
+            carried_keys.discard("verdict_cache_hit_rate")
+            _sync_carried()
+            persist()
+    # warm-cache re-verify: the pure-lookup cost a cache hit replaces
+    # the device dispatch with (CPU-only, no kernel warmup needed)
+    run_extra("commit_reverify_sigs_per_sec",
+              lambda: round(bench_commit_reverify(), 1),
+              "commit_reverify_config",
+              "signature-verdict cache warm re-verify: partition()"
+              " over an already-verified commit's triples — SHA-256"
+              " keying + striped LRU hits only (SIGCACHE_BENCH_SIGS x"
+              " SIGCACHE_BENCH_ITERS, defaults 1024 x 50)")
     # chaos recovery metrics: both numbers come from ONE bench_chaos()
     # run (seeded deterministic scenarios, CPU-only — no device time);
     # the second metric and the detail ride the recovery extra's run

@@ -13,6 +13,7 @@ import os
 import threading
 import time
 
+from ..crypto import sigcache
 from ..libs import tracetl
 from ..libs.trace import span as trace_span
 from ..p2p.base_reactor import Envelope, Reactor
@@ -88,18 +89,23 @@ class BlocksyncReactor(Reactor):
             self._pipeline = None
 
     def _get_pipeline(self):
-        if self._pipeline is None or not self._pipeline.is_running():
+        # return the LOCAL reference: on_stop may null self._pipeline
+        # concurrently, and re-reading the attribute here handed the
+        # pool routine a None mid-shutdown
+        pipe = self._pipeline
+        if pipe is None or not pipe.is_running():
             from ..crypto.dispatch import VerifyPipeline
             from ..ops import sharding
             devices = sharding.mesh_device_list(self.mesh_devices
                                                 or None)
             depth = self.pipeline_depth if devices is None else \
                 max(self.pipeline_depth, 2 * len(devices))
-            self._pipeline = VerifyPipeline(
+            pipe = VerifyPipeline(
                 depth=depth, name="blocksync-pipeline",
                 devices=devices if devices is not None else ())
-            self._pipeline.start()
-        return self._pipeline
+            pipe.start()
+            self._pipeline = pipe
+        return pipe
 
     def switch_to_blocksync(self, state) -> None:
         """Begin block-syncing from a statesync-bootstrapped state
@@ -287,8 +293,12 @@ class BlocksyncReactor(Reactor):
                         commits[i], defer_to=batch)
                     verified += 1
                 collecting_h = None
-            # HOT PATH: one device dispatch for the whole window
-            with trace_span("blocksync", "device"):
+            # HOT PATH: one device dispatch for the whole window.
+            # Verdicts land in the process-wide sigcache, so the
+            # apply-time validate_block below (and the NEXT height's
+            # LastCommit check at +1) re-verify for free.
+            with trace_span("blocksync", "device"), \
+                    sigcache.consumer("blocksync"):
                 batch.verify()
         except Exception as e:
             # blame the failing height: a deferred sig failure carries
@@ -333,7 +343,11 @@ class BlocksyncReactor(Reactor):
                                          height=first.header.height):
                     if ext_enabled:
                         first_ext.ensure_extensions(True)
-                    self.block_exec.validate_block(self.state, first)
+                    # all-hits when the window's device dispatch (or a
+                    # live consensus round) already resolved these
+                    # LastCommit triples into the verdict cache
+                    with sigcache.consumer("blocksync"):
+                        self.block_exec.validate_block(self.state, first)
             except Exception:
                 # evict BOTH suppliers (reactor.go:560): the next
                 # block's LastCommit drove the batched verify

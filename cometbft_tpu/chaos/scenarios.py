@@ -46,10 +46,25 @@ def scenario(deterministic=True, tier="fast", broken=False):
 
 
 def run_scenario(name: str, seed: int, artifact_dir=None,
-                 workdir=None, metrics=None, **kwargs) -> ScenarioResult:
+                 workdir=None, metrics=None, cache: bool | None = False,
+                 **kwargs) -> ScenarioResult:
+    """cache: the signature-verdict cache (crypto/sigcache.py) is
+    process-wide, but a chaos cluster simulates SEPARATE processes in
+    one interpreter — with the cache shared, node A's live verdicts
+    make node B's first-ever verify a hit and the device-fault
+    injectors never see a dispatch to fault.  Default False restores
+    per-process realism; pass True to measure chaos WITH the cache
+    (byzantine triples differ per sign-bytes, so verdicts never
+    merge)."""
+    from ..crypto import sigcache
     fn = SCENARIOS[name]["fn"]
-    return fn(seed, artifact_dir=artifact_dir, workdir=workdir,
-              metrics=metrics, **kwargs)
+    prev = sigcache._enabled_override
+    sigcache.set_enabled(cache)
+    try:
+        return fn(seed, artifact_dir=artifact_dir, workdir=workdir,
+                  metrics=metrics, **kwargs)
+    finally:
+        sigcache.set_enabled(prev)
 
 
 def _run(cluster, plan, checkers, artifact_dir, metrics) -> ScenarioResult:
@@ -351,8 +366,16 @@ def bench_chaos(seed: int = 29, blocks: int = 24) -> dict:
     zero expected violations — a violation fails the bench loudly
     rather than shipping a number measured on a broken cluster."""
     global last_chaos
-    r1 = partition_heal(seed, blocks=blocks)
-    r2 = device_fault_drain(seed + 1, blocks=blocks)
+    from ..crypto import sigcache
+    # same per-process realism as run_scenario: the shared in-process
+    # verdict cache would starve the device-fault burst of dispatches
+    prev = sigcache._enabled_override
+    sigcache.set_enabled(False)
+    try:
+        r1 = partition_heal(seed, blocks=blocks)
+        r2 = device_fault_drain(seed + 1, blocks=blocks)
+    finally:
+        sigcache.set_enabled(prev)
     for r in (r1, r2):
         if not r.ok:
             raise RuntimeError(

@@ -21,6 +21,7 @@ import os
 from typing import Protocol
 
 from . import ed25519 as ed
+from . import sigcache
 
 
 class BatchVerifier(Protocol):
@@ -30,7 +31,15 @@ class BatchVerifier(Protocol):
 
 
 class _SigCollector:
-    """Shared add/count scaffolding: items are (pubkey_bytes, msg, sig)."""
+    """Shared add/count scaffolding: items are (pubkey_bytes, msg, sig).
+
+    verify() wraps the subclass _verify_items() and POPULATES the
+    signature-verdict cache with every computed verdict — batch
+    verifiers are a resolution seam (crypto/sigcache.py); consulting
+    is the callers' job (types/validation partitions before building
+    the verifier), so a miss is never double-counted here."""
+
+    KEY_TYPE = "ed25519"
 
     def __init__(self):
         self._items: list[tuple[bytes, bytes, bytes]] = []
@@ -42,12 +51,19 @@ class _SigCollector:
     def count(self) -> int:
         return len(self._items)
 
+    def verify(self) -> tuple[bool, list[bool]]:
+        ok, verdicts = self._verify_items()
+        if self._items:
+            sigcache.insert_many(self._items, verdicts,
+                                 key_type=self.KEY_TYPE)
+        return ok, verdicts
+
 
 class _CpuLoopVerifier(_SigCollector):
     """Host-side per-signature loop (parity oracle for a device path);
     subclasses provide _check(pk, msg, sig) -> bool."""
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    def _verify_items(self) -> tuple[bool, list[bool]]:
         verdicts = []
         for pk, m, s in self._items:
             try:
@@ -72,7 +88,7 @@ class TpuEd25519BatchVerifier(_SigCollector):
     kernel compiles once per bucket; slots past the real batch are masked.
     """
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    def _verify_items(self) -> tuple[bool, list[bool]]:
         if not self._items:
             return False, []
         pks = [i[0] for i in self._items]
@@ -150,6 +166,8 @@ def _device_verify(pubkeys: list[bytes], parsed, packed=_NO_PACK,
 class CpuSecp256k1BatchVerifier(_CpuLoopVerifier):
     """Parity oracle for the secp256k1 device path."""
 
+    KEY_TYPE = "secp256k1"
+
     def _check(self, pk, m, s):
         from . import secp256k1 as sk
         return sk.PubKey(pk).verify_signature(m, s)
@@ -163,7 +181,9 @@ class TpuSecp256k1BatchVerifier(_SigCollector):
     still one dispatch for the whole batch.  The reference refuses to
     batch secp256k1 at all (crypto/batch/batch.go:12)."""
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    KEY_TYPE = "secp256k1"
+
+    def _verify_items(self) -> tuple[bool, list[bool]]:
         import numpy as np
 
         from ..ops import ed25519 as ed_dev
@@ -187,6 +207,8 @@ class TpuSecp256k1BatchVerifier(_SigCollector):
 class CpuSr25519BatchVerifier(_CpuLoopVerifier):
     """Parity oracle for the sr25519 device path."""
 
+    KEY_TYPE = "sr25519"
+
     def _check(self, pk, m, s):
         from . import sr25519 as sr
         return sr.PubKey(pk).verify_signature(m, s)
@@ -198,7 +220,9 @@ class TpuSr25519BatchVerifier(_SigCollector):
     SHA-512 challenge (see crypto/sr25519.to_edwards_inputs; the
     reference's analog is sr25519.BatchVerifier in batch.go)."""
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    KEY_TYPE = "sr25519"
+
+    def _verify_items(self) -> tuple[bool, list[bool]]:
         from . import sr25519 as sr
 
         n = len(self._items)
@@ -251,11 +275,21 @@ def safe_verify(pub_key, msg: bytes, sig: bytes) -> bool:
     unavailable native backend (bls12381 without its .so) is handled:
     every host single-verify loop — here, types/validation.py's commit
     loop, and DeferredSigBatch — must agree, or the same commit could
-    crash one path and merely fail another."""
+    crash one path and merely fail another.
+
+    Routes through the signature-verdict cache: a triple verified
+    anywhere in the process (vote stream, a batch window, a previous
+    commit check) answers here for one SHA-256; a fresh verdict is
+    inserted so the NEXT consumer gets the hit."""
+    v = sigcache.get(pub_key, msg, sig)
+    if v is not None:
+        return v
     try:
-        return bool(pub_key.verify_signature(msg, sig))
+        v = bool(pub_key.verify_signature(msg, sig))
     except Exception:
-        return False
+        v = False
+    sigcache.insert(pub_key, msg, sig, v)
+    return v
 
 # the reference batches only ed25519 & sr25519 (crypto/batch/batch.go:
 # 12-35); we also batch secp256k1 on device (a BASELINE.json target)

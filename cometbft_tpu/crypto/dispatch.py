@@ -147,12 +147,19 @@ class WindowHandle:
 class _Window:
     __slots__ = ("items", "handle", "threshold", "mode", "pks",
                  "parsed", "packed", "verifier", "staged", "device_s",
-                 "device_index", "dispatching", "result")
+                 "device_index", "dispatching", "result",
+                 "all_items", "cached")
 
     def __init__(self, items, handle, threshold):
+        # items = the MISSES after the verdict-cache partition (what
+        # actually stages + dispatches); all_items/cached keep the
+        # original window so verdicts merge back to one bool per
+        # submitted item.  cached is None when nothing was partitioned.
         self.items = items
         self.handle = handle
         self.threshold = threshold
+        self.all_items = items
+        self.cached = None
         self.mode = None          # "ed" | "mixed" | "host"
         self.pks = None
         self.parsed = None
@@ -262,6 +269,7 @@ class VerifyPipeline(BaseService):
             leftovers, self._windows = list(self._windows), []
         for w in leftovers:
             ok, verdicts = self._host_fallback(w)
+            ok, verdicts = self._merge_cache(w, ok, verdicts)
             w.handle._resolve(ok, verdicts, "host")
             try:
                 self._slots.release()
@@ -327,6 +335,25 @@ class VerifyPipeline(BaseService):
         if not items:
             handle._resolve(False, [], "host")
             return handle
+        # verdict-cache partition (crypto/sigcache.py): only misses
+        # stage and dispatch; cached verdicts merge back at window
+        # publication.  A fully-cached window resolves RIGHT HERE —
+        # no slot, no staging, no device.
+        from . import sigcache
+
+        cached = None
+        misses = items
+        if sigcache.enabled():
+            verdicts, miss_idx = sigcache.partition(
+                items, label=subsystem)
+            if not miss_idx:
+                full = [bool(v) for v in verdicts]
+                handle._resolve(all(full), full, "cache")
+                self._record_cache_window(handle, len(items))
+                return handle
+            if len(miss_idx) < len(items):
+                cached = verdicts
+                misses = [items[i] for i in miss_idx]
         if self._stopping or self._staging is None \
                 or not self.is_running():
             # late submissions still answer, synchronously on the host
@@ -335,7 +362,9 @@ class VerifyPipeline(BaseService):
             handle._resolve(all(verdicts), verdicts, "host")
             return handle
         self._slots.acquire()
-        win = _Window(items, handle, device_threshold)
+        win = _Window(misses, handle, device_threshold)
+        win.all_items = items
+        win.cached = cached
         with self._cv:
             if self.devices is not None:
                 win.device_index = self.submitted % len(self.devices)
@@ -490,6 +519,49 @@ class VerifyPipeline(BaseService):
             self.drained_windows += 1
             return ok, verdicts, "drain"
 
+    def _merge_cache(self, win: _Window, ok: bool, verdicts: list):
+        """Window publication: insert every COMPUTED verdict into the
+        verdict cache (this is a resolution seam — even verdicts whose
+        consumer cancel-raced the window become future hits), then
+        merge with the cached slots back to one bool per submitted
+        item."""
+        from . import sigcache
+
+        if win.items:
+            sigcache.insert_many(win.items, verdicts,
+                                 label=win.handle.subsystem)
+        if win.cached is None:
+            return ok, verdicts
+        merged = list(win.cached)
+        it = iter(verdicts)
+        for i, v in enumerate(merged):
+            if v is None:
+                merged[i] = bool(next(it))
+            else:
+                merged[i] = bool(v)
+        return all(merged) and bool(merged), merged
+
+    def _cache_hits(self, win: _Window) -> int:
+        return len(win.all_items) - len(win.items)
+
+    def _record_cache_window(self, handle: WindowHandle,
+                             n: int) -> None:
+        """A fully-cached window resolved at submit: record it like a
+        flush so the path mix (device/host/cache) reads in one series."""
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+        from ..libs import tracetl
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.flushes.labels("cache").inc()
+            dm.batch_size.labels("cache").observe(n)
+        flightrec.record(
+            flightrec.EV_VERIFY_FLUSH, path="cache", batch=n,
+            cache_hits=n, subsystem=handle.subsystem,
+            inflight=len(self._windows), staged=self.staged,
+            **tracetl.ctx_fields(handle.ctx))
+
     def _record_flush(self, win: _Window, path: str, t0: float) -> None:
         from ..libs import flightrec
         from ..libs import metrics as libmetrics
@@ -506,6 +578,7 @@ class VerifyPipeline(BaseService):
         flightrec.record(
             flightrec.EV_VERIFY_FLUSH, path=path,
             batch=len(win.items),
+            cache_hits=self._cache_hits(win),
             subsystem=win.handle.subsystem,
             inflight=len(self._windows), staged=self.staged,
             **tracetl.ctx_fields(win.handle.ctx))
@@ -521,10 +594,12 @@ class VerifyPipeline(BaseService):
                                inflight=len(self._windows)), \
                     tracetl.span_for(
                         self, win.handle.subsystem, "device",
+                        cache=self._cache_hits(win),
                         **tracetl.ctx_fields(win.handle.ctx)):
                 ok, verdicts, path = self._compute_verdicts(
                     win, self._faulted)
             win.device_s = time.monotonic() - t0
+            ok, verdicts = self._merge_cache(win, ok, verdicts)
             win.handle._resolve(ok, verdicts, path)
         except BaseException as e:  # pragma: no cover - defensive
             win.handle._fail(e)
@@ -566,12 +641,13 @@ class VerifyPipeline(BaseService):
                                    device=idx), \
                         tracetl.span_for(
                             self, win.handle.subsystem, "device",
-                            device=idx,
+                            device=idx, cache=self._cache_hits(win),
                             **tracetl.ctx_fields(win.handle.ctx)):
                     ok, verdicts, path = self._compute_verdicts(
                         win, faulted, device=self.devices[idx],
                         device_index=idx)
                 win.device_s = time.monotonic() - t0
+                ok, verdicts = self._merge_cache(win, ok, verdicts)
                 win.result = (ok, verdicts, path)
             except BaseException as e:  # pragma: no cover - defensive
                 win.result = (None, e, "error")

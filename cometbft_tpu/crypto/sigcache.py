@@ -1,0 +1,350 @@
+"""Process-wide signature-verdict cache: first-seen verify, zero-cost
+re-verify across consensus, blocksync, light, and evidence.
+
+The hot path re-checks signatures the process already proved: at
+height H+1 the node re-verifies H's LastCommit
+(state/validation.validate_block -> verify_commit), duplicate gossip
+votes from N peers each occupy a StreamingVerifier batch slot, and the
+light-client / evidence paths re-dispatch identical (pubkey, msg, sig)
+triples.  A signature verdict is an immutable fact of its inputs —
+content-address it once and every later consumer gets the answer for a
+SHA-256 instead of a device dispatch or an OpenSSL call.
+
+Design:
+
+- one SHA-256 over the length-framed (key_type, pubkey, msg, sig)
+  concatenation is the cache key; the verdict is a bool.  Because the
+  FULL triple is hashed, positive AND negative verdicts are cacheable
+  and unpoisonable: an attacker who wants a False verdict cached for
+  some triple must present that exact triple, whose verdict really is
+  False (and caching it makes the rejection cheaper, not weaker);
+- lock-striped bounded LRU: 16 stripes, each its own mutex +
+  OrderedDict, so concurrent product paths (votestream worker,
+  pipeline staging, blocksync collect) don't serialize on one lock;
+- the cache is performance-only, never behavior: consumers partition
+  into hits/misses and verify only the misses, producing bit-identical
+  verdicts and byte-identical errors to the uncached path (pinned by
+  tests/test_sigcache.py parity tests);
+- seam discipline matches metrics/flightrec/trace: module-level
+  enabled() check first, everything below is no-op-cheap when the
+  cache is off (COMETBFT_TPU_SIGCACHE=0 or set_enabled(False)).
+
+Instrumented end-to-end: CacheMetrics (libs/metrics.py, per-consumer
+labels via the `consumer(...)` context manager), flightrec
+EV_CACHE_LOOKUP / EV_CACHE_INSERT events on batch seams, and the
+`cache` field on verify_dispatch tracetl spans (crypto/dispatch.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+DEFAULT_CAPACITY = int(os.environ.get(
+    "COMETBFT_TPU_SIGCACHE_CAPACITY", "131072"))
+STRIPES = 16
+
+# consumers: the product path that asked.  The default is "crypto" —
+# a lookup below any labeled seam.
+_tls = threading.local()
+
+
+class consumer:
+    """Context manager labeling cache traffic with the product path
+    (consensus / blocksync / light / evidence / ...) for the
+    per-consumer CacheMetrics series.  Thread-local and reentrant
+    (inner labels win)."""
+
+    __slots__ = ("label", "_prev")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._prev = None
+
+    def __enter__(self) -> "consumer":
+        self._prev = getattr(_tls, "label", None)
+        _tls.label = self.label
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _tls.label = self._prev
+        return False
+
+
+def current_consumer() -> str:
+    return getattr(_tls, "label", None) or "crypto"
+
+
+def _pk_bytes(pk) -> bytes:
+    return pk.bytes() if hasattr(pk, "bytes") else bytes(pk)
+
+
+def _pk_type(pk) -> str:
+    return pk.type() if hasattr(pk, "type") else "ed25519"
+
+
+def key(pubkey, msg: bytes, sig: bytes,
+        key_type: str | None = None) -> bytes:
+    """Content address of one (pubkey, msg, sig) triple: a single
+    SHA-256 over the length-framed concatenation (framing prevents
+    boundary-shift collisions between fields; the key type is part of
+    the material because the SAME raw key bytes mean different curves
+    under different types).  Accepts a key object or raw bytes."""
+    if key_type is None:
+        key_type = _pk_type(pubkey)
+    pk = _pk_bytes(pubkey)
+    h = hashlib.sha256()
+    h.update(key_type.encode())
+    h.update(len(pk).to_bytes(4, "little"))
+    h.update(pk)
+    h.update(len(msg).to_bytes(4, "little"))
+    h.update(msg)
+    h.update(sig)
+    return h.digest()
+
+
+class SigVerdictCache:
+    """Lock-striped bounded LRU mapping key() digests to bool verdicts.
+
+    Raw counters live here (hits/misses/insertions/evictions/
+    negative_hits); the module-level helpers fold them into the
+    CacheMetrics bundle when a node installed one."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 stripes: int = STRIPES):
+        self.capacity = max(int(capacity), stripes)
+        self.stripes = stripes
+        # ceil-divide so stripes * per_stripe >= capacity
+        self._per_stripe = -(-self.capacity // stripes)
+        self._locks = [threading.Lock() for _ in range(stripes)]
+        self._maps: list[OrderedDict] = [
+            OrderedDict() for _ in range(stripes)]
+        self.hits = 0
+        self.negative_hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def _stripe(self, k: bytes) -> int:
+        # the key is a SHA-256 digest: any byte is uniform
+        return k[0] % self.stripes
+
+    def lookup(self, k: bytes) -> bool | None:
+        """Verdict for a key() digest, None on miss.  A hit refreshes
+        LRU recency.  Counter accounting is the CALLER'S job (the
+        module-level get/partition helpers) so batch seams can account
+        once per batch."""
+        i = self._stripe(k)
+        with self._locks[i]:
+            m = self._maps[i]
+            v = m.get(k)
+            if v is None:
+                return None
+            m.move_to_end(k)
+            return v
+
+    def store(self, k: bytes, verdict: bool) -> int:
+        """Insert one verdict; returns evictions performed (0 or 1).
+        Re-inserting an existing key refreshes recency (verdicts are
+        immutable facts — the value cannot change)."""
+        i = self._stripe(k)
+        with self._locks[i]:
+            m = self._maps[i]
+            if k in m:
+                m.move_to_end(k)
+                m[k] = bool(verdict)
+                return 0
+            m[k] = bool(verdict)
+            if len(m) > self._per_stripe:
+                m.popitem(last=False)
+                return 1
+            return 0
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self._maps)
+
+    def clear(self) -> None:
+        for i in range(self.stripes):
+            with self._locks[i]:
+                self._maps[i].clear()
+
+    def stats(self) -> dict:
+        looked = self.hits + self.misses
+        return {
+            "entries": len(self),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "negative_hits": self.negative_hits,
+            "misses": self.misses,
+            "insertions": self.insertions,
+            "evictions": self.evictions,
+            "hit_rate": round(self.hits / looked, 4) if looked else 0.0,
+        }
+
+
+# -- process-wide default instance -------------------------------------------
+
+_cache: SigVerdictCache | None = None
+_cache_lock = threading.Lock()
+# tri-state runtime override: None defers to COMETBFT_TPU_SIGCACHE
+# (default on); the A/B bench arms and the parity tests flip this
+_enabled_override: bool | None = None
+
+
+def cache() -> SigVerdictCache:
+    global _cache
+    with _cache_lock:
+        if _cache is None:
+            _cache = SigVerdictCache()
+        return _cache
+
+
+def reset(capacity: int | None = None) -> SigVerdictCache:
+    """Fresh process-wide cache (tests and bench arms); returns it."""
+    global _cache
+    with _cache_lock:
+        _cache = SigVerdictCache(
+            capacity if capacity is not None else DEFAULT_CAPACITY)
+        return _cache
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("COMETBFT_TPU_SIGCACHE", "1") != "0"
+
+
+def set_enabled(v: bool | None) -> None:
+    global _enabled_override
+    _enabled_override = v
+
+
+# -- instrumented operations -------------------------------------------------
+
+def _metrics():
+    from ..libs import metrics as libmetrics
+
+    return libmetrics.cache_metrics()
+
+
+def _account(label: str, hits: int, negs: int, misses: int) -> None:
+    c = cache()
+    c.hits += hits
+    c.negative_hits += negs
+    c.misses += misses
+    cm = _metrics()
+    if cm is not None:
+        if hits:
+            cm.hits.labels(label).inc(hits)
+        if negs:
+            cm.negative_hits.labels(label).inc(negs)
+        if misses:
+            cm.misses.labels(label).inc(misses)
+
+
+def get(pubkey, msg: bytes, sig: bytes,
+        key_type: str | None = None,
+        label: str | None = None) -> bool | None:
+    """Single-triple lookup: bool verdict or None (miss / disabled)."""
+    if not enabled():
+        return None
+    v = cache().lookup(key(pubkey, msg, sig, key_type))
+    if label is None:
+        label = current_consumer()
+    if v is None:
+        _account(label, 0, 0, 1)
+    else:
+        _account(label, 1, 0 if v else 1, 0)
+    return v
+
+
+def insert(pubkey, msg: bytes, sig: bytes, verdict: bool,
+           key_type: str | None = None,
+           label: str | None = None) -> None:
+    if not enabled():
+        return
+    c = cache()
+    ev = c.store(key(pubkey, msg, sig, key_type), verdict)
+    c.insertions += 1
+    c.evictions += ev
+    cm = _metrics()
+    if cm is not None:
+        cm.insertions.labels(label or current_consumer()).inc()
+        if ev:
+            cm.evictions.inc(ev)
+        cm.entries.set(len(c))
+
+
+def partition(items, label: str | None = None,
+              count_misses: bool = True):
+    """Batch consult: `items` is a sequence of (pubkey, msg, sig)
+    (key objects or raw bytes).  Returns (verdicts, miss_idx) where
+    verdicts has one bool-or-None slot per item (None = miss, verify
+    it) and miss_idx lists the positions to dispatch.  Disabled cache
+    = everything a miss, zero hashing.
+
+    count_misses=False skips miss accounting — for re-check seams
+    (votestream flush re-consults triples already counted at submit)
+    so one signature never counts as two misses."""
+    items = list(items)
+    if not enabled() or not items:
+        return [None] * len(items), list(range(len(items)))
+    c = cache()
+    verdicts: list[bool | None] = []
+    miss_idx: list[int] = []
+    hits = negs = 0
+    for i, (pk, msg, sig) in enumerate(items):
+        v = c.lookup(key(pk, msg, sig))
+        verdicts.append(v)
+        if v is None:
+            miss_idx.append(i)
+        else:
+            hits += 1
+            if not v:
+                negs += 1
+    if label is None:
+        label = current_consumer()
+    _account(label, hits, negs,
+             len(miss_idx) if count_misses else 0)
+    if hits and len(items) >= 2:
+        from ..libs import flightrec
+
+        flightrec.record(flightrec.EV_CACHE_LOOKUP, consumer=label,
+                         batch=len(items), hits=hits, negative=negs,
+                         misses=len(miss_idx))
+    return verdicts, miss_idx
+
+
+def insert_many(items, verdicts, label: str | None = None,
+                key_type: str | None = None) -> None:
+    """Batch populate: one (pubkey, msg, sig) + bool verdict per slot.
+    The verdict-resolution seams (votestream flush, pipeline window
+    publication, batch verifiers) call this so every computed verdict
+    becomes a future hit.  key_type overrides per-item inference when
+    the items carry raw key bytes of a known non-ed25519 type (the
+    typed batch collectors in crypto/batch.py)."""
+    if not enabled() or not items:
+        return
+    c = cache()
+    ev = 0
+    n = 0
+    for (pk, msg, sig), v in zip(items, verdicts):
+        ev += c.store(key(pk, msg, sig, key_type), bool(v))
+        n += 1
+    c.insertions += n
+    c.evictions += ev
+    if label is None:
+        label = current_consumer()
+    cm = _metrics()
+    if cm is not None:
+        cm.insertions.labels(label).inc(n)
+        if ev:
+            cm.evictions.inc(ev)
+        cm.entries.set(len(c))
+    if n >= 2:
+        from ..libs import flightrec
+
+        flightrec.record(flightrec.EV_CACHE_INSERT, consumer=label,
+                         count=n, evicted=ev)

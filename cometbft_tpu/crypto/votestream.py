@@ -61,12 +61,17 @@ class StreamingVerifier(BaseService):
         self.warmed = threading.Event()
         # (pubkey, msg, sig, future, trace_ctx_or_None)
         self._pending: list[tuple] = []
+        # in-flight dedupe: triple -> the future already queued for it,
+        # so two peers flooding the same vote share one batch slot
+        self._inflight: dict[tuple, Future] = {}
         self._cv = threading.Condition()
         self._thread: threading.Thread | None = None
         self._stopping = False
         self.flushes = 0
         self.device_flushes = 0
         self.verified = 0
+        self.coalesced = 0
+        self.cache_hits = 0
 
     # -- service -----------------------------------------------------------
 
@@ -143,15 +148,52 @@ class StreamingVerifier(BaseService):
         The caller keeps (pubkey, msg, sig) to check the verdict applies
         to what it meant to verify.  ``ctx`` is an optional trace
         context (libs/tracetl.py) tagging the flush events with the
-        consensus height/round that triggered the verify."""
+        consensus height/round that triggered the verify.
+
+        Two fast exits before a batch slot is occupied:
+        - verdict-cache hit (crypto/sigcache.py): the triple was
+          already proved somewhere in the process — the returned
+          future is ALREADY RESOLVED;
+        - in-flight duplicate: the same triple is already queued (a
+          second peer flooding the same vote) — the existing future is
+          returned, one device verification serves both."""
+        from . import sigcache
+
         fut: Future = Future()
+        if sigcache.enabled():
+            v = sigcache.get(pubkey, msg, sig, key_type="ed25519",
+                             label="consensus")
+            if v is not None:
+                self.cache_hits += 1
+                fut.set_result(v)
+                return fut
         with self._cv:
             if self._stopping or self._thread is None:
                 fut.set_result(_host_verify(pubkey, msg, sig))
                 return fut
+            triple = (pubkey, msg, sig)
+            existing = self._inflight.get(triple)
+            if existing is not None and not existing.done():
+                self.coalesced += 1
+                from ..libs import metrics as libmetrics
+
+                cm = libmetrics.cache_metrics()
+                if cm is not None:
+                    cm.votestream_coalesced.inc()
+                return existing
+            self._inflight[triple] = fut
+            # the done-callback fires on resolve AND on cancel, so a
+            # canceled slot stops absorbing new duplicates
+            fut.add_done_callback(
+                lambda f, t=triple: self._forget(t, f))
             self._pending.append((pubkey, msg, sig, fut, ctx))
             self._cv.notify()
         return fut
+
+    def _forget(self, triple: tuple, fut: Future) -> None:
+        with self._cv:
+            if self._inflight.get(triple) is fut:
+                del self._inflight[triple]
 
     # -- worker ------------------------------------------------------------
 
@@ -183,10 +225,28 @@ class StreamingVerifier(BaseService):
                 return
 
     def _flush(self, batch) -> None:
+        from . import sigcache
+
         # consumers cancel futures they already verified inline
         batch = [b for b in batch if not b[3].cancelled()]
         if not batch:
             return
+        # late cache hits: verdicts inserted since submit (blocksync,
+        # a previous flush, an inline verify) resolve here without
+        # occupying a batch slot.  Misses were already counted at
+        # submit time, so this re-check only accounts hits.
+        cache_hits = 0
+        if sigcache.enabled():
+            verdicts, miss_idx = sigcache.partition(
+                [(b[0], b[1], b[2]) for b in batch],
+                label="consensus", count_misses=False)
+            for b, v in zip(batch, verdicts):
+                if v is not None and b[3].set_running_or_notify_cancel():
+                    b[3].set_result(v)
+            cache_hits = len(batch) - len(miss_idx)
+            batch = [batch[i] for i in miss_idx]
+            if not batch:
+                return
         self.flushes += 1
         self.verified += len(batch)
         from ..libs import flightrec
@@ -205,7 +265,8 @@ class StreamingVerifier(BaseService):
                 # a synchronous device round-trip.
                 with libtrace.span("consensus", "verify_dispatch"), \
                         tracetl.span_for(self, "consensus",
-                                         "verify_dispatch"):
+                                         "verify_dispatch",
+                                         cache=cache_hits):
                     self._flush_device(batch)
                 return
             except Exception as e:
@@ -222,14 +283,18 @@ class StreamingVerifier(BaseService):
                         "device verify flush failed: %r" % e)
         path = "host"
         with libtrace.span("consensus", "verify_dispatch"), \
-                tracetl.span_for(self, "consensus", "verify_dispatch"):
+                tracetl.span_for(self, "consensus", "verify_dispatch",
+                                 cache=cache_hits):
             for pk, msg, sig, fut, _ in batch:
-                if not fut.set_running_or_notify_cancel():
-                    continue
-                try:
-                    fut.set_result(_host_verify(pk, msg, sig))
-                except Exception as e:  # pragma: no cover
-                    fut.set_exception(e)
+                # verdict first, future second: a consumer that
+                # cancel-raced this flush (Preverified.verdict_for)
+                # still gets the verdict CACHED, so its inline
+                # re-verify is the last time the triple costs anything
+                v = _host_verify(pk, msg, sig)
+                sigcache.insert(pk, msg, sig, v, key_type="ed25519",
+                                label="consensus")
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result(v)
         dm = libmetrics.device_metrics()
         if dm is not None:
             dm.flushes.labels(path).inc()
@@ -237,6 +302,7 @@ class StreamingVerifier(BaseService):
             dm.flush_latency_seconds.observe(time.monotonic() - t0)
         flightrec.record(flightrec.EV_VERIFY_FLUSH, path=path,
                          batch=len(batch), inflight=0, staged=0,
+                         cache_hits=cache_hits,
                          **tracetl.ctx_fields(_batch_ctx(batch)))
 
     def _flush_device(self, batch) -> None:
@@ -257,15 +323,24 @@ class StreamingVerifier(BaseService):
             ctx=_batch_ctx(batch))
 
         def _resolve(h):
+            from . import sigcache
+
             try:
                 _, verdicts = h.result(timeout=0)
             except Exception:           # pragma: no cover - defensive
                 verdicts = None
             if verdicts is None:
                 for pk, msg, sig, fut, _ in batch:
+                    v = _host_verify(pk, msg, sig)
+                    sigcache.insert(pk, msg, sig, v,
+                                    key_type="ed25519",
+                                    label="consensus")
                     if fut.set_running_or_notify_cancel():
-                        fut.set_result(_host_verify(pk, msg, sig))
+                        fut.set_result(v)
                 return
+            # verdicts for cancel-raced futures were inserted into the
+            # verdict cache by the pipeline at window publication —
+            # nothing re-verifies them even though set_running fails
             for (_, _, _, fut, _), ok in zip(batch, verdicts):
                 if fut.set_running_or_notify_cancel():
                     fut.set_result(bool(ok))

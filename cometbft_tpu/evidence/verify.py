@@ -8,6 +8,8 @@ pubkey path.
 
 from __future__ import annotations
 
+from ..crypto import sigcache
+from ..crypto.batch import safe_verify
 from ..types.evidence import (
     DuplicateVoteEvidence, LightClientAttackEvidence,
 )
@@ -79,13 +81,17 @@ def verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
             f"evidence total power {ev.total_voting_power} != actual "
             f"{val_set.total_voting_power()}")
 
+    # safe_verify rides the process-wide verdict cache: the accused
+    # validator's CANONICAL vote was usually verified live by
+    # consensus, so one of the pair is typically a hit
     pub_key = val.pub_key
-    if not pub_key.verify_signature(va.sign_bytes(chain_id),
-                                    va.signature):
-        raise EvidenceVerificationError("invalid signature on vote A")
-    if not pub_key.verify_signature(vb.sign_bytes(chain_id),
-                                    vb.signature):
-        raise EvidenceVerificationError("invalid signature on vote B")
+    with sigcache.consumer("evidence"):
+        if not safe_verify(pub_key, va.sign_bytes(chain_id),
+                           va.signature):
+            raise EvidenceVerificationError("invalid signature on vote A")
+        if not safe_verify(pub_key, vb.sign_bytes(chain_id),
+                           vb.signature):
+            raise EvidenceVerificationError("invalid signature on vote B")
 
 
 def verify_light_client_attack(ev: LightClientAttackEvidence, state,
@@ -105,8 +111,9 @@ def verify_light_client_attack(ev: LightClientAttackEvidence, state,
             "light-client attack evidence missing conflicting block")
     sh = cb.signed_header
     from ..types.validation import Fraction, verify_commit_light_trusting
-    verify_commit_light_trusting(
-        state.chain_id, common_vals, sh.commit, Fraction(1, 3))
+    with sigcache.consumer("evidence"):
+        verify_commit_light_trusting(
+            state.chain_id, common_vals, sh.commit, Fraction(1, 3))
     if ev.total_voting_power != common_vals.total_voting_power():
         raise EvidenceVerificationError(
             "evidence total power does not match common validator set")

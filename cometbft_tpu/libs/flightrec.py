@@ -44,6 +44,8 @@ EV_ESCALATION = "round_escalation"   # height moved past round 0
 EV_VERIFY_FLUSH = "verify_flush"     # streaming-verifier flush
 EV_DEVICE_FALLBACK = "device_fallback"  # device flush failed -> host
 EV_RLC_FALLBACK = "rlc_fallback"     # RLC whole-batch check failed
+EV_CACHE_LOOKUP = "cache_lookup"     # sigcache batch consult with hits
+EV_CACHE_INSERT = "cache_insert"     # sigcache batch verdict insertion
 EV_PIPELINE_DRAIN = "pipeline_drain"  # verify pipeline drained after a
 #                                       mid-flight device failure
 #                                       (crypto/dispatch.py); carries

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from ..crypto import sigcache
 from ..libs.trace import span as trace_span
 from ..types.timestamp import Timestamp
 from ..types.validation import Fraction
@@ -250,7 +251,8 @@ class Client:
                             interim.validator_set, self.trusting_period_ns,
                             now, self.max_clock_drift_ns, defer_to=batch)
                         verified = interim
-                with trace_span("light", "device"):
+                with trace_span("light", "device"), \
+                        sigcache.consumer("light"):
                     batch.verify()
                 trace.extend(window)
                 h = wend + 1

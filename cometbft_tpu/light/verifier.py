@@ -8,6 +8,7 @@ types/validation.py. Durations are nanoseconds (ints).
 
 from __future__ import annotations
 
+from ..crypto import sigcache
 from ..types.timestamp import Timestamp
 from ..types.validation import (
     ErrNotEnoughVotingPowerSigned, Fraction, verify_commit_light,
@@ -102,9 +103,14 @@ def verify_adjacent(trusted: SignedHeader, untrusted: SignedHeader,
             f"({trusted.header.next_validators_hash.hex()}) to match those "
             f"from new header ({untrusted.header.validators_hash.hex()})")
     try:
-        verify_commit_light(trusted.chain_id, untrusted_vals,
-                            untrusted.commit.block_id, untrusted.height,
-                            untrusted.commit, defer_to=defer_to)
+        # commits the full node already verified (consensus/blocksync)
+        # are verdict-cache hits here — attributed to the "light"
+        # consumer in CacheMetrics
+        with sigcache.consumer("light"):
+            verify_commit_light(trusted.chain_id, untrusted_vals,
+                                untrusted.commit.block_id,
+                                untrusted.height, untrusted.commit,
+                                defer_to=defer_to)
     except Exception as e:
         raise ErrInvalidHeader(str(e)) from e
 
@@ -124,14 +130,16 @@ def verify_non_adjacent(trusted: SignedHeader, trusted_vals,
     _verify_new_header_and_vals(untrusted, untrusted_vals, trusted, now,
                                 max_clock_drift_ns)
     try:
-        verify_commit_light_trusting(trusted.chain_id, trusted_vals,
-                                     untrusted.commit, trust_level)
+        with sigcache.consumer("light"):
+            verify_commit_light_trusting(trusted.chain_id, trusted_vals,
+                                         untrusted.commit, trust_level)
     except ErrNotEnoughVotingPowerSigned as e:
         raise ErrNewValSetCantBeTrusted(str(e)) from e
     try:
-        verify_commit_light(trusted.chain_id, untrusted_vals,
-                            untrusted.commit.block_id, untrusted.height,
-                            untrusted.commit)
+        with sigcache.consumer("light"):
+            verify_commit_light(trusted.chain_id, untrusted_vals,
+                                untrusted.commit.block_id,
+                                untrusted.height, untrusted.commit)
     except Exception as e:
         raise ErrInvalidHeader(str(e)) from e
 

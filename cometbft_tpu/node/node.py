@@ -348,11 +348,12 @@ class Node(BaseService):
         self.statesync_metrics = None
         if config.instrumentation.prometheus:
             from ..libs import metrics as libmetrics
-            from ..libs.metrics import (BlockSyncMetrics, ConsensusMetrics,
-                                        DeviceMetrics, MempoolMetrics,
-                                        MetricsServer, P2PMetrics,
-                                        ProxyMetrics, Registry, StateMetrics,
-                                        StateSyncMetrics, StoreMetrics)
+            from ..libs.metrics import (BlockSyncMetrics, CacheMetrics,
+                                        ConsensusMetrics, DeviceMetrics,
+                                        MempoolMetrics, MetricsServer,
+                                        P2PMetrics, ProxyMetrics, Registry,
+                                        StateMetrics, StateSyncMetrics,
+                                        StoreMetrics)
             registry = Registry(config.instrumentation.namespace)
             self.metrics_registry = registry
             self.consensus_state.metrics = ConsensusMetrics(registry)
@@ -377,6 +378,7 @@ class Node(BaseService):
                 libmetrics.BLOCK_STORE_TIMED_METHODS)
             # the crypto layers report through the process-wide seam
             libmetrics.set_device_metrics(DeviceMetrics(registry))
+            libmetrics.set_cache_metrics(CacheMetrics(registry))
             # stage spans (decode/verify-dispatch/device/apply/store):
             # the block-ingest breakdown reports through the same kind
             # of process-wide seam (libs/trace.py)
@@ -480,6 +482,7 @@ class Node(BaseService):
             from ..libs import metrics as libmetrics
             from ..libs import trace as libtrace
             libmetrics.set_device_metrics(None)
+            libmetrics.set_cache_metrics(None)
             libtrace.set_tracer(None)
             libflightrec.set_recorder(None)
         if self.rpc_server is not None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import os
 import time
 
+from ..crypto import sigcache
 from ..libs import trace as libtrace
 from .node import SimNode, clone_chain, grow_chain, make_sim_genesis
 from .transport import SimNetwork
@@ -34,6 +35,7 @@ from .transport import SimNetwork
 last_blocksync: dict | None = None
 last_light: dict | None = None
 last_consensus: dict | None = None
+last_cache_ab: dict | None = None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -144,7 +146,8 @@ def bench_consensus_e2e(n_blocks: int | None = None,
                         seed: int = 13,
                         timeout: float = 300.0,
                         attach_timeline: bool | None = None,
-                        trace_export: str | None = None) -> dict:
+                        trace_export: str | None = None,
+                        cache: bool | None = None) -> dict:
     """Live multi-validator consensus over conditioned links: real
     rounds (propose -> prevote -> precommit -> commit) through the
     real reactors, votes pre-verified through the streaming-verifier
@@ -160,7 +163,13 @@ def bench_consensus_e2e(n_blocks: int | None = None,
     proposal->commit critical-path decomposition
     (`critical_path_device_share` + per-segment summary) to the
     result; trace_export (SIMNET_TRACE_EXPORT=path) additionally
-    writes the merged Perfetto trace_event JSON there."""
+    writes the merged Perfetto trace_event JSON there.
+
+    cache forces the signature-verdict cache on (True) or off (False)
+    for the run — the A/B knob bench_consensus_cache_ab drives; None
+    leaves the process default (env COMETBFT_TPU_SIGCACHE).  The cache
+    starts EMPTY either way, so the reported `verdict_cache` stats
+    are entirely this run's traffic."""
     global last_consensus
     n_blocks = n_blocks if n_blocks is not None else _env_int(
         "SIMNET_CONSENSUS_BLOCKS", 12)
@@ -179,6 +188,10 @@ def bench_consensus_e2e(n_blocks: int | None = None,
     nodes = [SimNode(f"cval{i}", genesis, net, priv_validator=p,
                      consensus_active=True, seed=seed)
              for i, p in enumerate(privs)]
+
+    prev_cache_enabled = sigcache._enabled_override
+    sigcache.set_enabled(cache)
+    sigcache.reset()
 
     session = None
     if attach_timeline:
@@ -208,6 +221,8 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         lats = sorted(lat for n in nodes for lat in n.round_latencies())
         for n in nodes:
             n.stop()
+        cache_stats = sigcache.cache().stats()
+        sigcache.set_enabled(prev_cache_enabled)
         if session is not None:
             trace = session.export()
             session.uninstall()
@@ -231,6 +246,18 @@ def bench_consensus_e2e(n_blocks: int | None = None,
             "samples": len(lats),
         },
         "recorders": summaries,
+        "cache_enabled": (bool(cache) if cache is not None
+                          else sigcache.enabled()),
+        "verdict_cache": cache_stats,
+        "verdict_cache_hit_rate": cache_stats["hit_rate"],
+        # byte-determinism probe: the cache must not change WHAT
+        # commits, only how often signatures re-verify.  Sampled at
+        # the FIXED height n_blocks (nodes race slightly past it), so
+        # two same-seed runs must agree byte-for-byte.
+        "heights": [n.height() for n in nodes],
+        "app_hashes": [
+            n.block_store.load_block_meta(n_blocks).header.app_hash.hex()
+            for n in nodes],
     }
     if trace is not None:
         from ..libs import tracetl
@@ -241,6 +268,55 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         last_consensus["critical_path_device_share"] = \
             cp["summary"]["device_share"]
     return last_consensus
+
+
+def bench_consensus_cache_ab(n_blocks: int | None = None,
+                             n_vals: int | None = None,
+                             seed: int = 13,
+                             timeout: float = 300.0,
+                             attach_timeline: bool | None = None) -> dict:
+    """A/B the signature-verdict cache over the SAME seeded consensus
+    run: arm A with the cache disabled, arm B with it force-enabled.
+
+    The contract the cache must hold: identical heights and app hashes
+    in both arms (verdicts are facts — caching them may not change
+    what commits), while arm B shows a non-zero hit rate (the H+1
+    LastCommit re-validation and duplicate vote gossip resolve from
+    cache) and, when the timeline is attached, a LOWER share of the
+    proposal->commit critical path spent in device verify dispatches.
+    Stores the combined record in `last_cache_ab`."""
+    global last_cache_ab
+    off = bench_consensus_e2e(n_blocks=n_blocks, n_vals=n_vals,
+                              seed=seed, timeout=timeout,
+                              attach_timeline=attach_timeline,
+                              cache=False)
+    on = bench_consensus_e2e(n_blocks=n_blocks, n_vals=n_vals,
+                             seed=seed, timeout=timeout,
+                             attach_timeline=attach_timeline,
+                             cache=True)
+    if off["app_hashes"] != on["app_hashes"]:
+        raise RuntimeError(
+            "verdict cache changed app hashes: "
+            f"off={off['app_hashes']} on={on['app_hashes']}")
+    if min(off["heights"]) < off["blocks"] or \
+            min(on["heights"]) < on["blocks"]:
+        raise RuntimeError("cache A/B arm stalled below target height")
+    last_cache_ab = {
+        "blocks": on["blocks"],
+        "validators": on["validators"],
+        "seed": seed,
+        "app_hash_parity": True,
+        "hit_rate_off": off["verdict_cache_hit_rate"],
+        "hit_rate_on": on["verdict_cache_hit_rate"],
+        "verdict_cache_on": on["verdict_cache"],
+        "blocks_per_sec_off": off["blocks_per_sec"],
+        "blocks_per_sec_on": on["blocks_per_sec"],
+    }
+    for arm, rec in (("off", off), ("on", on)):
+        if "critical_path_device_share" in rec:
+            last_cache_ab[f"critical_path_device_share_{arm}"] = \
+                rec["critical_path_device_share"]
+    return last_cache_ab
 
 
 def bench_light_e2e(n_headers: int | None = None,

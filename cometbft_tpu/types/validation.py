@@ -17,6 +17,7 @@ import os
 from dataclasses import dataclass
 
 from ..crypto import batch as crypto_batch
+from ..crypto import sigcache
 from .block import (
     BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BlockID, Commit,
 )
@@ -111,6 +112,19 @@ class DeferredSigBatch:
         if not self._entries:
             return
         self._entries, entries = [], self._entries
+        # verdict-cache partition: triples the process already proved
+        # (the previous window's commits, the live vote stream) skip
+        # the dispatch entirely; a cached NEGATIVE raises the same
+        # error the uncached path would, immediately
+        cached, miss_idx = sigcache.partition(
+            [(pub, sign_bytes, sig)
+             for _, _, pub, sign_bytes, sig in entries])
+        for (label, ctx, _, _, sig), v in zip(entries, cached):
+            if v is False:
+                raise self._fail(label, ctx, sig)
+        entries = [entries[i] for i in miss_idx]
+        if not entries:
+            return
         if len(entries) < self.DEVICE_THRESHOLD:
             for label, ctx, pub, sign_bytes, sig in entries:
                 if not crypto_batch.safe_verify(pub, sign_bytes, sig):
@@ -319,16 +333,31 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
         return
 
     if use_batch:
+        # verdict-cache partition (crypto/sigcache.py): only misses
+        # reach a verifier; a cached negative rejects immediately with
+        # the SAME localization message as the uncached path (on a hot
+        # cache every entry is cached, so the first False in entry
+        # order is the same index the uncached scan would name)
+        cached, miss_idx = sigcache.partition(
+            [(val.pub_key, sign_bytes, sig)
+             for _, val, sign_bytes, sig in entries])
+        for (idx, _, _, sig), v in zip(entries, cached):
+            if v is False:
+                raise ErrInvalidSignature(
+                    f"wrong signature (#{idx}): {sig.hex()}")
+        misses = [entries[i] for i in miss_idx]
+        if not misses:
+            return
         bv = crypto_batch.MixedBatchVerifier() \
             if not vals.all_keys_have_same_type() \
             else crypto_batch.create_batch_verifier(
-                vals.get_proposer().pub_key.type(), n_hint=len(entries))
-        for _, val, sign_bytes, sig in entries:
+                vals.get_proposer().pub_key.type(), n_hint=len(misses))
+        for _, val, sign_bytes, sig in misses:
             bv.add(val.pub_key, sign_bytes, sig)
         ok, verdicts = bv.verify()
         if ok:
             return
-        for (idx, _, _, sig), valid in zip(entries, verdicts):
+        for (idx, _, _, sig), valid in zip(misses, verdicts):
             if not valid:
                 raise ErrInvalidSignature(
                     f"wrong signature (#{idx}): {sig.hex()}")

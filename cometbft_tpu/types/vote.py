@@ -47,11 +47,21 @@ class Vote:
             chain_id, self.height, self.round, self.extension)
 
     def verify(self, chain_id: str, pubkey) -> None:
-        """vote.go:219-235: address match + signature check."""
+        """vote.go:219-235: address match + signature check.
+
+        The signature routes through the cached safe_verify seam
+        (crypto/batch.py -> crypto/sigcache.py): an inline re-verify
+        after a cancel-raced preverification both HITS a verdict the
+        worker already resolved and INSERTS its own, so the same
+        triple never verifies twice — at height H+1 this vote's
+        LastCommit slot is a cache hit."""
         if pubkey.address() != self.validator_address:
             raise ValueError("invalid validator address")
-        if not pubkey.verify_signature(self.sign_bytes(chain_id),
-                                       self.signature):
+        from ..crypto import batch as crypto_batch
+
+        if not crypto_batch.safe_verify(pubkey,
+                                        self.sign_bytes(chain_id),
+                                        self.signature):
             raise ValueError("invalid signature")
 
     def verify_vote_and_extension(self, chain_id: str, pubkey) -> None:

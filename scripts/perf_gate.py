@@ -31,11 +31,19 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# metrics where smaller is the improvement
+# metrics where smaller is the improvement.  NOTE
+# verdict_cache_hit_rate stays in the default higher-is-better set: a
+# hit-rate drop means commits started re-verifying signatures.
 LOWER_IS_BETTER = {"chaos_recovery_seconds"}
 # non-metric extras (configs, notes, lists) are skipped by the numeric
-# filter; these numerics are ratios/counters, not rates to gate on
-SKIP = {"rlc_batch", "headline_passes", "vs_baseline"}
+# filter; these numerics are ratios/counters, not rates to gate on.
+# critical_path_device_share moved here when the signature-verdict
+# cache landed: the cache removes device dispatches from the
+# proposal->commit critical path BY DESIGN, so the share falling is
+# the optimisation working, not a regression — and it rising again is
+# not an improvement either.  perf_report still prints its trajectory.
+SKIP = {"rlc_batch", "headline_passes", "vs_baseline",
+        "critical_path_device_share"}
 
 
 def load_record(path: str) -> dict | None:

@@ -126,19 +126,25 @@ def critical_path_report(paths: list[str]) -> None:
             with open(p) as f:
                 rec = json.load(f)
             v = (rec.get("parsed") or {}).get("value")
-            share = ((rec.get("parsed") or {}).get("extra") or {}) \
-                .get("critical_path_device_share")
+            extra = ((rec.get("parsed") or {}).get("extra") or {})
+            share = extra.get("critical_path_device_share")
+            hit_rate = extra.get("verdict_cache_hit_rate")
         except (json.JSONDecodeError, OSError):
             continue
         n = re.search(r"r(\d+)", os.path.basename(p))
         if v is not None:
-            heads.append((n.group(1) if n else "?", v, share))
+            heads.append((n.group(1) if n else "?", v, share, hit_rate))
     if heads:
+        # device share and verdict-cache hit rate print side by side:
+        # a rising hit rate SHOULD pull the device share down (cached
+        # verdicts skip the dispatch), so the pair reads as one story
         print("headline trajectory (BENCH_r*.json):")
-        for rnd, v, share in heads:
+        for rnd, v, share, hit_rate in heads:
             share_s = f"  device_share={share:.1%}" \
                 if isinstance(share, (int, float)) else ""
-            print(f"  r{rnd}: {fmt(v)} sigs/s{share_s}")
+            hit_s = f"  cache_hit_rate={hit_rate:.1%}" \
+                if isinstance(hit_rate, (int, float)) else ""
+            print(f"  r{rnd}: {fmt(v)} sigs/s{share_s}{hit_s}")
         print()
     for path in paths:
         with open(path) as f:

@@ -35,6 +35,23 @@ def _rss_kb() -> int:
     return 0
 
 
+@pytest.fixture(autouse=True)
+def _sigcache_isolation():
+    """The signature-verdict cache (crypto/sigcache) is process-wide
+    by design — which in a test process means verdicts leak across
+    tests: a triple verified in one test resolves as a cache hit in
+    the next, masking the code path the later test means to exercise.
+    Start every test with an empty cache and the default (env-driven)
+    enable state."""
+    from cometbft_tpu.crypto import sigcache
+
+    sigcache.reset()
+    sigcache.set_enabled(None)
+    yield
+    sigcache.reset()
+    sigcache.set_enabled(None)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _module_memory_hygiene(request):
     """Drop live jit executables between modules: a full-suite run

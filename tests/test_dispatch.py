@@ -13,6 +13,7 @@ import pytest
 
 from cometbft_tpu.crypto import batch as cb
 from cometbft_tpu.crypto import dispatch as vd
+from cometbft_tpu.crypto import sigcache
 from cometbft_tpu.crypto.ed25519 import PrivKey, PubKey
 
 
@@ -90,10 +91,16 @@ class TestPipelineVerdicts:
                    for p, (pk, m, s) in zip(win.parsed, win.items)]
             return all(out), out
 
+        # the oracle and each pipeline arm share triples; flush the
+        # process-wide verdict cache between them so every arm
+        # genuinely exercises its own lane (a hit would short-circuit
+        # to path "cache")
+        sigcache.reset()
         with vd.VerifyPipeline(depth=2) as pipe:
             ok_h, host = pipe.submit(list(items),
                                      device_threshold=1 << 30).result(
                                          timeout=60)
+        sigcache.reset()
         with vd.VerifyPipeline(
                 depth=2, dispatch_fn=judge_from_staging) as pipe:
             h = pipe.submit(list(items), device_threshold=1)
@@ -109,6 +116,9 @@ class TestPipelineVerdicts:
         serial oracle; cold-compiles the XLA kernels, so slow tier."""
         items = make_items(24, seed=7, bad=(3, 20))
         want = serial_verdicts(items)
+        # the oracle cached every verdict — flush so the submit really
+        # drives the device chain instead of resolving from cache
+        sigcache.reset()
         with vd.VerifyPipeline(depth=2) as pipe:
             ok, dev = pipe.submit(list(items),
                                   device_threshold=1).result(

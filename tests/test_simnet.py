@@ -9,6 +9,7 @@ import time
 
 import pytest
 
+from cometbft_tpu.crypto import sigcache
 from cometbft_tpu.libs import trace as libtrace
 from cometbft_tpu.p2p.node_info import NodeInfo
 from cometbft_tpu.p2p.transport import TransportError
@@ -117,6 +118,11 @@ class TestBlocksyncSmoke:
     def test_clean_sync_with_trace(self):
         """3-node fast smoke: 20 real blocks through the real reactor
         into the store, every pipeline stage span recorded."""
+        # this test pins the verify lanes themselves; the process-wide
+        # verdict cache (shared across in-process sim nodes) would
+        # resolve the syncer's windows at submit and starve the device
+        # stage of spans
+        sigcache.set_enabled(False)
         net = SimNetwork(seed=7)
         net.set_default_link(latency=0.001)
         genesis, privs = make_sim_genesis(4, seed=7)
@@ -208,6 +214,7 @@ class TestBlocksyncSmoke:
 
 class TestE2EBench:
     def test_blocksync_e2e_bench_small(self):
+        sigcache.set_enabled(False)     # pin the device stage span
         from cometbft_tpu.simnet import bench as simbench
         res = simbench.bench_blocksync_e2e(
             n_blocks=8, n_vals=4, txs_per_block=1, seed=3, timeout=60)
@@ -221,8 +228,12 @@ class TestE2EBench:
         per-stage consensus breakdown + round-latency histogram + per
         node flight-recorder summaries in one record."""
         from cometbft_tpu.simnet import bench as simbench
+        # cache=False pins the verify_dispatch lane (in-process sim
+        # nodes share the verdict cache, which otherwise resolves every
+        # gossiped vote at submit); the cached arm is covered by
+        # tests/test_sigcache.py's A/B parity test
         res = simbench.bench_consensus_e2e(
-            n_blocks=3, n_vals=3, seed=17, timeout=120)
+            n_blocks=3, n_vals=3, seed=17, timeout=120, cache=False)
         assert res["blocks_per_sec"] > 0
         assert res["blocks"] == 3
         for stage in ("consensus.propose", "consensus.prevote",
@@ -256,6 +267,7 @@ class TestPipelinedBlocksync:
         syncs correctly through the overlapped reactor path and the
         pipeline-only stages (collect, host_pack) land in the trace
         next to the classic five."""
+        sigcache.set_enabled(False)     # pin the device stage span
         from cometbft_tpu.simnet import bench as simbench
         res = simbench.bench_blocksync_e2e(
             n_blocks=8, n_vals=4, txs_per_block=1, seed=3, timeout=60,
@@ -287,6 +299,9 @@ class TestPipelinedBlocksync:
         from cometbft_tpu.libs import flightrec
         from cometbft_tpu.types import validation
 
+        # the fault only fires if windows actually dispatch — the
+        # shared in-process verdict cache would resolve them at submit
+        sigcache.set_enabled(False)
         # force the ed25519 device lane so the injected dispatch_fn is
         # actually on the path (fixture sigs are far below the real
         # threshold); the stub keeps the XLA compile out of fast tier
@@ -377,6 +392,10 @@ class TestConsensusObservability:
             ConsensusMetrics, MetricsServer, P2PMetrics, Registry,
             TraceMetrics)
 
+        # the verify_dispatch span assertion needs live verification:
+        # with the in-process verdict cache shared across sim nodes,
+        # every gossiped vote resolves at submit
+        sigcache.set_enabled(False)
         net = SimNetwork(seed=31)
         net.set_default_link(latency=0.002, jitter=0.001)
         genesis, privs = make_sim_genesis(4, seed=31)

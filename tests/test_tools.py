@@ -361,6 +361,39 @@ class TestPerfGate:
         traj = mod.trajectory(str(tmp_path))
         assert all("rlc_batch" not in m for _, m in traj)
 
+    def test_verdict_cache_extras_gate_direction(self, tmp_path):
+        """The sigcache extras: verdict_cache_hit_rate gates
+        higher-is-better (a hit-rate collapse means commits started
+        re-verifying), commit_reverify_sigs_per_sec gates as a normal
+        rate, and critical_path_device_share never gates at all — the
+        cache removes device dispatches from the critical path by
+        design, so its fall is the feature, not a regression."""
+        mod = self._load()
+        assert "verdict_cache_hit_rate" not in mod.LOWER_IS_BETTER
+        history = [{"headline": 100.0,
+                    "verdict_cache_hit_rate": 0.8,
+                    "commit_reverify_sigs_per_sec": 400_000.0}
+                   for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "verdict_cache_hit_rate": 0.1,
+                         "commit_reverify_sigs_per_sec": 100_000.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["verdict_cache_hit_rate"]["status"] == "regressed"
+        assert by["commit_reverify_sigs_per_sec"]["status"] == \
+            "regressed"
+        # device share is filtered out at record-load time
+        for i, share in enumerate((0.6, 0.55, 0.2), start=1):
+            self._write(tmp_path, f"BENCH_r0{i}.json", 100.0,
+                        extra={"critical_path_device_share": share,
+                               "verdict_cache_hit_rate": 0.8})
+        traj = mod.trajectory(str(tmp_path))
+        assert all("critical_path_device_share" not in m
+                   for _, m in traj)
+        assert all(m["verdict_cache_hit_rate"] == 0.8 for _, m in traj)
+        assert mod.main(["--root", str(tmp_path), "--check-only"]) == 0
+
     def test_usage_errors_exit_2(self, tmp_path):
         import json
         mod = self._load()
