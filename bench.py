@@ -143,6 +143,81 @@ def bench_per_sig(batch: int, iters: int) -> float:
     return batch / dt
 
 
+def bench_device_hash(batch: int, iters: int, n_keys=None) -> float:
+    """Fused hash-to-scalar RLC dispatches: SHA-512(R||A||M), the
+    per-pubkey zh aggregation and the A-side signed-window recode all
+    run on device (ops/ed25519.rlc_verify_hash_kernel); the host ships
+    raw padded message blocks.  The host-hash device arm on the SAME
+    fixture rides .last_detail for the A/B delta — note the fused rate
+    folds in the hashing the host arm leaves behind in host_pack
+    spans."""
+    import jax
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519 as dev
+
+    pks, msgs, sigs = _make_sigs(batch, n_keys=n_keys)
+    packed = [jax.device_put(np.asarray(x))
+              for x in ed.pack_rlc_device_hash(pks, msgs, sigs)]
+    assert bool(np.asarray(dev.rlc_verify_hash_device(*packed))), \
+        "benchmark batch failed fused RLC verification"
+    t0 = time.perf_counter()
+    outs = [dev.rlc_verify_hash_device(*packed) for _ in range(iters)]
+    assert np.asarray(outs[-1])
+    rate = batch / ((time.perf_counter() - t0) / iters)
+
+    host_packed = [jax.device_put(x)
+                   for x in ed.pack_rlc(pks, msgs, sigs)]
+    assert bool(np.asarray(dev.rlc_verify_device(*host_packed)))
+    t0 = time.perf_counter()
+    outs = [dev.rlc_verify_device(*host_packed) for _ in range(iters)]
+    assert np.asarray(outs[-1])
+    host_rate = batch / ((time.perf_counter() - t0) / iters)
+    bench_device_hash.last_detail = {
+        "fused_sigs_per_sec": round(rate, 1),
+        "host_hash_device_sigs_per_sec": round(host_rate, 1)}
+    return rate
+
+
+def bench_commit_splice(n_vals: int = 200, iters: int = 50) -> float:
+    """Columnar vote sign-bytes assembly for one commit, ms/commit
+    (LOWER is better): one numpy splice per timestamp-length group vs
+    the per-signature canonical encode the columnar path replaced.
+    Byte parity is asserted before timing; the per-sig baseline rides
+    .last_detail."""
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT, BlockID, Commit, CommitSig, PartSetHeader)
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    bid = BlockID(b"\xab" * 32, PartSetHeader(3, b"\xcd" * 32))
+    sigs = [CommitSig(BLOCK_ID_FLAG_COMMIT, bytes([i % 256]) * 20,
+                      Timestamp(1_700_000_000 + i, (i * 7919) % 10 ** 9),
+                      b"\x00" * 64)
+            for i in range(n_vals)]
+    commit = Commit(height=1234, round=1, block_id=bid, signatures=sigs)
+    chain_id = "bench-chain"
+    cols = commit.vote_sign_bytes_all(chain_id)
+    per_sig = [canonical.vote_sign_bytes(chain_id, 2, 1234, 1, bid,
+                                         s.timestamp) for s in sigs]
+    assert cols == per_sig, "columnar splice broke sign-bytes parity"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        commit._sb_all = None          # defeat the memo: time the splice
+        commit.vote_sign_bytes_all(chain_id)
+    columnar_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        [canonical.vote_sign_bytes(chain_id, 2, 1234, 1, bid,
+                                   s.timestamp) for s in sigs]
+    per_sig_ms = (time.perf_counter() - t0) / iters * 1e3
+    bench_commit_splice.last_detail = {
+        "columnar_ms": round(columnar_ms, 3),
+        "per_sig_ms": round(per_sig_ms, 3),
+        "n_vals": n_vals}
+    return columnar_ms
+
+
 def bench_light_headers(n_validators: int, n_dispatches: int,
                         headers_per_dispatch: int) -> float:
     """Headers/sec for light-client sync: the syncing client batches
@@ -836,6 +911,8 @@ def main() -> None:
         ("mixed_commit_sigs_per_sec", "mixed_commit_config"),
         ("multichip_sharded_sigs_per_sec", "multichip_config"),
         ("multichip_scaling_efficiency", None),
+        ("device_hash_sigs_per_sec", "device_hash_config"),
+        ("commit_splice_ms", "commit_splice_config"),
     )
     # per-key provenance so CHAINED carries don't launder staleness
     # (review finding): a key already carried/merged in the previous
@@ -987,6 +1064,37 @@ def main() -> None:
             and isinstance(extra.get("rlc_cached_a_sigs_per_sec"),
                            (int, float))):
         extra["rlc_cached_a_pass_rates"] = bench_rlc.last_pass_rates
+        persist()
+    # fused hash-to-scalar arm (device-hash tentpole): same batch
+    # shape as the headline, host-hash device arm carried in detail
+    run_extra("device_hash_sigs_per_sec",
+              lambda: round(bench_device_hash(batch, iters), 1),
+              "device_hash_config",
+              f"fused SHA-512 + zh aggregation + A-recode on device,"
+              f" batch {batch}; host-hash device arm in"
+              f" device_hash_detail (its rate excludes the host"
+              f" hashing the fused kernel absorbs)")
+    if ("device_hash_sigs_per_sec" not in carried_keys
+            and isinstance(extra.get("device_hash_sigs_per_sec"),
+                           (int, float))
+            and isinstance(getattr(bench_device_hash, "last_detail",
+                                   None), dict)):
+        extra["device_hash_detail"] = bench_device_hash.last_detail
+        persist()
+    # columnar commit splice (ms/commit, LOWER is better — registered
+    # in scripts/perf_gate.py LOWER_IS_BETTER); numpy-only, no device
+    run_extra("commit_splice_ms",
+              lambda: round(bench_commit_splice(), 3),
+              "commit_splice_config",
+              "columnar vote sign-bytes splice (one numpy splice per"
+              " timestamp-length group), 200-sig commit, ms/commit;"
+              " per-signature canonical-encode baseline in"
+              " commit_splice_detail")
+    if ("commit_splice_ms" not in carried_keys
+            and isinstance(extra.get("commit_splice_ms"), (int, float))
+            and isinstance(getattr(bench_commit_splice, "last_detail",
+                                   None), dict)):
+        extra["commit_splice_detail"] = bench_commit_splice.last_detail
         persist()
     def run_extra_upgrade(key, config_key, fn, note):
         """Deepening tier: re-measure an ALREADY-BANKED metric at a

@@ -122,6 +122,20 @@ def wrap(msg) -> bytes:
     return pw.Writer().message_field(msg.FIELD, msg.to_proto()).bytes()
 
 
+def wrap_block_response_bytes(block_bytes: bytes,
+                              ext_commit=None) -> bytes:
+    """The wrapped BlockResponse built straight from serialized block
+    wire bytes — byte-identical to wrap(BlockResponse(block, ext))
+    because block.to_proto() IS block_bytes.  The serve path uses this
+    with BlockStore.load_block_bytes so a cache hit never decodes or
+    re-encodes the block."""
+    w = pw.Writer().message_field(1, block_bytes)
+    if ext_commit is not None:
+        w.message_field(2, ext_commit.to_proto())
+    return (pw.Writer()
+            .message_field(BlockResponse.FIELD, w.bytes()).bytes())
+
+
 def unwrap(payload: bytes):
     r = pw.Reader(payload)
     while not r.at_end():

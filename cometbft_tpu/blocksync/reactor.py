@@ -177,8 +177,11 @@ class BlocksyncReactor(Reactor):
             self.pool.no_block_response(peer.id, msg.height)
 
     def _respond_to_block_request(self, peer, height: int) -> None:
-        block = self.store.load_block(height)
-        if block is None:
+        # serve the serialized block directly: on a warm cache
+        # (store.load_block_bytes) this is a bytes splice — no block
+        # decode, no re-encode, no part split
+        block_bytes = self.store.load_block_bytes(height)
+        if block_bytes is None:
             peer.try_send(BLOCKSYNC_CHANNEL,
                           bm.wrap(bm.NoBlockResponse(height)))
             return
@@ -196,7 +199,8 @@ class BlocksyncReactor(Reactor):
             tctx = tl.ctx(height, 0)
             tl.send("blocksync", "BlockResponse", tctx)
         peer.try_send(BLOCKSYNC_CHANNEL,
-                      bm.wrap(bm.BlockResponse(block, ext)), tctx=tctx)
+                      bm.wrap_block_response_bytes(block_bytes, ext),
+                      tctx=tctx)
 
     # -- sync driver -------------------------------------------------------
     def _pool_routine(self) -> None:

@@ -163,6 +163,58 @@ def _device_verify(pubkeys: list[bytes], parsed, packed=_NO_PACK,
     return all(out) and bool(out), out
 
 
+def _device_verify_hash(pubkeys: list[bytes], msgs: list[bytes], parsed,
+                        packed=_NO_PACK,
+                        device=None) -> tuple[bool, list[bool]]:
+    """_device_verify with FUSED hash-to-scalar: h = SHA512(R||A||M)
+    mod L, the per-pubkey aggregation and the A-side recode all run on
+    device (ops/ed25519.rlc_verify_hash_kernel) — no digest ever
+    crosses back to the host, including the per-signature localization
+    kernel on a reject.  `parsed` is a parse_batch result
+    ((r_enc, s) | None; no h).  Raises ValueError("message exceeds
+    max_blocks") when a message outgrows the static block bucket — the
+    dispatch layer's host-fallback trigger."""
+    import numpy as np
+
+    from ..ops import ed25519 as dev
+
+    n = len(pubkeys)
+    if n >= 2:
+        rlc_ok = None
+        if packed is _NO_PACK and device is None:
+            from . import mesh
+
+            rlc_ok = mesh.maybe_split_verify_hash(pubkeys, msgs, parsed)
+        if rlc_ok is None:
+            if packed is _NO_PACK:
+                packed = ed.pack_rlc_device_hash(pubkeys, msgs,
+                                                 [b""] * n, parsed=parsed)
+            rlc_ok = packed is not None and \
+                ed.rlc_verify_hash(packed, device=device)
+        if rlc_ok:
+            return True, [True] * n
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.rlc_fallbacks.inc()
+        flightrec.record(flightrec.EV_RLC_FALLBACK, batch=n)
+    bucket = dev.bucket_size(n)
+    a, r, s, bh, bl, nb, valid = ed.pack_batch_device_hash(
+        pubkeys, msgs, [b""] * n, bucket, parsed=parsed)
+    if device is not None:
+        import jax
+
+        a, r, s, bh, bl, nb = (jax.device_put(x, device)
+                               for x in (a, r, s, bh, bl, nb))
+    verdict = np.asarray(dev.verify_batch_hash_device(a, r, s, bh, bl,
+                                                      nb))
+    verdict = verdict & valid
+    out = verdict[:n].tolist()
+    return all(out) and bool(out), out
+
+
 class CpuSecp256k1BatchVerifier(_CpuLoopVerifier):
     """Parity oracle for the secp256k1 device path."""
 

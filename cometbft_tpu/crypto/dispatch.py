@@ -52,11 +52,20 @@ from ..libs.service import BaseService
 # deeper helps only when device time >> host time per window
 DEFAULT_DEPTH = int(os.environ.get("COMETBFT_TPU_PIPELINE_DEPTH", "2"))
 # the host pool parallelizes WITHIN a window (parse_and_hash chunks);
-# hashlib releases the GIL so this scales to real cores
-DEFAULT_HOST_WORKERS = int(os.environ.get(
-    "COMETBFT_TPU_PIPELINE_WORKERS",
-    str(min(4, os.cpu_count() or 1))))
+# hashlib releases the GIL so this scales to real cores.  Sized from
+# the machine (one core stays free for the device thread) instead of
+# the old static min(4, cpu_count) cap, which left a 16-core host
+# hashing on 4 threads; COMETBFT_TPU_PIPELINE_WORKERS pins it exactly.
+DEFAULT_HOST_WORKERS = int(
+    os.environ.get("COMETBFT_TPU_PIPELINE_WORKERS", "0")) or \
+    max(1, (os.cpu_count() or 2) - 1)
 _MIN_PARALLEL_CHUNK = 256
+# below this many signatures the hash runs INLINE on the staging
+# thread: the pool handoff (submit + futures + result gather) costs
+# more than hashlib saves on a tiny votestream flush
+PARSE_INLINE_THRESHOLD = int(os.environ.get(
+    "COMETBFT_TPU_PARSE_INLINE_THRESHOLD",
+    str(2 * _MIN_PARALLEL_CHUNK)))
 
 
 def parse_and_hash_parallel(pubkeys, msgs, sigs, pool=None,
@@ -65,14 +74,14 @@ def parse_and_hash_parallel(pubkeys, msgs, sigs, pool=None,
 
     Byte-identical to the serial function (pinned by
     tests/test_dispatch.py): chunking only partitions the index space.
-    Small batches (or pool=None) stay serial — the fan-out overhead
-    beats the hashing below ~256 signatures.
+    Small batches (under PARSE_INLINE_THRESHOLD, or pool=None) stay
+    serial — the fan-out overhead beats the hashing there.
     """
     from . import ed25519 as ed
 
     n = len(pubkeys)
     nworkers = workers if workers is not None else DEFAULT_HOST_WORKERS
-    if pool is None or nworkers <= 1 or n < 2 * _MIN_PARALLEL_CHUNK:
+    if pool is None or nworkers <= 1 or n < PARSE_INLINE_THRESHOLD:
         return ed.parse_and_hash(pubkeys, msgs, sigs)
     chunk = max(_MIN_PARALLEL_CHUNK, -(-n // nworkers))
     spans = [(i, min(i + chunk, n)) for i in range(0, n, chunk)]
@@ -146,8 +155,8 @@ class WindowHandle:
 
 class _Window:
     __slots__ = ("items", "handle", "threshold", "mode", "pks",
-                 "parsed", "packed", "verifier", "staged", "device_s",
-                 "device_index", "dispatching", "result",
+                 "msgs", "parsed", "packed", "verifier", "staged",
+                 "device_s", "device_index", "dispatching", "result",
                  "all_items", "cached")
 
     def __init__(self, items, handle, threshold):
@@ -160,8 +169,9 @@ class _Window:
         self.threshold = threshold
         self.all_items = items
         self.cached = None
-        self.mode = None          # "ed" | "mixed" | "host"
+        self.mode = None          # "ed" | "ed_hash" | "mixed" | "host"
         self.pks = None
+        self.msgs = None          # kept for ed_hash reject localization
         self.parsed = None
         self.packed = None
         self.verifier = None
@@ -398,6 +408,7 @@ class VerifyPipeline(BaseService):
     def _staging_loop(self) -> None:
         from ..libs import trace as libtrace
         from ..libs import tracetl
+        from . import ed25519 as ed
 
         while True:
             with self._cv:
@@ -407,11 +418,21 @@ class VerifyPipeline(BaseService):
                 if self._stopping and self._next_unstaged() is None:
                     return
                 win = self._next_unstaged()
+            # span name decided UP FRONT from the knob (not win.mode,
+            # set inside _stage): in device-hash mode the staging
+            # thread's job shrinks to splice+pack, and the split
+            # host_splice/device_hash names keep tracetl's critical
+            # path decomposition summing exactly (both map into the
+            # existing host_pack/device segments)
+            stage_span = "host_splice" if (
+                ed.device_hash_enabled()
+                and os.environ.get("COMETBFT_TPU_PROVIDER",
+                                   "auto") != "cpu") else "host_pack"
             try:
-                with libtrace.span(win.handle.subsystem, "host_pack",
+                with libtrace.span(win.handle.subsystem, stage_span,
                                    inflight=len(self._windows)), \
                         tracetl.span_for(
-                            self, win.handle.subsystem, "host_pack",
+                            self, win.handle.subsystem, stage_span,
                             **tracetl.ctx_fields(win.handle.ctx)):
                     self._stage(win)
             except Exception:
@@ -452,10 +473,27 @@ class VerifyPipeline(BaseService):
         msgs = [m for _, m, _ in items]
         sigs = [s for _, _, s in items]
         win.pks = pks
+        n = len(pks)
+        if ed.device_hash_enabled() and n >= 2:
+            # fused hash-to-scalar staging: structural parse + splice
+            # only — hashing, zh aggregation and the A-side recode run
+            # on device.  Structural rejects and oversized messages
+            # fall through to the host-hash staging below (the drain
+            # path is unchanged; the fallback is observable).
+            parsed = ed.parse_batch(pks, sigs)
+            if all(p is not None for p in parsed):
+                try:
+                    win.packed = ed.pack_rlc_device_hash(
+                        pks, msgs, sigs, parsed=parsed)
+                    win.parsed = parsed
+                    win.msgs = msgs
+                    win.mode = "ed_hash"
+                    return
+                except ValueError:
+                    self._record_hash_fallback(n)
         win.parsed = parse_and_hash_parallel(
             pks, msgs, sigs, pool=self._pool,
             workers=self.host_workers)
-        n = len(pks)
         if n >= 2:
             # pack (aggregation + recode) here so the device thread
             # only dispatches; None = structural reject, the device
@@ -463,6 +501,18 @@ class VerifyPipeline(BaseService):
             win.packed = ed.pack_rlc(pks, [b""] * n, [b""] * n,
                                      parsed=win.parsed)
         win.mode = "ed"
+
+    def _record_hash_fallback(self, n: int) -> None:
+        """A window left the device-hash path (message exceeded the
+        static SHA-512 block bucket): count it and leave a flightrec
+        breadcrumb — the window still verifies via host-hash staging."""
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.device_hash_fallbacks.inc()
+        flightrec.record(flightrec.EV_DEVICE_HASH_FALLBACK, batch=n)
 
     # -- device (ordered dispatch) -------------------------------------
 
@@ -496,7 +546,7 @@ class VerifyPipeline(BaseService):
         """The path decision + verdict computation shared by the
         single-device loop and the per-device mesh loops; returns
         (ok, verdicts, path)."""
-        if faulted and win.mode in ("ed", "mixed"):
+        if faulted and win.mode in ("ed", "ed_hash", "mixed"):
             # draining after a device fault: everything staged
             # behind the faulted window resolves on the host
             ok, verdicts = self._host_fallback(win)
@@ -589,11 +639,12 @@ class VerifyPipeline(BaseService):
 
         t0 = time.monotonic()
         path = "host"
+        dev_span = "device_hash" if win.mode == "ed_hash" else "device"
         try:
-            with libtrace.span(win.handle.subsystem, "device",
+            with libtrace.span(win.handle.subsystem, dev_span,
                                inflight=len(self._windows)), \
                     tracetl.span_for(
-                        self, win.handle.subsystem, "device",
+                        self, win.handle.subsystem, dev_span,
                         cache=self._cache_hits(win),
                         **tracetl.ctx_fields(win.handle.ctx)):
                 ok, verdicts, path = self._compute_verdicts(
@@ -635,12 +686,14 @@ class VerifyPipeline(BaseService):
                 faulted = idx in self._dev_faulted
             t0 = time.monotonic()
             path = "host"
+            dev_span = "device_hash" if win.mode == "ed_hash" \
+                else "device"
             try:
-                with libtrace.span(win.handle.subsystem, "device",
+                with libtrace.span(win.handle.subsystem, dev_span,
                                    inflight=len(self._windows),
                                    device=idx), \
                         tracetl.span_for(
-                            self, win.handle.subsystem, "device",
+                            self, win.handle.subsystem, dev_span,
                             device=idx, cache=self._cache_hits(win),
                             **tracetl.ctx_fields(win.handle.ctx)):
                     ok, verdicts, path = self._compute_verdicts(
@@ -688,6 +741,11 @@ class VerifyPipeline(BaseService):
             return win.verifier.verify()
         from . import batch as cb
 
+        if win.mode == "ed_hash":
+            return cb._device_verify_hash(win.pks, win.msgs,
+                                          win.parsed,
+                                          packed=win.packed,
+                                          device=device)
         return cb._device_verify(win.pks, win.parsed,
                                  packed=win.packed, device=device)
 

@@ -209,39 +209,79 @@ NDIG_128 = 26       # signed-5-bit digits covering 128-bit z (+carry)
 NDIG_256 = 52       # covering scalars < L (253 bits, +carry)
 
 
-def _recode_w5(values: list[int], ndig: int, width: int):
-    """Signed radix-32 recoding: each value becomes ndig digits in
-    [-16, 15] (LSB-up with carry), emitted MSB-first as separate
-    magnitude (int32) and sign (bool) arrays of shape (ndig, width).
-    Pad columns beyond len(values) stay zero (identity contribution).
+def _recode_nbytes(ndig: int) -> int:
+    """Little-endian byte width of _recode_w5's raw input rows."""
+    return (5 * ndig + 7) // 8 + 1
 
-    Vectorized over the batch: raw 5-bit digit extraction happens on a
-    (n, nbytes) uint8 view, then one carry sweep over the ndig digits
-    (numpy ops per digit, not per scalar) — host packing must not
-    bottleneck the device pipeline."""
+
+def _recode_w5_scalar(values: list[int], ndig: int, width: int):
+    """Pure-Python reference recoding, one value and one digit at a
+    time (the pre-vectorization semantics, LSB-up carry sweep): the
+    parity oracle `_recode_w5` and the device-side recode are pinned
+    against in tests/test_recode.py."""
+    mag = np.zeros((width, ndig), np.int32)
+    neg = np.zeros((width, ndig), bool)
+    for i, v in enumerate(values):
+        assert v < 1 << (5 * ndig), \
+            "scalar out of range for recoding width"
+        digs = [(v >> (5 * j)) & 31 for j in range(ndig)]
+        carry = 0
+        for j in range(ndig):
+            d = digs[j] + carry
+            carry = 1 if d > 15 else 0
+            digs[j] = d - 32 if d > 15 else d
+        assert carry == 0, "scalar out of range for recoding width"
+        mag[i] = [abs(d) for d in digs]
+        neg[i] = [d < 0 for d in digs]
+    return (np.ascontiguousarray(mag.T[::-1]),
+            np.ascontiguousarray(neg.T[::-1]))
+
+
+def _recode_w5(values, ndig: int, width: int):
+    """Signed radix-32 recoding: each value becomes ndig digits in
+    [-16, 15], emitted MSB-first as separate magnitude (int32) and sign
+    (bool) arrays of shape (ndig, width).  Pad columns beyond
+    len(values) stay zero (identity contribution).
+
+    Fully vectorized via the bias trick: the signed digits of x are the
+    plain base-32 digits of x + BIAS minus 16, where
+    BIAS = sum_j 16*32**j — pre-paying the worst-case borrow turns the
+    old data-dependent carry sweep into one addition plus static bit
+    extraction, and is the exact algorithm the device recode
+    (ops/ed25519._recode_w5_device) runs.  `values` is either a
+    list[int] or an already-raw (n, _recode_nbytes(ndig)) uint8 array
+    of little-endian bytes (the device-hash packer hands z straight
+    from its random byte block, never materializing Python ints)."""
     n = len(values)
     mag = np.zeros((width, ndig), np.int32)
     neg = np.zeros((width, ndig), bool)
     if n:
-        assert max(values) < 1 << (5 * ndig), \
-            "scalar out of range for recoding width"
-        nbytes = (5 * ndig + 7) // 8 + 1
-        raw = np.frombuffer(
-            b"".join(v.to_bytes(nbytes, "little") for v in values),
-            dtype=np.uint8).reshape(n, nbytes).astype(np.uint16)
+        nbytes = _recode_nbytes(ndig)
+        if isinstance(values, np.ndarray):
+            assert values.shape == (n, nbytes) and values.dtype == np.uint8
+            raw = values.astype(np.uint16)
+        else:
+            assert max(values) < 1 << (5 * ndig), \
+                "scalar out of range for recoding width"
+            raw = np.frombuffer(
+                b"".join(v.to_bytes(nbytes, "little") for v in values),
+                dtype=np.uint8).reshape(n, nbytes).astype(np.uint16)
+        bias = np.frombuffer(
+            sum(16 << (5 * j) for j in range(ndig)).to_bytes(
+                nbytes, "little"), dtype=np.uint8).astype(np.uint16)
+        acc = raw + bias                      # per-byte sums < 2**9
+        carry = np.zeros(n, np.uint16)
+        for k in range(nbytes):
+            t = acc[:, k] + carry
+            acc[:, k] = t & 0xFF
+            carry = t >> 8
+        assert not carry.any(), "scalar out of range for recoding width"
         digs = np.empty((n, ndig), np.int16)
         for j in range(ndig):
             off = 5 * j
             k, sh = off >> 3, off & 7
-            word = raw[:, k] | (raw[:, k + 1] << 8)
-            digs[:, j] = (word >> sh) & 31
-        carry = np.zeros(n, np.int16)
-        for j in range(ndig):
-            d = digs[:, j] + carry
-            over = d > 15
-            digs[:, j] = np.where(over, d - 32, d)
-            carry = over.astype(np.int16)
-        assert not carry.any(), "scalar out of range for recoding width"
+            word = acc[:, k] | (acc[:, k + 1] << 8)
+            digs[:, j] = (((word >> sh) & 31).astype(np.int16)) - 16
         mag[:n] = np.abs(digs)
         neg[:n] = digs < 0
     return (np.ascontiguousarray(mag.T[::-1]),
@@ -332,6 +372,233 @@ def pack_rlc(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
     return (np.ascontiguousarray(a_words.T),
             np.ascontiguousarray(r_words.T),
             a_mag, a_neg, r_mag, r_neg)
+
+
+# ---------------------------------------------------------------------------
+# device-side hash-to-scalar packing (COMETBFT_TPU_DEVICE_HASH)
+# ---------------------------------------------------------------------------
+#
+# The fused kernel (ops/ed25519.rlc_verify_hash_kernel) computes
+# h = SHA512(R||A||M) mod L, zh = z*h, the per-pubkey aggregation AND
+# the signed-window recode on device; the host's per-signature work
+# shrinks to a structural parse plus one columnar message-pad.  No
+# digest or scalar ever crosses back to the host.
+
+
+def device_hash_enabled() -> bool:
+    """Env knob for the fused device-hash verify path.  Read per call
+    (cheap) so tests and operators can flip it without reloads."""
+    return os.environ.get("COMETBFT_TPU_DEVICE_HASH", "0") == "1"
+
+
+# Static SHA-512 block bucket for R||A||M messages.  Vote sign-bytes
+# are ~110-130 bytes; +64 for R||A and +17 padding overhead needs 3
+# blocks.  Messages that exceed the bucket raise ValueError from
+# sha2._pad, which the dispatch layer turns into a host-hash fallback
+# (flightrec EV_DEVICE_HASH_FALLBACK + DeviceMetrics counter).
+DEVICE_HASH_MAX_BLOCKS = int(os.environ.get(
+    "COMETBFT_TPU_DEVICE_HASH_BLOCKS", "3"))
+
+
+def parse_batch(pubkeys: list[bytes],
+                sigs: list[bytes]) -> list[tuple[bytes, int] | None]:
+    """Structural parse ONLY (lengths, s < L) — the host side of the
+    device-hash path, where parse_and_hash's hashlib loop never runs."""
+    return [parse_signature(sig) if len(pk) == PUBKEY_SIZE else None
+            for pk, sig in zip(pubkeys, sigs)]
+
+
+def pack_rlc_device_hash(pubkeys: list[bytes], msgs: list[bytes],
+                         sigs: list[bytes], parsed=None,
+                         max_blocks: int | None = None):
+    """Pack a batch for the fused hash-to-scalar RLC kernel.
+
+    `parsed` is a parse_batch result ((r_enc, s) | None per entry — no
+    h).  Host work per signature: a 128-bit z draw (one vectorized
+    block), c += z*s mod L, and the R||A||M byte splice; hashing,
+    per-pubkey zh aggregation and the A-side recode all move on-device.
+
+    Returns the kernel's positional argument tuple
+    (a_words (8,K), r_words (8,N), base_limbs (K,16), z_limbs (N,8),
+    group_ids (N,), blocks_hi/lo (N,B,16), n_blocks (N,),
+    r_mag/r_neg (26,N)), or None if any entry fails structural checks.
+    Raises ValueError("message exceeds max_blocks") when a message
+    outgrows the static block bucket — the caller's fallback trigger.
+    """
+    import secrets
+
+    from ..ops import ed25519 as dev
+    from ..ops import limbs as lb
+    from ..ops import sha2
+
+    global _NEG_B_ENC
+    if _NEG_B_ENC is None:
+        _NEG_B_ENC = _neg_b_encoding()
+
+    n = len(pubkeys)
+    if n == 0:
+        return None
+    if parsed is None:
+        parsed = parse_batch(pubkeys, sigs)
+    if max_blocks is None:
+        max_blocks = DEVICE_HASH_MAX_BLOCKS
+
+    zraw = np.frombuffer(secrets.token_bytes(16 * n),
+                         dtype=np.uint8).reshape(n, 16).copy()
+    zraw[:, 15] |= 0x80                    # pin the top bit, like pack_rlc
+
+    if any(p is None for p in parsed):
+        return None
+
+    # callers that parsed ahead of time (crypto/batch._device_verify_hash,
+    # crypto/mesh.split_rlc_verify_hash) pass placeholder sigs; the
+    # 64-byte rows rebuild from parsed's (r_enc, s)
+    if len(sigs[0]) != 64:
+        sigs = [r_enc + s.to_bytes(32, "little") for r_enc, s in parsed]
+
+    # fully vectorized from here: parse_batch guaranteed every sig is
+    # 64 bytes and every key 32, so the whole batch flattens into two
+    # matrices and the per-signature Python loop disappears.
+    nbatch = dev.pad_width(n)
+    filler = np.frombuffer(ref.point_compress(ref.B), dtype=np.uint32)
+    sig_mat = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+    pk_mat = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(n, 32)
+    r_words = np.empty((nbatch, 8), dtype=np.uint32)
+    r_words[:] = filler
+    r_words[:n] = np.ascontiguousarray(sig_mat[:, :32]).view(np.uint32)
+
+    # group ids without the per-signature dict walk: unique keys via a
+    # byte-comparing sort, remapped to FIRST-APPEARANCE order so slot
+    # assignment matches the host-hash packer exactly
+    pk_void = pk_mat.view(np.dtype((np.void, 32))).ravel()
+    _, first_idx, inv = np.unique(pk_void, return_index=True,
+                                  return_inverse=True)
+    n_keys = len(first_idx)
+    remap = np.empty(n_keys, dtype=np.int32)
+    remap[np.argsort(first_idx)] = np.arange(n_keys, dtype=np.int32)
+    group_ids = np.zeros(nbatch, dtype=np.int32)
+    group_ids[:n] = remap[inv] + 1
+
+    # c = sum(z_i * s_i) mod L as one uint16-limb convolution: column
+    # sums are bounded by 8n * 2^32, far under 2^64, so a single
+    # big-int fold replaces n per-signature 384-bit modmuls
+    s16 = np.ascontiguousarray(sig_mat[:, 32:]).view(np.uint16)
+    z16 = zraw.view(np.uint16)
+    cols = np.zeros(23, dtype=np.uint64)
+    for j in range(8):
+        cols[j:j + 16] += (z16[:, j:j + 1].astype(np.uint64)
+                           * s16).sum(axis=0)
+    c = sum(int(v) << (16 * k) for k, v in enumerate(cols)) % L
+
+    # columnar R||A||M assembly straight into the padded block matrix:
+    # the 64-byte prefix is two matrix copies, the message bytes one
+    # reshape when lengths are uniform (the vote case), and
+    # pad_sha512_matrix finishes 0x80/bit-length in place
+    mlens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
+    lens = np.zeros(nbatch, dtype=np.int64)
+    lens[:n] = 64 + mlens
+    if int(lens.max()) + 1 + 16 > max_blocks * 128:
+        raise ValueError("message exceeds max_blocks")
+    mat = np.zeros((nbatch, max_blocks * 128), dtype=np.uint8)
+    mat[:n, :32] = sig_mat[:, :32]
+    mat[:n, 32:64] = pk_mat
+    flat = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    m0 = int(mlens[0])
+    if np.all(mlens == m0):
+        mat[:n, 64:64 + m0] = flat.reshape(n, m0)
+    else:
+        colix = np.arange(max_blocks * 128, dtype=np.int64)
+        mask = (colix[None, :] >= 64) & (colix[None, :] < lens[:n, None])
+        mat[:n][mask] = flat
+    blocks_hi, blocks_lo, n_blocks = sha2.pad_sha512_matrix(mat, lens)
+    n_blocks[n:] = 0                       # fillers: z = 0 keeps them inert
+
+    kbatch = dev.pad_width(1 + n_keys)
+    a_words = np.empty((kbatch, 8), dtype=np.uint32)
+    a_words[:] = filler
+    a_words[0] = np.frombuffer(_NEG_B_ENC, dtype=np.uint32)
+    a_words[1:1 + n_keys] = np.ascontiguousarray(
+        pk_mat[np.sort(first_idx)]).view(np.uint32)
+    base_limbs = np.zeros((kbatch, 16), dtype=np.uint32)
+    base_limbs[0] = lb.int_to_limbs(c, 16)
+
+    z_limbs = np.zeros((nbatch, 8), dtype=np.uint32)
+    z_limbs[:n] = zraw[:, 0::2].astype(np.uint32) | \
+        (zraw[:, 1::2].astype(np.uint32) << 8)
+    zbytes = np.zeros((n, _recode_nbytes(NDIG_128)), dtype=np.uint8)
+    zbytes[:, :16] = zraw
+    r_mag, r_neg = _recode_w5(zbytes, NDIG_128, nbatch)
+    return (np.ascontiguousarray(a_words.T),
+            np.ascontiguousarray(r_words.T),
+            base_limbs, z_limbs, group_ids,
+            blocks_hi, blocks_lo, n_blocks, r_mag, r_neg)
+
+
+def pack_batch_device_hash(pubkeys: list[bytes], msgs: list[bytes],
+                           sigs: list[bytes], batch_size: int,
+                           parsed=None, max_blocks: int | None = None):
+    """Per-signature packing with device-side hashing — the reject
+    localization arm of the fused mode (digests stay on device even
+    when a batch fails and individual verdicts are needed).
+
+    Returns (a_words (8,B), r_words (8,B), s_limbs (16,B),
+    blocks_hi/lo (B,Bk,16), n_blocks (B,), valid (B,)); raises
+    ValueError on an oversized message like pack_rlc_device_hash.
+    """
+    from ..ops import limbs as lb
+    from ..ops import sha2
+
+    n = len(pubkeys)
+    assert batch_size >= n
+    if parsed is None:
+        parsed = parse_batch(pubkeys, sigs)
+    if max_blocks is None:
+        max_blocks = DEVICE_HASH_MAX_BLOCKS
+    valid = np.zeros(batch_size, dtype=bool)
+    a_words = np.zeros((batch_size, 8), dtype=np.uint32)
+    r_words = np.zeros((batch_size, 8), dtype=np.uint32)
+    s_limbs = np.zeros((batch_size, 16), dtype=np.uint32)
+    hash_msgs = []
+    for i in range(n):
+        if parsed[i] is None:
+            hash_msgs.append(b"")
+            continue
+        r_enc, s = parsed[i]
+        valid[i] = True
+        a_words[i] = np.frombuffer(pubkeys[i], dtype=np.uint32)
+        r_words[i] = np.frombuffer(r_enc, dtype=np.uint32)
+        s_limbs[i] = lb.int_to_limbs(s, 16)
+        hash_msgs.append(r_enc + pubkeys[i] + msgs[i])
+    blocks_hi, blocks_lo, n_blocks = sha2.pad_sha512(
+        hash_msgs + [b""] * (batch_size - n), max_blocks)
+    n_blocks[~valid] = 0
+    filler = np.frombuffer(ref.point_compress(ref.B), dtype=np.uint32)
+    a_words[~valid] = filler
+    r_words[~valid] = filler
+    return (np.ascontiguousarray(a_words.T),
+            np.ascontiguousarray(r_words.T),
+            np.ascontiguousarray(s_limbs.T),
+            blocks_hi, blocks_lo, n_blocks, valid)
+
+
+def rlc_verify_hash_async(packed, device=None):
+    """Fused-kernel dispatch without the host sync (see
+    rlc_verify_async).  The A-table cache is not plumbed through this
+    kernel yet: the fused program recodes its A scalars on device, and
+    the cacheable part (decompression + table build) is a smaller
+    fraction of its runtime than of the host-hash kernel's."""
+    from ..ops import ed25519 as dev
+
+    if device is not None:
+        import jax
+
+        packed = tuple(jax.device_put(np.asarray(x), device)
+                       for x in packed)
+    return dev.rlc_verify_hash_device(*packed)
+
+
+def rlc_verify_hash(packed, device=None) -> bool:
+    return bool(np.asarray(rlc_verify_hash_async(packed, device=device)))
 
 
 # one cached A-table slot: 17 rows x 4 coords x 20 int32 limbs

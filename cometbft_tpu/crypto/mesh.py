@@ -108,6 +108,51 @@ def maybe_split_verify(pubkeys: list[bytes], parsed,
     return all(verdicts)
 
 
+def split_rlc_verify_hash(pubkeys: list[bytes], msgs: list[bytes],
+                          parsed, devices):
+    """split_rlc_verify for the fused hash-to-scalar kernel: each
+    chunk's pack carries its own message blocks (blocks_hi/lo travel to
+    that chunk's chip with the rest of the pack), so the device-hash
+    mode splits across a mesh exactly like the host-hash mode.
+    `parsed` is a parse_batch result ((r_enc, s) | None).  Propagates
+    pack_rlc_device_hash's ValueError on an oversized message."""
+    from . import ed25519 as ed
+
+    n = len(pubkeys)
+    spans = split_spans(n, len(devices))
+    packs = []
+    for a, b in spans:
+        packed = ed.pack_rlc_device_hash(pubkeys[a:b], msgs[a:b],
+                                         [b""] * (b - a),
+                                         parsed=parsed[a:b])
+        if packed is None:
+            return None
+        packs.append(packed)
+    outs = []
+    for i, (packed, dev_) in enumerate(zip(packs, devices)):
+        outs.append(ed.rlc_verify_hash_async(packed, device=dev_))
+        _count_dispatch(i)
+    return [bool(np.asarray(o)) for o in outs]
+
+
+def maybe_split_verify_hash(pubkeys: list[bytes], msgs: list[bytes],
+                            parsed, min_split: int | None = None):
+    """maybe_split_verify for the device-hash mode (see
+    crypto/batch._device_verify_hash)."""
+    n = len(pubkeys)
+    if n < (min_split if min_split is not None else MIN_SPLIT):
+        return None
+    from ..ops import sharding
+
+    devices = sharding.mesh_device_list(None)
+    if devices is None:
+        return None
+    verdicts = split_rlc_verify_hash(pubkeys, msgs, parsed, devices)
+    if verdicts is None:
+        return False
+    return all(verdicts)
+
+
 def verify_batch_mesh(pubkeys: list[bytes], parsed):
     """Per-signature verdicts with the batch axis sharded over the
     mesh and the bucket auto-sized from device_count() — the

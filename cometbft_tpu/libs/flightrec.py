@@ -50,6 +50,11 @@ EV_PIPELINE_DRAIN = "pipeline_drain"  # verify pipeline drained after a
 #                                       mid-flight device failure
 #                                       (crypto/dispatch.py); carries
 #                                       inflight + staged depths
+EV_DEVICE_HASH_FALLBACK = "device_hash_fallback"  # a window left the
+#                                       fused device-hash path (message
+#                                       exceeded the static SHA-512
+#                                       block bucket) and re-staged
+#                                       through host hashing
 
 
 class FlightRecorder:

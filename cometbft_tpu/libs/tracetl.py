@@ -62,6 +62,12 @@ STAGE_SEGMENTS = {
     "apply": "apply", "store": "apply", "commit": "apply",
     "collect": "collect", "decode": "collect", "fetch": "collect",
     "propose": "collect", "prevote": "collect", "precommit": "collect",
+    # device-hash verify mode (crypto/dispatch.py): host_pack's
+    # successors — staging shrinks to splice+pack, hashing joins the
+    # device dispatch.  Mapping INTO the existing segments keeps the
+    # critical-path sweep's exact-sum property and every downstream
+    # consumer (perf gate, PERF.md tables) comparable across modes.
+    "host_splice": "host_pack", "device_hash": "device",
 }
 # highest-priority segment wins when spans overlap in the sweep
 SEGMENT_PRIORITY = ("device", "host_pack", "apply", "collect")

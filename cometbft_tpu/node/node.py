@@ -368,6 +368,8 @@ class Node(BaseService):
                 1 if self._statesync_enabled else 0)
             self.app_conns.set_metrics(ProxyMetrics(registry))
             self.store_metrics = StoreMetrics(registry)
+            # serialized-block cache counters (store/blockstore.py)
+            self.block_store.metrics = self.store_metrics
             libmetrics.instrument_methods(
                 self.state_store,
                 self.state_metrics.store_access_duration_seconds,

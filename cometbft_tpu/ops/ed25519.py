@@ -35,6 +35,9 @@ import jax
 import jax.numpy as jnp
 
 from . import fe
+from . import limbs as lb
+from . import scalar25519 as sc
+from . import sha2
 from ..crypto import ed25519_ref as ref
 
 # ---------------------------------------------------------------------------
@@ -639,3 +642,156 @@ def bucket_size(n: int) -> int:
 
 def verify_batch_device(a_words, r_words, s_limbs, h_limbs):
     return _jitted(a_words, r_words, s_limbs, h_limbs)
+
+
+# ---------------------------------------------------------------------------
+# fused hash-to-scalar verify (device-side h = SHA512(R||A||M) mod L)
+# ---------------------------------------------------------------------------
+#
+# The RLC path above still receives h_i REDUCTIONS from the host: every
+# signature's SHA-512 runs through hashlib and the per-pubkey z*h
+# aggregation plus the signed-window recode run in numpy — the largest
+# host stage left on the blocksync critical path.  The fused variant
+# moves all of it onto the device:
+#
+#   h_i   = SHA512(R_i || A_i || M_i) mod L      (sha2 kernel + Barrett)
+#   zh_i  = z_i * h_i mod L                      (limb mul + Barrett)
+#   agg_k = (base_k + sum_{group(i)=k} zh_i) mod L
+#   digits= signed 5-bit recode of agg_k          (bias trick, below)
+#
+# and feeds the digits straight into rlc_verify_kernel — no digest or
+# scalar ever crosses back to the host.  The host ships raw padded
+# message blocks, the 128-bit z_i as limbs, a per-signature group id
+# mapping each sig to its distinct-pubkey A slot, and per-slot host
+# scalars (slot 0 carries c = sum z_i*s_i mod L for the -B fixed-base
+# term; every other slot is zero).  Filler signatures carry z = 0 so
+# their zh vanishes no matter what their (zeroed) blocks hash to.
+#
+# Signed-digit recode without a sequential carry sweep: the signed
+# 5-bit digits of x are exactly the base-32 digits of x + BIAS minus
+# 16, where BIAS = sum_j 16*32**j — adding 16 to every digit position
+# pre-pays the worst-case borrow, turning the host's data-dependent
+# carry loop into one limb addition plus static bit extraction.
+
+_NDIG_A = 52                       # 256-bit scalars, 5-bit windows
+_W5_BIAS_LIMBS = lb.int_to_limbs(
+    sum(16 << (5 * j) for j in range(_NDIG_A)), 17)
+_SEG_BYTES = 36                    # sum_i zh_i < 2**17 * L < 2**270
+
+
+def _h_scalars(blocks_hi, blocks_lo, n_blocks):
+    """Padded message blocks -> (N, 16) limbs of SHA512(msg) mod L."""
+    sh, sl = sha2.sha512_blocks(blocks_hi, blocks_lo, n_blocks)
+    return sc.barrett_reduce_wide(sc.digest512_to_wide_limbs(sh, sl))
+
+
+def _zh_mod_l(z_limbs, h_limbs):
+    """(N, 8) z limbs x (N, 16) h limbs -> (N, 16) z*h mod L.
+
+    The 384-bit product is < 2**381 < 2**512, inside Barrett's domain.
+    """
+    prod = lb.mul(z_limbs, h_limbs)                       # (N, 24)
+    zeros = jnp.zeros(prod.shape[:-1] + (sc.WIDE - prod.shape[-1],),
+                      dtype=jnp.uint32)
+    return sc.barrett_reduce_wide(jnp.concatenate([prod, zeros], axis=-1))
+
+
+def _segment_sum_mod_l(zh, group_ids, k):
+    """Per-A-slot sum of zh rows mod L: (N, 16) x (N,) -> (k, 16).
+
+    The scatter-add runs in radix 2**8: each 16-bit limb splits into
+    two byte columns, so a column accumulates at most N * 255 < 2**25
+    per lane at the 131071-sig max shape — no uint32 overflow, unlike a
+    direct 16-bit-limb scatter which overflows past N = 65536.  A
+    static byte-radix carry sweep then renormalizes before Barrett.
+    """
+    cols = jnp.stack([zh & jnp.uint32(0xFF), zh >> 8],
+                     axis=-1).reshape(zh.shape[:-1] + (2 * zh.shape[-1],))
+    acc = jnp.zeros((k, cols.shape[-1]), dtype=jnp.uint32)
+    acc = acc.at[group_ids].add(cols)
+    out = []
+    carry = jnp.zeros((k,), dtype=jnp.uint32)
+    for j in range(_SEG_BYTES):
+        v = carry if j >= acc.shape[-1] else acc[..., j] + carry
+        out.append(v & jnp.uint32(0xFF))
+        carry = v >> 8
+    by = jnp.stack(out, axis=-1)                          # (k, 36) bytes
+    limbs = by[..., 0::2] | (by[..., 1::2] << 8)          # (k, 18)
+    zeros = jnp.zeros((k, sc.WIDE - limbs.shape[-1]), dtype=jnp.uint32)
+    return sc.barrett_reduce_wide(jnp.concatenate([limbs, zeros], axis=-1))
+
+
+def _add_mod_l(a, b):
+    """(…, 16) + (…, 16) mod L for inputs already < L."""
+    s, _ = lb.carry_prop(a + b)                           # sum < 2L < 2**254
+    return lb.cond_sub(s, jnp.asarray(sc.L_LIMBS))
+
+
+def _recode_w5_device(scalars):
+    """(K, 16) limbs (< L) -> ((52, K), (52, K)) signed-window digit
+    magnitudes and signs, MSB-first — bit-identical to the host
+    crypto/ed25519._recode_w5 (pinned by tests/test_device_hash.py)."""
+    pad = jnp.zeros(scalars.shape[:-1] + (1,), dtype=jnp.uint32)
+    xb, _ = lb.carry_prop(
+        jnp.concatenate([scalars, pad], axis=-1) +
+        jnp.asarray(_W5_BIAS_LIMBS))                      # (K, 17)
+    mags, negs = [], []
+    for j in range(_NDIG_A - 1, -1, -1):                  # MSB first
+        p = 5 * j
+        li, sh = p >> 4, p & 15
+        hi = xb[..., li + 1] if li + 1 < xb.shape[-1] else 0
+        word = xb[..., li] | (hi << 16)
+        d = ((word >> sh) & jnp.uint32(31)).astype(jnp.int32) - 16
+        negs.append(d < 0)
+        mags.append(jnp.abs(d))
+    return jnp.stack(mags, axis=0), jnp.stack(negs, axis=0)
+
+
+def rlc_verify_hash_kernel(a_words, r_words, base_limbs, z_limbs,
+                           group_ids, blocks_hi, blocks_lo, n_blocks,
+                           r_mag, r_neg):
+    """Whole-batch RLC verify with DEVICE-side hash-to-scalar.
+
+    a_words: (8, K) distinct-pubkey encodings (slot 0 = -B, pads = B);
+    r_words: (8, N) R encodings.
+    base_limbs: (K, 16) host scalar per A slot (slot 0 = c = sum z*s
+                mod L, others zero); z_limbs: (N, 8) 128-bit z_i;
+    group_ids: (N,) int32 A-slot index per signature (fillers -> 0,
+               where z = 0 keeps them inert);
+    blocks_hi/lo: (N, B, 16) padded SHA-512 blocks of R||A||M;
+    n_blocks: (N,); r_mag/r_neg: (26, N) z_i window digits, MSB-first.
+    Returns one bool verdict.
+    """
+    h = _h_scalars(blocks_hi, blocks_lo, n_blocks)        # (N, 16)
+    zh = _zh_mod_l(z_limbs, h)                            # (N, 16)
+    seg = _segment_sum_mod_l(zh, group_ids, a_words.shape[-1])
+    a_mag, a_neg = _recode_w5_device(_add_mod_l(base_limbs, seg))
+    return rlc_verify_kernel(a_words, r_words, a_mag, a_neg, r_mag, r_neg)
+
+
+def verify_hash_kernel(a_words, r_words, s_limbs, blocks_hi, blocks_lo,
+                       n_blocks):
+    """Per-signature verify with device-side hashing: the reject
+    localization path of the fused mode, so digests stay on device even
+    when a batch fails and individual verdicts are needed."""
+    h = _h_scalars(blocks_hi, blocks_lo, n_blocks)        # (N, 16)
+    return verify_kernel(a_words, r_words, s_limbs,
+                         jnp.moveaxis(h, -1, 0))
+
+
+_rlc_hash_jitted = jax.jit(rlc_verify_hash_kernel)
+_hash_jitted = jax.jit(verify_hash_kernel)
+
+
+def rlc_verify_hash_device(a_words, r_words, base_limbs, z_limbs,
+                           group_ids, blocks_hi, blocks_lo, n_blocks,
+                           r_mag, r_neg):
+    return _rlc_hash_jitted(a_words, r_words, base_limbs, z_limbs,
+                            group_ids, blocks_hi, blocks_lo, n_blocks,
+                            r_mag, r_neg)
+
+
+def verify_batch_hash_device(a_words, r_words, s_limbs, blocks_hi,
+                             blocks_lo, n_blocks):
+    return _hash_jitted(a_words, r_words, s_limbs, blocks_hi, blocks_lo,
+                        n_blocks)

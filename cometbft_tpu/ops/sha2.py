@@ -249,26 +249,60 @@ def pad_sha512(msgs: list[bytes], max_blocks: int | None = None):
     return hi, lo, n
 
 
+def pad_sha512_matrix(mat: np.ndarray, lens: np.ndarray):
+    """Like pad_sha512, but over a caller-built (N, B*128) u8 matrix:
+    row i holds message bytes [0, lens[i]) with zeros beyond.  The
+    matrix is padded IN PLACE (0x80 + big-endian bit length) — the
+    zero-copy seam for packers that can assemble messages columnarly.
+    Returns (blocks_hi, blocks_lo (N,B,16) u32, n_blocks (N,))."""
+    blocks, n = _pad_matrix(mat, np.asarray(lens, dtype=np.int64), 128)
+    hi = blocks[..., 0::2]
+    lo = blocks[..., 1::2]
+    return hi, lo, n
+
+
 def _pad(msgs: list[bytes], block_bytes: int, max_blocks: int | None):
+    # vectorized: one C-level join + masked scatter instead of four
+    # numpy ops per message — the batch padding is a hot host stage on
+    # the device-hash verify path (6-7k messages per block).
     lenbytes = 16 if block_bytes == 128 else 8
-    n_blocks = np.array(
-        [(len(m) + 1 + lenbytes + block_bytes - 1) // block_bytes for m in msgs],
-        dtype=np.int32)
-    B = int(max_blocks or n_blocks.max(initial=1))
-    out = np.zeros((len(msgs), B * block_bytes), dtype=np.uint8)
-    for i, m in enumerate(msgs):
-        if n_blocks[i] > B:
+    n = len(msgs)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
+    fit = (lens + 1 + lenbytes + block_bytes - 1) // block_bytes
+    B = int(max_blocks or (fit.max() if n else 1))
+    out = np.zeros((n, B * block_bytes), dtype=np.uint8)
+    if n:
+        if int(fit.max()) > B:
             raise ValueError("message exceeds max_blocks")
-        out[i, :len(m)] = np.frombuffer(m, dtype=np.uint8)
-        out[i, len(m)] = 0x80
-        bitlen = (8 * len(m)).to_bytes(lenbytes, "big")
-        end = n_blocks[i] * block_bytes
-        out[i, end - lenbytes:end] = np.frombuffer(bitlen, dtype=np.uint8)
-    words = out.reshape(len(msgs), B, block_bytes // 4, 4)
-    w32 = (words[..., 0].astype(np.uint32) << 24) | \
-          (words[..., 1].astype(np.uint32) << 16) | \
-          (words[..., 2].astype(np.uint32) << 8) | \
-          words[..., 3].astype(np.uint32)
+        # boolean-mask assignment fills row-major, i.e. in exactly the
+        # concatenated-message order of `flat`
+        col = np.arange(B * block_bytes, dtype=np.int64)
+        flat = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+        out[col[None, :] < lens[:, None]] = flat
+    return _pad_matrix(out, lens, block_bytes)
+
+
+def _pad_matrix(out: np.ndarray, lens: np.ndarray, block_bytes: int):
+    lenbytes = 16 if block_bytes == 128 else 8
+    n = out.shape[0]
+    B = out.shape[1] // block_bytes
+    n_blocks = ((lens + 1 + lenbytes + block_bytes - 1)
+                // block_bytes).astype(np.int32)
+    if n:
+        if int(n_blocks.max()) > B:
+            raise ValueError("message exceeds max_blocks")
+        rows = np.arange(n)
+        out[rows, lens] = 0x80
+        end = n_blocks.astype(np.int64) * block_bytes
+        # big-endian bit length in the block tail; bytes above the low
+        # 8 stay zero for any message under 2^61 bits
+        bits = (lens * 8).astype(np.uint64)
+        for k in range(8):
+            out[rows, end - 1 - k] = \
+                ((bits >> np.uint64(8 * k)) & np.uint64(0xFF)).astype(np.uint8)
+    # big-endian u32 words via one byteswapping view+copy
+    w32 = out.view(">u4").reshape(n, B, block_bytes // 4) \
+        .astype(np.uint32)
     return w32, n_blocks
 
 

@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 
 from ..libs import protowire as pw
 from ..types.block import Block, BlockID, Commit, Header, PartSetHeader
-from ..types.part_set import Part, PartSet
+from ..types.part_set import Part, PartSet, SerializedBlockCache
 from .kv import KVStore, be64
 
 
@@ -98,6 +98,12 @@ class BlockStore:
         self._mtx = threading.RLock()
         self._base = 0
         self._height = 0
+        # encode-once serve-many (types/part_set.SerializedBlockCache):
+        # save_block deposits the wire bytes it already built; block /
+        # part loads serve from it without decode + re-encode.  metrics
+        # is a StoreMetrics (node wiring) or None.
+        self._block_cache = SerializedBlockCache()
+        self.metrics = None
         raw = db.get(_K_STATE)
         if raw is not None:
             r = pw.Reader(raw)
@@ -156,9 +162,11 @@ class BlockStore:
                              num_txs=len(block.data.txs))
             sets = [(_k_meta(height), meta.to_proto()),
                     (_k_hash(block.hash()), be64(height))]
+            part_protos = []
             for i in range(parts.header.total):
-                sets.append((_k_part(height, i),
-                             parts.get_part(i).to_proto()))
+                p = parts.get_part(i).to_proto()
+                part_protos.append(p)
+                sets.append((_k_part(height, i), p))
             # height's LastCommit == commit *for* height-1
             if block.last_commit is not None:
                 sets.append((_k_commit(height - 1),
@@ -173,6 +181,9 @@ class BlockStore:
                 self._base = height
             sets.append((_K_STATE, self._state_bytes()))
             self._db.write_batch(sets)
+            # the joined part chunks ARE the serialized block: deposit
+            # both forms so later serves skip decode + re-encode
+            self._block_cache.put(height, parts.assemble(), part_protos)
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self._db.set(_k_seen_commit(height), commit.to_proto())
@@ -193,18 +204,50 @@ class BlockStore:
             return None
         return self.load_block_meta(struct.unpack(">Q", raw)[0])
 
-    def load_block(self, height: int) -> Block | None:
-        """Reassemble from parts (store/store.go:222 LoadBlock)."""
+    def _cache_hit(self) -> None:
+        m = self.metrics
+        if m is not None:
+            m.block_cache_hits.inc()
+
+    def _cache_miss(self) -> None:
+        m = self.metrics
+        if m is not None:
+            m.block_cache_misses.inc()
+
+    def _cache_evicted(self, n: int = 1) -> None:
+        m = self.metrics
+        if m is not None and n:
+            m.block_cache_evictions.inc(n)
+
+    def load_block_bytes(self, height: int) -> bytes | None:
+        """Serialized block wire bytes for `height`: the encode-once
+        cached form when present, else joined from the stored parts
+        (and deposited for the next reader).  The blocksync serve path
+        ships these bytes directly — a cache hit costs no proto
+        decode, no re-encode, and no part split."""
+        cached = self._block_cache.get_block_bytes(height)
+        if cached is not None:
+            self._cache_hit()
+            return cached
+        self._cache_miss()
         meta = self.load_block_meta(height)
         if meta is None:
             return None
-        buf = []
+        buf, part_protos = [], []
         for i in range(meta.block_id.part_set_header.total):
             raw = self._db.get(_k_part(height, i))
             if raw is None:
                 return None
+            part_protos.append(raw)
             buf.append(Part.from_proto(raw).bytes_)
-        return Block.from_proto(b"".join(buf))
+        data = b"".join(buf)
+        self._block_cache.put(height, data, part_protos)
+        return data
+
+    def load_block(self, height: int) -> Block | None:
+        """Reassemble from parts (store/store.go:222 LoadBlock)."""
+        raw = self.load_block_bytes(height)
+        return Block.from_proto(raw) if raw is not None else None
 
     def load_block_by_hash(self, block_hash: bytes) -> Block | None:
         raw = self._db.get(_k_hash(block_hash))
@@ -213,6 +256,11 @@ class BlockStore:
         return self.load_block(struct.unpack(">Q", raw)[0])
 
     def load_block_part(self, height: int, index: int) -> Part | None:
+        cached = self._block_cache.get_part_proto(height, index)
+        if cached is not None:
+            self._cache_hit()
+            return Part.from_proto(cached)
+        self._cache_miss()
         raw = self._db.get(_k_part(height, index))
         return Part.from_proto(raw) if raw is not None else None
 
@@ -247,6 +295,8 @@ class BlockStore:
                     deletes.append(_k_part(h, i))
             self._height = h - 1
             self._db.write_batch([(_K_STATE, self._state_bytes())], deletes)
+            if self._block_cache.invalidate(h):
+                self._cache_evicted()
 
     def prune_blocks(self, retain_height: int) -> int:
         """Remove blocks below retain_height; keep the commit for
@@ -275,4 +325,6 @@ class BlockStore:
                 pruned += 1
             self._base = retain_height
             self._db.write_batch([(_K_STATE, self._state_bytes())], deletes)
+            self._cache_evicted(
+                self._block_cache.invalidate_below(retain_height))
             return pruned

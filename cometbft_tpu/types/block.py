@@ -233,28 +233,50 @@ class Commit:
     def size(self) -> int:
         return len(self.signatures)
 
+    def vote_sign_bytes_all(self, chain_id: str) -> list[bytes]:
+        """Canonical sign-bytes for EVERY precommit of this commit, in
+        signature order, built columnar: signatures split into the two
+        canonical-vote shapes (commit BlockID vs nil) and each group's
+        rows are assembled by one numpy splice of the per-signature
+        timestamps into the shared framing
+        (canonical.vote_sign_bytes_columnar).  Memoized — the verify
+        loop, re-verifies, and the deferred batch all read the same
+        list."""
+        key = (chain_id, self.height, self.round, self.block_id)
+        memo = getattr(self, "_sb_all", None)
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        from . import canonical
+        sigs = self.signatures
+        commit_idx = [i for i, s in enumerate(sigs)
+                      if s.block_id_flag == BLOCK_ID_FLAG_COMMIT]
+        nil_idx = [i for i, s in enumerate(sigs)
+                   if s.block_id_flag != BLOCK_ID_FLAG_COMMIT]
+        out: list[bytes] = [b""] * len(sigs)
+        if commit_idx:
+            rows = canonical.vote_sign_bytes_columnar(
+                chain_id, PRECOMMIT, self.height, self.round,
+                self.block_id,
+                [sigs[i].timestamp for i in commit_idx])
+            for i, sb in zip(commit_idx, rows):
+                out[i] = sb
+        if nil_idx:
+            rows = canonical.vote_sign_bytes_columnar(
+                chain_id, PRECOMMIT, self.height, self.round, BlockID(),
+                [sigs[i].timestamp for i in nil_idx])
+            for i, sb in zip(nil_idx, rows):
+                out[i] = sb
+        self._sb_all = (key, out)
+        return out
+
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Canonical sign-bytes for validator val_idx's precommit
-        (block.go:897, vote.go:150).  Uses per-commit templates — the
-        canonical vote differs between signatures ONLY in the
-        timestamp (and nil-vs-commit BlockID), so the 6667-sig verify
-        loop pays O(1) writer calls per signature."""
-        sig = self.signatures[val_idx]
-        tpl = getattr(self, "_sb_tpl", None)
-        if tpl is None or tpl[0] != (chain_id, self.height, self.round,
-                                     self.block_id):
-            from . import canonical
-            mk_commit = canonical.vote_sign_bytes_template(
-                chain_id, PRECOMMIT, self.height, self.round,
-                self.block_id)
-            mk_nil = canonical.vote_sign_bytes_template(
-                chain_id, PRECOMMIT, self.height, self.round, BlockID())
-            tpl = ((chain_id, self.height, self.round, self.block_id),
-                   mk_commit, mk_nil)
-            self._sb_tpl = tpl
-        if sig.block_id_flag == BLOCK_ID_FLAG_COMMIT:
-            return tpl[1](sig.timestamp)
-        return tpl[2](sig.timestamp)
+        (block.go:897, vote.go:150).  Indexes the memoized columnar
+        whole-commit list — the canonical vote differs between
+        signatures ONLY in the timestamp (and nil-vs-commit BlockID),
+        so the 6667-sig verify loop pays one bytes slice per
+        signature after a single vectorized splice."""
+        return self.vote_sign_bytes_all(chain_id)[val_idx]
 
     def hash(self) -> bytes:
         if self._hash is None:

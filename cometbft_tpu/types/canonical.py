@@ -73,6 +73,57 @@ def vote_sign_bytes_template(chain_id: str, msg_type: int, height: int,
     return make
 
 
+def vote_sign_bytes_columnar(chain_id: str, msg_type: int, height: int,
+                             round_: int, block_id: BlockID,
+                             timestamps) -> list[bytes]:
+    """Whole-commit sign-bytes in one numpy splice: all rows sharing a
+    template differ ONLY in the timestamp field, so rows with the same
+    timestamp wire length have identical framing (delimiter varint,
+    head, field-5 tag + length, tail) at identical offsets.  Group by
+    wire length, tile the constant framing once per group, and splice
+    the timestamp bytes in as one (g, ts_len) block — per signature the
+    python cost drops to one Timestamp.to_proto plus a bytes slice,
+    replacing the per-sig 5-way join of vote_sign_bytes_template.make.
+    Byte parity with vote_sign_bytes is pinned by tests/test_types.py.
+    Returns sign-bytes in input order."""
+    import numpy as np
+
+    head = (pw.Writer()
+            .int_field(1, msg_type)
+            .sfixed64_field(2, height)
+            .sfixed64_field(3, round_)
+            .optional_message_field(4, canonical_block_id(block_id))
+            .bytes())
+    tail = pw.Writer().string_field(6, chain_id).bytes()
+    uv = pw.encode_uvarint
+
+    ts_protos = [ts.to_proto() for ts in timestamps]
+    groups: dict[int, list[int]] = {}
+    for i, ts in enumerate(ts_protos):
+        groups.setdefault(len(ts), []).append(i)
+
+    out: list[bytes] = [b""] * len(ts_protos)
+    for tl, idxs in groups.items():
+        lenpfx = uv(tl)
+        payload_len = len(head) + 1 + len(lenpfx) + tl + len(tail)
+        prefix = uv(payload_len) + head + b"\x2a" + lenpfx
+        poff = len(prefix)
+        row_len = poff + tl + len(tail)
+        g = len(idxs)
+        mat = np.empty((g, row_len), dtype=np.uint8)
+        mat[:, :poff] = np.frombuffer(prefix, dtype=np.uint8)
+        if tl:
+            mat[:, poff:poff + tl] = np.frombuffer(
+                b"".join(ts_protos[i] for i in idxs),
+                dtype=np.uint8).reshape(g, tl)
+        if tail:
+            mat[:, poff + tl:] = np.frombuffer(tail, dtype=np.uint8)
+        rows = mat.tobytes()
+        for j, i in enumerate(idxs):
+            out[i] = rows[j * row_len:(j + 1) * row_len]
+    return out
+
+
 def proposal_sign_bytes(chain_id: str, height: int, round_: int,
                         pol_round: int, block_id: BlockID,
                         timestamp: Timestamp) -> bytes:

@@ -9,6 +9,9 @@ verified independently.
 
 from __future__ import annotations
 
+import os
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..crypto import merkle
@@ -126,3 +129,82 @@ class PartSet:
 
     def bit_array(self) -> list[bool]:
         return [p is not None for p in self.parts]
+
+
+class SerializedBlockCache:
+    """Encode-once, serve-many: a bounded LRU of height -> (block wire
+    bytes, per-part proto bytes).
+
+    save_block already holds both forms — the joined part chunks ARE
+    the serialized block, and each part proto was just built for the KV
+    batch — so caching them kills the partset residual on the serve
+    side: a blocksync BlockResponse ships the cached wire bytes without
+    decode + re-encode + re-split, and a consensus gossip part request
+    ships the cached part proto without a KV read.  Bounded (env
+    COMETBFT_TPU_BLOCK_CACHE, default 64 heights, 0 disables) and
+    thread safe; hit/miss/eviction counts are plain ints the owning
+    BlockStore mirrors into StoreMetrics."""
+
+    DEFAULT_CAPACITY = 64
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = int(os.environ.get(
+                "COMETBFT_TPU_BLOCK_CACHE", str(self.DEFAULT_CAPACITY)))
+        self.capacity = max(0, int(capacity))
+        self._mtx = threading.Lock()
+        # height -> (block_bytes, tuple[part proto bytes, ...])
+        self._entries: OrderedDict[int, tuple] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._entries)
+
+    def put(self, height: int, block_bytes: bytes, part_protos) -> None:
+        if self.capacity == 0:
+            return
+        with self._mtx:
+            self._entries[height] = (bytes(block_bytes),
+                                     tuple(part_protos))
+            self._entries.move_to_end(height)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def _lookup(self, height: int):
+        with self._mtx:
+            entry = self._entries.get(height)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(height)
+            self.hits += 1
+            return entry
+
+    def get_block_bytes(self, height: int) -> bytes | None:
+        entry = self._lookup(height)
+        return entry[0] if entry is not None else None
+
+    def get_part_proto(self, height: int, index: int) -> bytes | None:
+        entry = self._lookup(height)
+        if entry is None or not 0 <= index < len(entry[1]):
+            return None
+        return entry[1][index]
+
+    def invalidate(self, height: int) -> bool:
+        with self._mtx:
+            if self._entries.pop(height, None) is None:
+                return False
+            self.evictions += 1
+            return True
+
+    def invalidate_below(self, retain_height: int) -> int:
+        with self._mtx:
+            stale = [h for h in self._entries if h < retain_height]
+            for h in stale:
+                del self._entries[h]
+            self.evictions += len(stale)
+            return len(stale)

@@ -294,6 +294,9 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
     entries = []          # (commit_idx, validator, sign_bytes, signature)
     seen: dict[int, int] = {}
     tallied = 0
+    # one columnar splice for the whole commit (types/canonical.py);
+    # the loop body pays a list index per signature
+    sign_bytes_all = commit.vote_sign_bytes_all(chain_id)
 
     for idx, cs in enumerate(commit.signatures):
         if ignore(cs):
@@ -315,7 +318,7 @@ def _verify(chain_id, vals, commit, needed, ignore, count, count_all,
                 f"index {idx}")
         if not use_batch:
             cs.validate_basic()
-        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        sign_bytes = sign_bytes_all[idx]
         entries.append((idx, val, sign_bytes, cs.signature))
         if count(cs):
             tallied += val.voting_power

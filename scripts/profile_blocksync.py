@@ -31,9 +31,17 @@ packs).  The overlap rows carry an overlap-efficiency line
 parity sample against the serial path, so serial vs pipelined is an
 apples-to-apples A/B in the same JSONL.
 
+--hash-device adds the device-hash A/B on the same fixture: a
+host_splice row (structural parse + z draw + columnar R||A||M pad —
+the ENTIRE host side of the fused path, numpy-only so it runs
+anywhere) to set against the host_pack row, and a TPU-gated
+device_hash row timing the fused rlc_verify_hash_device dispatch to
+set against the device row.  Together they decompose the
+COMETBFT_TPU_DEVICE_HASH=1 window exactly as tracetl's split spans do.
+
 Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
        flock /tmp/tpu.lock python scripts/profile_blocksync.py \
-           [out.jsonl] [--overlap]
+           [out.jsonl] [--overlap] [--hash-device]
 """
 
 from __future__ import annotations
@@ -48,8 +56,10 @@ sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 from _capture_util import already_done, append_log, wedged  # noqa: E402
 
-_ARGS = [a for a in sys.argv[1:] if a != "--overlap"]
+_FLAGS = {"--overlap", "--hash-device"}
+_ARGS = [a for a in sys.argv[1:] if a not in _FLAGS]
 OVERLAP = "--overlap" in sys.argv[1:]
+HASH_DEVICE = "--hash-device" in sys.argv[1:]
 OUT = _ARGS[0] if _ARGS else "/tmp/blocksync_profile.jsonl"
 
 import os
@@ -390,6 +400,65 @@ def main():
             parse_parity=bool(parse_parity),
             verdict_parity=bool(verdict_parity),
             subwindows=len(groups), depth=depth)
+
+    # -- device-hash A/B (--hash-device): fused vs host-hash window ----
+    if HASH_DEVICE:
+        packed_hash = None
+        if "host_splice" not in done:
+            log(stage="host_splice", start=True)
+            t0 = time.time()
+            parsed_s = ed.parse_batch(pks, sigs_raw)
+            packed_hash = ed.pack_rlc_device_hash(
+                pks, msgs, sigs_raw, parsed=parsed_s)
+            dt = time.time() - t0
+            log(stage="host_splice",
+                ms_per_block=round(1000 * dt / WINDOW, 2),
+                window_s=round(dt, 3), n_sigs=len(pks),
+                blocks_bucket=int(packed_hash[5].shape[1]))
+        if "device_hash" not in done:
+            log(stage="device_hash", start=True)
+            try:
+                import jax
+                from cometbft_tpu.ops import ed25519 as dev
+
+                import threading
+                box = {}
+
+                def _probe_hash():
+                    try:
+                        box["d"] = jax.devices()[0]
+                    except Exception as e:  # pragma: no cover
+                        box["err"] = repr(e)
+
+                th = threading.Thread(target=_probe_hash, daemon=True)
+                th.start()
+                th.join(90)
+                d = box.get("d")
+                is_tpu = d is not None and (
+                    "tpu" in getattr(d, "device_kind", "").lower()
+                    or d.platform == "tpu")
+                if not is_tpu:
+                    log(stage="device_hash",
+                        skipped="no TPU in this process")
+                else:
+                    if packed_hash is None:
+                        packed_hash = ed.pack_rlc_device_hash(
+                            pks, msgs, sigs_raw)
+                    placed = [jax.device_put(np.asarray(x))
+                              for x in packed_hash]
+                    dispatch = lambda: dev.rlc_verify_hash_device(  # noqa
+                        *placed)
+                    assert bool(np.asarray(dispatch()))
+                    iters = 4
+                    t0 = time.time()
+                    outs = [dispatch() for _ in range(iters)]
+                    assert np.asarray(outs[-1])
+                    dt = (time.time() - t0) / iters
+                    log(stage="device_hash",
+                        ms_per_block=round(1000 * dt / WINDOW, 2),
+                        window_s=round(dt, 3), pipelined_iters=iters)
+            except Exception as e:
+                log(stage="device_hash", err=repr(e)[:500])
 
     log(stage="done", total_s=round(time.time() - t_start, 1))
 

@@ -1,0 +1,476 @@
+"""Device-side hash-to-scalar (ops/ed25519 fused kernels + the
+crypto/ed25519 device-hash packers + the dispatch staging branch).
+
+The fused path moves SHA-512, the per-pubkey zh aggregation and the
+A-side signed-window recode onto the device; every host/device
+boundary it introduces is pinned here against a host oracle:
+
+  - sha512 + mod-L reduction vs hashlib across the classic padding
+    boundaries (111/112/127/128 and friends);
+  - the device recode vs the vectorized host recode (itself pinned
+    against the sequential-carry reference in tests/test_recode.py);
+  - the byte-radix segment sum vs python ints;
+  - per-signature fused-kernel verdicts vs the serial oracle,
+    including reject localization and structural rejects;
+  - pack_rlc_device_hash structure (group slots, the c slot, h parity
+    on real signatures, the oversized-message ValueError);
+  - the pipeline's ed_hash staging mode, its host_splice/device_hash
+    span names, the observable host fallback, and byte-identical
+    "wrong signature" errors hot and cold vs the host-hash path;
+  - (slow tier) the real fused RLC dispatch chain and a same-seed
+    simnet A/B that refuses to pass unless app hashes are
+    bit-identical with the knob on and off.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as cb
+from cometbft_tpu.crypto import dispatch as vd
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import sigcache
+from cometbft_tpu.crypto.ed25519 import NDIG_256, PrivKey, PubKey
+from cometbft_tpu.ops import ed25519 as dev
+from cometbft_tpu.ops import limbs as lb
+from cometbft_tpu.ops import sha2
+from cometbft_tpu.ops.scalar25519 import L
+from tests.test_dispatch import make_items, serial_verdicts
+
+
+def _limbs_to_int(row) -> int:
+    """Little-endian 16-bit limb row -> python int."""
+    return sum(int(v) << (16 * j) for j, v in enumerate(np.asarray(row)))
+
+
+def _signed(n, seed=5, dup=None, sizes=None):
+    """n real (pk, msg, sig) lists; `dup` maps index -> index whose
+    key it reuses (distinct-pubkey slot coverage); `sizes` overrides
+    per-index message length."""
+    privs = [PrivKey.generate(bytes([seed & 0xFF, i]) + b"\x07" * 30)
+             for i in range(n)]
+    for i, j in (dup or {}).items():
+        privs[i] = privs[j]
+    pks, msgs, sigs = [], [], []
+    for i, p in enumerate(privs):
+        m = b"devhash-" + bytes([i])
+        if sizes and i in sizes:
+            m = bytes([i]) * sizes[i]
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    return pks, msgs, sigs
+
+
+class TestHashToScalar:
+    def test_sha512_mod_l_matches_hashlib(self):
+        """The device digest-to-scalar vs hashlib across every SHA-512
+        length/padding boundary the vote path can hit: 111/112 (the
+        one-vs-two block padding split), 127/128 (block edge), plus
+        the short and multi-block shapes around them."""
+        rng = random.Random(11)
+        sizes = [0, 1, 55, 56, 63, 64, 65, 111, 112, 127, 128, 129, 200]
+        msgs = [bytes(rng.randrange(256) for _ in range(sz))
+                for sz in sizes]
+        bh, bl, nb = sha2.pad_sha512(msgs, 3)
+        h = np.asarray(dev._h_scalars(bh, bl, nb))
+        for i, m in enumerate(msgs):
+            want = int.from_bytes(hashlib.sha512(m).digest(),
+                                  "little") % L
+            assert _limbs_to_int(h[i]) == want, f"len={len(m)}"
+
+    def test_recode_device_matches_host(self):
+        """The bias-trick device recode vs the host _recode_w5 (the
+        oracle chain: device == host vectorized == host sequential)."""
+        rng = random.Random(12)
+        vals = [0, 1, 16, 31, L - 1, L // 2] + \
+            [rng.randrange(L) for _ in range(26)]
+        scal = np.stack([lb.int_to_limbs(v, 16) for v in vals]) \
+            .astype(np.uint32)
+        dm, dn = dev._recode_w5_device(scal)
+        hm, hn = ed._recode_w5(vals, NDIG_256, len(vals))
+        np.testing.assert_array_equal(np.asarray(dm), hm)
+        np.testing.assert_array_equal(np.asarray(dn), hn)
+
+    def test_segment_sum_matches_python_ints(self):
+        rng = random.Random(13)
+        n, k = 24, 6
+        zh_vals = [rng.randrange(L) for _ in range(n)]
+        gids = np.array([rng.randrange(k) for _ in range(n)],
+                        dtype=np.int32)
+        zh = np.stack([lb.int_to_limbs(v, 16) for v in zh_vals]) \
+            .astype(np.uint32)
+        seg = np.asarray(dev._segment_sum_mod_l(zh, gids, k))
+        for slot in range(k):
+            want = sum(v for v, g in zip(zh_vals, gids)
+                       if g == slot) % L
+            assert _limbs_to_int(seg[slot]) == want, f"slot={slot}"
+
+    def test_add_mod_l(self):
+        rng = random.Random(14)
+        pairs = [(0, 0), (L - 1, L - 1), (L - 1, 1)] + \
+            [(rng.randrange(L), rng.randrange(L)) for _ in range(8)]
+        a = np.stack([lb.int_to_limbs(x, 16) for x, _ in pairs]) \
+            .astype(np.uint32)
+        b = np.stack([lb.int_to_limbs(y, 16) for _, y in pairs]) \
+            .astype(np.uint32)
+        out = np.asarray(dev._add_mod_l(a, b))
+        for i, (x, y) in enumerate(pairs):
+            assert _limbs_to_int(out[i]) == (x + y) % L
+
+
+class TestPerSigFusedKernel:
+    def test_verdict_parity_and_localization(self):
+        """The reject-localization arm: per-signature fused kernel
+        verdicts vs the serial oracle, with a corrupted signature AND
+        a structural reject (s >= L) in the batch — digests stay on
+        device even on the failure path."""
+        items = make_items(6, seed=21, bad=(2,))
+        items[4] = (items[4][0], items[4][1], b"\xff" * 64)
+        pks = [i[0] for i in items]
+        msgs = [i[1] for i in items]
+        sigs = [i[2] for i in items]
+        bucket = dev.bucket_size(len(items))
+        a, r, s, bh, bl, nb, valid = ed.pack_batch_device_hash(
+            pks, msgs, sigs, bucket)
+        verdict = np.asarray(
+            dev.verify_batch_hash_device(a, r, s, bh, bl, nb)) & valid
+        assert verdict[:len(items)].tolist() == serial_verdicts(items)
+        assert not verdict[len(items):].any()
+
+
+class TestPackRlcDeviceHash:
+    def test_structure_group_slots_and_h_parity(self):
+        # index 3 reuses key 0: both must land in ONE A slot
+        pks, msgs, sigs = _signed(5, seed=31, dup={3: 0})
+        parsed = ed.parse_batch(pks, sigs)
+        packed = ed.pack_rlc_device_hash(pks, msgs, sigs, parsed=parsed)
+        assert packed is not None and len(packed) == 10
+        (a_words, r_words, base_limbs, z_limbs, gids,
+         bh, bl, nb, r_mag, r_neg) = packed
+        nbatch = dev.pad_width(5)
+        kbatch = dev.pad_width(1 + 4)         # 4 distinct keys + -B slot
+        assert a_words.shape == (8, kbatch)
+        assert r_words.shape == (8, nbatch)
+        assert r_mag.shape == (26, nbatch) and r_neg.shape == (26, nbatch)
+        # group ids: slot 0 is reserved for -B; the duplicate key
+        # shares its first occurrence's slot
+        assert (gids[:5] >= 1).all()
+        assert gids[3] == gids[0]
+        assert len({int(g) for g in gids[:5]}) == 4
+        # h parity on the REAL R||A||M preimages
+        h = np.asarray(dev._h_scalars(bh, bl, nb))
+        for i in range(5):
+            pre = sigs[i][:32] + pks[i] + msgs[i]
+            want = int.from_bytes(hashlib.sha512(pre).digest(),
+                                  "little") % L
+            assert _limbs_to_int(h[i]) == want, f"sig {i}"
+        # the c slot: base_limbs[0] must carry sum z_i*s_i mod L with
+        # the z the packer actually drew; other slots are zero
+        c = 0
+        for i in range(5):
+            c = (c + _limbs_to_int(z_limbs[i]) * parsed[i][1]) % L
+        np.testing.assert_array_equal(base_limbs[0],
+                                      lb.int_to_limbs(c, 16))
+        assert not base_limbs[1:].any()
+        # fillers are inert: z = 0 and no hash blocks
+        assert not z_limbs[5:].any()
+        assert not nb[5:].any()
+
+    def test_placeholder_sigs_rebuild_from_parsed(self):
+        """The pre-parsed calling convention (crypto/batch and
+        crypto/mesh pass sigs=[b""]*n with parsed=): every
+        z-independent field of the pack must match the real-sigs pack
+        bit for bit."""
+        pks, msgs, sigs = _signed(5, seed=34, dup={2: 1})
+        parsed = ed.parse_batch(pks, sigs)
+        real = ed.pack_rlc_device_hash(pks, msgs, sigs, parsed=parsed)
+        placeholder = ed.pack_rlc_device_hash(
+            pks, msgs, [b""] * 5, parsed=parsed)
+        assert placeholder is not None
+        # (a_words, r_words, _, _, gids, bh, bl, nb, _, _): everything
+        # the z draw doesn't touch
+        for i in (0, 1, 4, 5, 6, 7):
+            np.testing.assert_array_equal(placeholder[i], real[i])
+
+    def test_oversized_message_raises_value_error(self):
+        pks, msgs, sigs = _signed(3, seed=32, sizes={1: 600})
+        with pytest.raises(ValueError):
+            ed.pack_rlc_device_hash(pks, msgs, sigs)
+
+    def test_structural_reject_returns_none(self):
+        pks, msgs, sigs = _signed(3, seed=33)
+        sigs[1] = b"\xff" * 64                 # s >= L
+        assert ed.pack_rlc_device_hash(pks, msgs, sigs) is None
+
+
+class TestPipelineDeviceHashStaging:
+    def test_ed_hash_mode_spans_and_verdict_parity(self, monkeypatch):
+        """With the knob on, staging takes the splice+pack-only branch
+        (win.mode == 'ed_hash', the 10-tuple pack, msgs retained for
+        localization) and the spans split into host_splice /
+        device_hash.  The stub seam replaces only the device call, so
+        a staging bug breaks verdict parity here."""
+        from cometbft_tpu.libs import trace as libtrace
+
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "1")
+        monkeypatch.delenv("COMETBFT_TPU_PROVIDER", raising=False)
+        items = make_items(8, seed=41, bad=(5,))
+        want = serial_verdicts(items)
+        seen = {}
+
+        def judge(win):
+            seen["mode"] = win.mode
+            seen["packed_len"] = len(win.packed)
+            seen["msgs"] = win.msgs
+            out = [p is not None and cb.safe_verify(PubKey(pk), m, s)
+                   for p, (pk, m, s) in zip(win.parsed, win.items)]
+            return all(out), out
+
+        sigcache.reset()
+        tr = libtrace.StageTracer()
+        prev = libtrace.tracer()
+        libtrace.set_tracer(tr)
+        try:
+            with vd.VerifyPipeline(depth=2, dispatch_fn=judge) as pipe:
+                ok, verdicts = pipe.submit(
+                    list(items), subsystem="blocksync",
+                    device_threshold=1).result(timeout=60)
+        finally:
+            libtrace.set_tracer(prev)
+        assert verdicts == want and not ok
+        assert seen["mode"] == "ed_hash"
+        assert seen["packed_len"] == 10
+        assert seen["msgs"] == [m for _, m, _ in items]
+        snap = tr.snapshot()
+        assert snap["blocksync.host_splice"]["count"] >= 1
+        assert snap["blocksync.device_hash"]["count"] >= 1
+
+    def test_tracetl_segments_map_into_existing_buckets(self):
+        """The split span names must keep tracetl's critical-path
+        decomposition summing: host_splice rolls up into host_pack,
+        device_hash into device."""
+        from cometbft_tpu.libs import tracetl
+
+        assert tracetl.STAGE_SEGMENTS["host_splice"] == "host_pack"
+        assert tracetl.STAGE_SEGMENTS["device_hash"] == "device"
+
+    def test_oversized_message_falls_back_observably(self, monkeypatch):
+        """A message past the static SHA-512 bucket re-stages the
+        window through host hashing (win.mode == 'ed', verdicts
+        unchanged) and the fallback is OBSERVABLE: flightrec event +
+        DeviceMetrics counter."""
+        from cometbft_tpu.libs import flightrec
+        from cometbft_tpu.libs import metrics as libmetrics
+        from cometbft_tpu.libs.metrics import DeviceMetrics, Registry
+
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "1")
+        monkeypatch.delenv("COMETBFT_TPU_PROVIDER", raising=False)
+        pks, msgs, sigs = _signed(4, seed=43, sizes={2: 600})
+        items = list(zip(pks, msgs, sigs))
+        want = serial_verdicts(items)
+        seen = {}
+
+        def judge(win):
+            seen["mode"] = win.mode
+            out = [cb.safe_verify(PubKey(pk), m, s)
+                   for pk, m, s in win.items]
+            return all(out), out
+
+        reg = Registry("cometbft_tpu")
+        dm = DeviceMetrics(reg)
+        libmetrics.set_device_metrics(dm)
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        sigcache.reset()
+        try:
+            with vd.VerifyPipeline(depth=2, dispatch_fn=judge) as pipe:
+                ok, verdicts = pipe.submit(
+                    list(items), device_threshold=1).result(timeout=60)
+        finally:
+            flightrec.set_recorder(None)
+            libmetrics.set_device_metrics(None)
+        assert ok and verdicts == want
+        assert seen["mode"] == "ed"            # host-hash staging ran
+        ev = next(e for e in rec.events()
+                  if e["kind"] == flightrec.EV_DEVICE_HASH_FALLBACK)
+        assert ev["batch"] == 4
+        assert "cometbft_tpu_device_device_hash_fallbacks 1" \
+            in reg.expose()
+
+    def test_structural_reject_falls_through_silently(self, monkeypatch):
+        """A structurally-bad signature is NOT a device-hash fallback:
+        the window quietly takes the host-hash staging (which
+        localizes it) with no fallback breadcrumb."""
+        from cometbft_tpu.libs import flightrec
+
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "1")
+        monkeypatch.delenv("COMETBFT_TPU_PROVIDER", raising=False)
+        items = make_items(4, seed=44)
+        items[1] = (items[1][0], items[1][1], b"\xff" * 64)
+        want = serial_verdicts(items)
+        seen = {}
+
+        def judge(win):
+            seen["mode"] = win.mode
+            out = [cb.safe_verify(PubKey(pk), m, s)
+                   for pk, m, s in win.items]
+            return all(out), out
+
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        sigcache.reset()
+        try:
+            with vd.VerifyPipeline(depth=2, dispatch_fn=judge) as pipe:
+                ok, verdicts = pipe.submit(
+                    list(items), device_threshold=1).result(timeout=60)
+        finally:
+            flightrec.set_recorder(None)
+        assert verdicts == want and not ok
+        assert seen["mode"] == "ed"
+        kinds = [e["kind"] for e in rec.events()]
+        assert flightrec.EV_DEVICE_HASH_FALLBACK not in kinds
+
+
+class TestErrorMessageParity:
+    def test_wrong_signature_error_byte_identical_hot_and_cold(
+            self, monkeypatch):
+        """The deferred-batch reject error must be byte-identical
+        across (a) the host-hash path, (b) the device-hash path cold,
+        and (c) the device-hash path hot (verdict served from the
+        process-wide signature cache) — reject localization included
+        via .failed_ctx."""
+        from cometbft_tpu.types import validation
+        from cometbft_tpu.types.validation import ErrInvalidSignature
+        from tests.test_dispatch import TestDeferredVerifyAsync
+
+        monkeypatch.setattr(validation.DeferredSigBatch,
+                            "DEVICE_THRESHOLD", 1)
+        modes = []
+
+        def judge(win):
+            modes.append(win.mode)
+            out = [cb.safe_verify(
+                pk if not isinstance(pk, bytes) else PubKey(pk), m, s)
+                for pk, m, s in win.items]
+            return all(out), out
+
+        def run_arm():
+            batch = TestDeferredVerifyAsync()._commits_fixture(
+                bad_height=6)
+            with vd.VerifyPipeline(depth=2, dispatch_fn=judge) as pipe:
+                verdict = batch.verify_async(pipe, subsystem="blocksync")
+                with pytest.raises(ErrInvalidSignature) as ei:
+                    verdict.wait(timeout=60)
+            return ei.value
+
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "0")
+        sigcache.reset()
+        e_host = run_arm()
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "1")
+        monkeypatch.delenv("COMETBFT_TPU_PROVIDER", raising=False)
+        sigcache.reset()
+        e_dev_cold = run_arm()
+        e_dev_hot = run_arm()                  # no reset: cache hot
+        assert str(e_host) == str(e_dev_cold) == str(e_dev_hot)
+        assert e_host.failed_ctx == e_dev_cold.failed_ctx \
+            == e_dev_hot.failed_ctx == 6
+        assert "wrong signature in" in str(e_host)
+        assert modes[0] == "ed" and modes[1] == "ed_hash"
+
+
+@pytest.mark.slow
+class TestFusedRlcEndToEnd:
+    """The real XLA dispatch chain — cold-compiles the fused RLC
+    program (minutes on the CPU tier), so slow tier only.  8 sigs from
+    4 distinct keys keeps the compile at the one smoke shape
+    (nbatch 8, kbatch 8, 3 blocks)."""
+
+    def _fixture(self, corrupt=None):
+        pks, msgs, sigs = _signed(8, seed=51,
+                                  dup={4: 0, 5: 1, 6: 2, 7: 3})
+        if corrupt is not None:
+            s = sigs[corrupt]
+            sigs[corrupt] = s[:6] + bytes([s[6] ^ 1]) + s[7:]
+        return pks, msgs, sigs
+
+    def test_fused_rlc_accepts_good_batch(self):
+        pks, msgs, sigs = self._fixture()
+        packed = ed.pack_rlc_device_hash(pks, msgs, sigs)
+        assert packed is not None
+        assert ed.rlc_verify_hash(packed) is True
+
+    def test_fused_rlc_rejects_and_localizes(self):
+        pks, msgs, sigs = self._fixture(corrupt=3)
+        packed = ed.pack_rlc_device_hash(pks, msgs, sigs)
+        assert ed.rlc_verify_hash(packed) is False
+        parsed = ed.parse_batch(pks, sigs)
+        ok, verdicts = cb._device_verify_hash(pks, msgs, parsed)
+        assert not ok
+        want = [cb.safe_verify(PubKey(pk), m, s)
+                for pk, m, s in zip(pks, msgs, sigs)]
+        assert verdicts == want
+        assert verdicts.count(False) == 1 and not verdicts[3]
+
+
+@pytest.mark.slow
+def test_simnet_ab_bit_identical_app_hash(monkeypatch):
+    """Same-seed simnet blocksync with the device-hash knob OFF then
+    ON: both arms must reach the target height AND produce
+    bit-identical app hashes — the test refuses to pass otherwise.
+    VERIFY_WINDOW=2 with 4 validators keeps every deferred window at
+    the one smoke compile shape."""
+    import time
+
+    from cometbft_tpu.blocksync import reactor as breactor
+    from cometbft_tpu.simnet import (
+        SimNetwork, SimNode, clone_chain, grow_chain, make_sim_genesis)
+    from cometbft_tpu.types import validation
+
+    blocks = 6
+    monkeypatch.setattr(breactor, "VERIFY_WINDOW", 2)
+    monkeypatch.setattr(validation.DeferredSigBatch,
+                        "DEVICE_THRESHOLD", 1)
+    monkeypatch.delenv("COMETBFT_TPU_PROVIDER", raising=False)
+
+    def run_arm(seed=77):
+        net = SimNetwork(seed=seed)
+        net.set_default_link(latency=0.001)
+        genesis, privs = make_sim_genesis(4, seed=seed)
+        src = SimNode("src", genesis, net, seed=seed)
+        grow_chain(src, privs, blocks + 1)
+        src2 = SimNode("src2", genesis, net, seed=seed)
+        clone_chain(src, src2)
+        syncer = SimNode("syncer", genesis, net, block_sync=True,
+                         seed=seed)
+        nodes = (src, src2, syncer)
+        for n in nodes:
+            n.start()
+        try:
+            syncer.dial(src)
+            syncer.dial(src2)
+            assert syncer.wait_for_height(blocks, timeout=600), \
+                f"stalled at {syncer.height()}"
+            # settle in-flight applies before reading the app hash
+            time.sleep(0.2)
+            want = src.block_store.load_block(
+                blocks + 1).header.app_hash
+            got = syncer.app_hash()
+            assert got == want, "arm diverged from the source chain"
+            return (syncer.height(), got.hex())
+        finally:
+            for n in nodes:
+                n.stop()
+
+    sigcache.set_enabled(False)
+    try:
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "0")
+        host_arm = run_arm()
+        monkeypatch.setenv("COMETBFT_TPU_DEVICE_HASH", "1")
+        device_arm = run_arm()
+    finally:
+        sigcache.set_enabled(True)
+    assert host_arm == device_arm
+    assert host_arm[0] == blocks

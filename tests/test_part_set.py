@@ -1,0 +1,198 @@
+"""Serialized-block cache (types/part_set.SerializedBlockCache + the
+BlockStore / blocksync serve paths): a block proto is encoded and
+part-split ONCE at save; every later serve — blocksync BlockResponse,
+consensus gossip part request — ships the cached wire bytes.
+
+Pinned here: encode-once semantics (hit/miss accounting), the LRU
+eviction bound, cached bytes byte-identical to a fresh serialization,
+cache coherence under delete/prune, the pre-split BlockResponse frame
+parity, and an end-to-end simnet pair where the server answers
+blocksync from its cache.
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.blocksync import messages as bm
+from cometbft_tpu.store import BlockStore, MemDB
+from cometbft_tpu.types.block import Block, Commit, Data, ExtendedCommit
+from cometbft_tpu.types.part_set import PartSet, SerializedBlockCache
+
+from helpers import ChainBuilder
+
+
+def _block_from_light(lb, last_commit) -> Block:
+    return Block(header=lb.signed_header.header,
+                 data=Data([b"tx-1", b"tx-2"]),
+                 last_commit=last_commit)
+
+
+def _filled_store(n=3, db=None):
+    bs = BlockStore(db if db is not None else MemDB())
+    chain = ChainBuilder()
+    chain.build(n)
+    last_commit = Commit()
+    blocks = []
+    for lb in chain.blocks:
+        block = _block_from_light(lb, last_commit)
+        bs.save_block(block, PartSet.from_data(block.to_proto()),
+                      lb.signed_header.commit)
+        last_commit = lb.signed_header.commit
+        blocks.append(block)
+    return bs, blocks
+
+
+class TestSerializedBlockCache:
+    def test_put_get_and_counters(self):
+        c = SerializedBlockCache(capacity=4)
+        c.put(1, b"block-one", [b"p0", b"p1"])
+        assert len(c) == 1
+        assert c.get_block_bytes(1) == b"block-one"
+        assert c.get_part_proto(1, 1) == b"p1"
+        assert c.get_block_bytes(2) is None
+        assert c.get_part_proto(1, 2) is None      # out of range
+        assert c.get_part_proto(1, -1) is None
+        # entry-level accounting: the height resolved 4 times (the two
+        # out-of-range part indexes still found the entry); only the
+        # absent height is a miss
+        assert (c.hits, c.misses) == (4, 1)
+
+    def test_lru_eviction_bound_and_recency(self):
+        c = SerializedBlockCache(capacity=3)
+        for h in (1, 2, 3):
+            c.put(h, bytes([h]), [])
+        assert c.get_block_bytes(1) == b"\x01"     # touch 1: now MRU
+        c.put(4, b"\x04", [])
+        # bound held; the LRU entry (2) went, the touched one stayed
+        assert len(c) == 3 and c.evictions == 1
+        assert c.get_block_bytes(2) is None
+        assert c.get_block_bytes(1) == b"\x01"
+        assert c.get_block_bytes(4) == b"\x04"
+
+    def test_invalidate_and_invalidate_below(self):
+        c = SerializedBlockCache(capacity=8)
+        for h in range(1, 6):
+            c.put(h, bytes([h]), [])
+        assert c.invalidate(5) is True
+        assert c.invalidate(5) is False            # idempotent
+        assert c.invalidate_below(4) == 3          # heights 1, 2, 3
+        assert len(c) == 1 and c.get_block_bytes(4) is not None
+        assert c.evictions == 4
+
+    def test_capacity_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_BLOCK_CACHE", "0")
+        c = SerializedBlockCache()
+        c.put(1, b"x", [])
+        assert len(c) == 0 and c.get_block_bytes(1) is None
+
+
+class TestBlockStoreCache:
+    def test_save_deposits_and_bytes_match_fresh_serialization(self):
+        bs, blocks = _filled_store(3)
+        assert bs._block_cache.misses == 0
+        for h, block in enumerate(blocks, start=1):
+            got = bs.load_block_bytes(h)
+            assert got == block.to_proto()         # byte-identical
+        # every serve above came from the save-time deposit
+        assert bs._block_cache.hits == 3
+        assert bs._block_cache.misses == 0
+
+    def test_cold_store_repopulates_then_serves_hot(self):
+        db = MemDB()
+        bs, blocks = _filled_store(3, db=db)
+        cold = BlockStore(db)                      # fresh cache
+        raw1 = cold.load_block_bytes(2)            # miss: joins KV parts
+        raw2 = cold.load_block_bytes(2)            # hit: cached deposit
+        assert raw1 == raw2 == blocks[1].to_proto()
+        assert cold._block_cache.misses == 1
+        assert cold._block_cache.hits == 1
+        assert cold.load_block(2).header.height == 2
+
+    def test_part_served_from_cache_matches_kv(self):
+        db = MemDB()
+        bs, _ = _filled_store(2, db=db)
+        warm = bs.load_block_part(2, 0)            # cache hit
+        cold_store = BlockStore(db)
+        cold = cold_store.load_block_part(2, 0)    # KV read
+        assert warm.to_proto() == cold.to_proto()
+        assert bs._block_cache.hits >= 1
+        assert cold_store._block_cache.misses >= 1
+
+    def test_delete_and_prune_invalidate(self):
+        bs, _ = _filled_store(5)
+        assert bs.prune_blocks(3) == 2
+        assert bs._block_cache.get_block_bytes(1) is None
+        assert bs._block_cache.get_block_bytes(2) is None
+        assert bs.load_block(4) is not None
+        bs.delete_latest_block()
+        assert bs._block_cache.get_block_bytes(5) is None
+        assert bs.load_block_bytes(5) is None
+        # evictions mirror both paths: 2 pruned + 1 deleted
+        assert bs._block_cache.evictions == 3
+
+    def test_metrics_mirror_counters(self):
+        from cometbft_tpu.libs.metrics import Registry, StoreMetrics
+
+        reg = Registry("cometbft_tpu")
+        bs, _ = _filled_store(2)
+        bs.metrics = StoreMetrics(reg)
+        bs.load_block_bytes(1)                     # hit
+        bs.load_block_bytes(99)                    # miss (no such block)
+        bs.delete_latest_block()                   # eviction
+        text = reg.expose()
+        assert "cometbft_tpu_store_block_cache_hits 1" in text
+        assert "cometbft_tpu_store_block_cache_misses 1" in text
+        assert "cometbft_tpu_store_block_cache_evictions 1" in text
+
+
+class TestBlockResponseFraming:
+    def test_wire_parity_with_object_encode(self):
+        bs, blocks = _filled_store(1)
+        block = blocks[0]
+        raw = bs.load_block_bytes(1)
+        assert bm.wrap_block_response_bytes(raw) \
+            == bm.wrap(bm.BlockResponse(block))
+        ext = ExtendedCommit(height=1, round=0,
+                             block_id=block.last_commit.block_id)
+        assert bm.wrap_block_response_bytes(raw, ext) \
+            == bm.wrap(bm.BlockResponse(block, ext))
+
+
+class TestBlocksyncServesFromCache:
+    def test_simnet_pair_serves_cached_bytes(self):
+        """End to end: a syncer pulls a real chain over simnet and the
+        serving side answers every BlockResponse from its serialized-
+        block cache (grow_chain deposited at save time), with the
+        synced app hash still correct."""
+        from cometbft_tpu.crypto import sigcache
+        from cometbft_tpu.simnet import (
+            SimNetwork, SimNode, grow_chain, make_sim_genesis)
+
+        blocks = 8
+        sigcache.set_enabled(False)
+        net = SimNetwork(seed=15)
+        net.set_default_link(latency=0.001)
+        genesis, privs = make_sim_genesis(4, seed=15)
+        src = SimNode("src", genesis, net, seed=15)
+        grow_chain(src, privs, blocks + 1)
+        syncer = SimNode("syncer", genesis, net, block_sync=True,
+                         seed=15)
+        nodes = (src, syncer)
+        try:
+            for n in nodes:
+                n.start()
+            syncer.dial(src)
+            assert syncer.wait_for_height(blocks, timeout=60), \
+                f"stalled at {syncer.height()}"
+            time.sleep(0.2)
+            assert syncer.app_hash() == src.block_store.load_block(
+                blocks + 1).header.app_hash
+            cache = src.block_store._block_cache
+            # every served height resolved from the save-time deposit
+            assert cache.hits >= blocks, (cache.hits, cache.misses)
+            assert cache.misses == 0
+        finally:
+            sigcache.set_enabled(True)
+            for n in nodes:
+                n.stop()
