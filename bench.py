@@ -1233,6 +1233,18 @@ def main() -> None:
             carried_keys.discard("verdict_cache_hit_rate")
             _sync_carried()
             persist()
+        # device-time accounting of the SAME e2e run (libs/devprof.py):
+        # occupancy is higher-is-better (chips busier = the pipeline is
+        # feeding them); host_bound_fraction and compile seconds are
+        # diagnostic (perf_gate SKIPs them — cache warmth flaps them)
+        for key in ("device_occupancy_fraction", "host_bound_fraction",
+                    "compile_seconds_total"):
+            val = _simbench.last_consensus.get(key)
+            if isinstance(val, (int, float)):
+                extra[key] = val
+                carried_keys.discard(key)
+        _sync_carried()
+        persist()
     # warm-cache re-verify: the pure-lookup cost a cache hit replaces
     # the device dispatch with (CPU-only, no kernel warmup needed)
     run_extra("commit_reverify_sigs_per_sec",

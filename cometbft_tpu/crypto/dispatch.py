@@ -310,23 +310,62 @@ class VerifyPipeline(BaseService):
             return sum(1 for w in self._windows if w.staged)
 
     def _gauge(self) -> None:
+        from ..libs import devprof
         from ..libs import metrics as libmetrics
 
         dm = libmetrics.device_metrics()
+        rec = devprof.recorder()
+        if dm is None and rec is None:
+            return
+        with self._cv:
+            n = len(self._windows)
+            s = sum(1 for w in self._windows if w.staged)
+            per_dev = None
+            if self.devices is not None:
+                per_dev = [0] * len(self.devices)
+                for w in self._windows:
+                    per_dev[w.device_index] += 1
         if dm is not None:
-            with self._cv:
-                n = len(self._windows)
-                s = sum(1 for w in self._windows if w.staged)
-                per_dev = None
-                if self.devices is not None:
-                    per_dev = [0] * len(self.devices)
-                    for w in self._windows:
-                        per_dev[w.device_index] += 1
             dm.pipeline_inflight.set(n)
             dm.pipeline_staged.set(s)
             if per_dev is not None:
                 for i, c in enumerate(per_dev):
                     dm.pipeline_device_inflight.labels(str(i)).set(c)
+        if rec is not None:
+            # Perfetto counter tracks: queue depth + per-device
+            # in-flight windows under the occupancy tracks
+            rec.counter("pipeline_queue_depth", n)
+            rec.counter("pipeline_staged_windows", s)
+            if per_dev is not None:
+                for i, c in enumerate(per_dev):
+                    rec.counter("inflight_windows/dev%d" % i, c)
+
+    def _idle_cause(self, device_index: int | None = None) -> str:
+        """Why a dispatch thread is about to wait — called under
+        self._cv when a devprof recorder is installed.  drain: the
+        pipeline (or this mesh device) is fault-draining; staging: a
+        window exists for this device but its host work has not
+        finished; no_work: the submit queue is empty (including
+        cache-starved — fully-cached windows resolve at submit and
+        never reach a device); backpressure: windows exist but none
+        are dispatchable here (slots held by other devices' windows,
+        or computed heads awaiting in-order publication)."""
+        from ..libs import devprof
+
+        if device_index is None:
+            if self._faulted:
+                return devprof.IDLE_DRAIN
+            mine = self._windows
+        else:
+            if device_index in self._dev_faulted:
+                return devprof.IDLE_DRAIN
+            mine = [w for w in self._windows
+                    if w.device_index == device_index]
+        if any(not w.staged for w in mine):
+            return devprof.IDLE_STAGING
+        if not self._windows:
+            return devprof.IDLE_NO_WORK
+        return devprof.IDLE_BACKPRESSURE
 
     # -- API ---------------------------------------------------------------
 
@@ -517,7 +556,17 @@ class VerifyPipeline(BaseService):
     # -- device (ordered dispatch) -------------------------------------
 
     def _device_loop(self) -> None:
+        from ..libs import devprof
+
+        dev = "0"
         while True:
+            # devprof accounting (libs/devprof.py): classify WHY this
+            # thread is about to wait (under the lock, where the queue
+            # state is coherent), then attribute the waited gap to that
+            # cause on wake — so busy + attributed idle partition the
+            # device's wall-clock exactly
+            rec = devprof.recorder()
+            cause = devprof.IDLE_NO_WORK
             with self._cv:
                 while True:
                     if self._windows and self._windows[0].staged:
@@ -525,10 +574,24 @@ class VerifyPipeline(BaseService):
                         break
                     if self._stopping and not self._windows:
                         return
+                    if rec is not None:
+                        cause = self._idle_cause()
                     # stopping with an unstaged head: the staging loop
                     # drains every submitted window before exiting
                     self._cv.wait(timeout=0.05)
+                    if rec is not None:
+                        rec.advance(dev, cause)
+            if rec is not None:
+                # close the residual gap (lock wakeup to dispatch
+                # start) under the last known cause
+                rec.advance(dev, cause)
             self._resolve_window(win)
+            if rec is not None:
+                path = win.handle.path
+                if path in ("device", "host"):
+                    rec.advance(dev, devprof.BUSY, path=path)
+                else:                     # drain (or a failed resolve)
+                    rec.advance(dev, devprof.IDLE_DRAIN)
             with self._cv:
                 if self._windows and self._windows[0] is win:
                     self._windows.pop(0)
@@ -668,10 +731,17 @@ class VerifyPipeline(BaseService):
         return None
 
     def _mesh_device_loop(self, idx: int) -> None:
+        from ..libs import devprof
         from ..libs import trace as libtrace
         from ..libs import tracetl
 
+        dev = str(idx)
         while True:
+            # same devprof gap-attribution discipline as _device_loop,
+            # per mesh device: classify the wait under the lock,
+            # attribute the gap on wake
+            rec = devprof.recorder()
+            cause = devprof.IDLE_NO_WORK
             with self._cv:
                 while True:
                     win = self._next_for_device(idx)
@@ -682,8 +752,14 @@ class VerifyPipeline(BaseService):
                             w.device_index == idx and w.result is None
                             for w in self._windows):
                         return
+                    if rec is not None:
+                        cause = self._idle_cause(device_index=idx)
                     self._cv.wait(timeout=0.05)
+                    if rec is not None:
+                        rec.advance(dev, cause)
                 faulted = idx in self._dev_faulted
+            if rec is not None:
+                rec.advance(dev, cause)
             t0 = time.monotonic()
             path = "host"
             dev_span = "device_hash" if win.mode == "ed_hash" \
@@ -705,6 +781,11 @@ class VerifyPipeline(BaseService):
             except BaseException as e:  # pragma: no cover - defensive
                 win.result = (None, e, "error")
                 path = "error"
+            if rec is not None:
+                if path in ("device", "host"):
+                    rec.advance(dev, devprof.BUSY, path=path)
+                else:
+                    rec.advance(dev, devprof.IDLE_DRAIN)
             self._record_flush(win, path, t0)
             self._publish_resolved(idx)
 

@@ -52,12 +52,18 @@ def split_spans(n: int, ndev: int) -> list[tuple[int, int]]:
     return spans
 
 
-def _count_dispatch(i: int) -> None:
+def _count_dispatch(i: int, n: int = 0) -> None:
+    from ..libs import devprof
     from ..libs import metrics as libmetrics
 
     dm = libmetrics.device_metrics()
     if dm is not None:
         dm.mesh_dispatches.labels(str(i)).inc()
+    # split-RLC chunks bypass the pipeline's per-device accounts; a
+    # counter-track sample keeps them visible on the devprof timeline
+    rec = devprof.recorder()
+    if rec is not None:
+        rec.counter("mesh_split_chunk_sigs/dev%d" % i, n)
 
 
 def split_rlc_verify(pubkeys: list[bytes], parsed, devices,
@@ -84,7 +90,7 @@ def split_rlc_verify(pubkeys: list[bytes], parsed, devices,
     for i, (packed, dev_) in enumerate(zip(packs, devices)):
         outs.append(ed.rlc_verify_async(packed, use_cache=use_cache,
                                         device=dev_))
-        _count_dispatch(i)
+        _count_dispatch(i, spans[i][1] - spans[i][0])
     return [bool(np.asarray(o)) for o in outs]
 
 
@@ -131,7 +137,7 @@ def split_rlc_verify_hash(pubkeys: list[bytes], msgs: list[bytes],
     outs = []
     for i, (packed, dev_) in enumerate(zip(packs, devices)):
         outs.append(ed.rlc_verify_hash_async(packed, device=dev_))
-        _count_dispatch(i)
+        _count_dispatch(i, spans[i][1] - spans[i][0])
     return [bool(np.asarray(o)) for o in outs]
 
 

@@ -226,6 +226,19 @@ class StreamingVerifier(BaseService):
 
     def _flush(self, batch) -> None:
         from . import sigcache
+        from ..libs import devprof as libdevprof
+
+        # devprof accounting (libs/devprof.py): below device_threshold
+        # the worker thread IS the verify engine — account it under
+        # device "0" like the pipeline's single-device loop does, so a
+        # live consensus run (4-val simnet bench) still reads an
+        # occupancy + idle-cause partition.  The gap since the last
+        # mark was spent collecting the flood batch (or, on the early
+        # returns below, the cache absorbed the whole flush) — either
+        # way the engine was starved of work, not slow: no_work.
+        dp = libdevprof.recorder()
+        if dp is not None:
+            dp.advance("0", libdevprof.IDLE_NO_WORK)
 
         # consumers cancel futures they already verified inline
         batch = [b for b in batch if not b[3].cancelled()]
@@ -295,6 +308,8 @@ class StreamingVerifier(BaseService):
                                 label="consensus")
                 if fut.set_running_or_notify_cancel():
                     fut.set_result(v)
+        if dp is not None:
+            dp.advance("0", libdevprof.BUSY, path=path)
         dm = libmetrics.device_metrics()
         if dm is not None:
             dm.flushes.labels(path).inc()

@@ -9,6 +9,8 @@ Endpoints (GET):
   /debug/pprof/profile?seconds=N - statistical CPU profile (cProfile)
   /debug/pprof/cmdline    - process command line
   /debug/pprof/flightrec  - consensus flight recorder dump
+  /debug/pprof/devprof    - device-time accounting dump (occupancy,
+                            idle causes, compile ledger)
 """
 
 from __future__ import annotations
@@ -21,7 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
 _ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec",
-              "tracetl")
+              "tracetl", "devprof")
 
 
 def _dump_threads() -> str:
@@ -135,6 +137,13 @@ class PprofServer:
                         self._text("no timeline installed", 404)
                     else:
                         self._text(tl.dump_text())
+                elif name == "devprof":
+                    from . import devprof as _dp
+                    rec = _dp.recorder()
+                    if rec is None:
+                        self._text("no devprof recorder installed", 404)
+                    else:
+                        self._text(rec.dump_text())
                 else:
                     self._text("unknown profile", 404)
 

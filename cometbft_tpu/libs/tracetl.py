@@ -308,21 +308,31 @@ def _flow_id(ctx) -> str:
     return "%s/%d/%d/%d" % tuple(ctx)
 
 
-def perfetto_trace(timelines) -> dict:
+def perfetto_trace(timelines, counters=None) -> dict:
     """Merge per-node timelines into one Chrome/Perfetto trace_event
     JSON object: pid per node, tid per subsystem, X/i slices, and
     s->f flow events for every cross-node context edge.
 
     `timelines` is a {name: Timeline} dict or an iterable of Timeline
-    (named by their .node)."""
+    (named by their .node).  `counters` is an optional iterable of
+    (t, track, value) samples (DevprofRecorder.counter_samples());
+    they render as "C" counter tracks under a dedicated "devprof"
+    process so occupancy/queue-depth trajectories sit on the same time
+    axis as the spans they explain."""
     if isinstance(timelines, dict):
         items = sorted(timelines.items())
     else:
         items = sorted((tl.node, tl) for tl in timelines)
 
     dumps = [(name, tl.dump()) for name, tl in items]
+    counters = list(counters) if counters is not None else []
     t0 = min((e["t"] for _, d in dumps for e in d["events"]),
-             default=0.0)
+             default=None)
+    if counters:
+        ct0 = min(t for t, _, _ in counters)
+        t0 = ct0 if t0 is None else min(t0, ct0)
+    if t0 is None:
+        t0 = 0.0
 
     def us(t: float) -> float:
         return round((t - t0) * 1e6, 3)
@@ -365,12 +375,21 @@ def perfetto_trace(timelines) -> dict:
                     if direction == PH_RECV:
                         flow["bp"] = "e"
                     events.append(flow)
+    if counters:
+        cpid = len(dumps) + 1
+        events.append({"ph": "M", "name": "process_name", "pid": cpid,
+                       "tid": 0, "args": {"name": "devprof"}})
+        for t, track, value in counters:
+            events.append({"ph": "C", "name": track, "pid": cpid,
+                           "tid": 0, "ts": us(t),
+                           "args": {"value": value}})
     return {
         "displayTimeUnit": "ms",
         "traceEvents": events,
         "metadata": {
             "nodes": [name for name, _ in dumps],
             "dropped": {name: d["dropped"] for name, d in dumps},
+            "counters": len(counters),
         },
     }
 
@@ -424,7 +443,16 @@ def critical_path(trace: dict) -> dict:
     commits: dict[int, float] = {}
     spans: list[tuple] = []
     for e in trace.get("traceEvents", []):
+        # only "i" instants and "X" slices feed the sweep; any other
+        # phase ("M" metadata, "s"/"f" flows, "C" counter tracks, or
+        # phases a future exporter invents) passes through untouched,
+        # as do malformed events missing ts/name
+        if not isinstance(e, dict):
+            continue
         ph = e.get("ph")
+        if not isinstance(e.get("ts"), (int, float)) \
+                or not isinstance(e.get("name"), str):
+            continue
         if ph == "i":
             h = (e.get("args") or {}).get("height")
             if not isinstance(h, int):

@@ -342,6 +342,13 @@ class Node(BaseService):
         self.consensus_reactor.timeline = self.timeline
         self.blocksync_reactor.timeline = self.timeline
 
+        # device-time accounting plane (libs/devprof.py): always-on like
+        # the flight recorder (an advance is a lock + float adds),
+        # dumpable via the devprof RPC route and /debug/pprof/devprof
+        from ..libs import devprof as libdevprof
+        self.devprof_recorder = libdevprof.DevprofRecorder()
+        self.consensus_state.devprof = self.devprof_recorder
+
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
         self.metrics_server = None
@@ -395,6 +402,14 @@ class Node(BaseService):
             libflightrec.set_recorder(self.flight_recorder)
             # ... and their timeline spans through tracetl's seam
             libtracetl.set_timeline(self.timeline)
+            # ... and their device busy/idle intervals through devprof's
+            # seam; the compile hook attributes every XLA compilation
+            # this process triggers to the cold-compile ledger
+            from ..libs.metrics import DevprofMetrics
+            from ..ops import compile_hook
+            libmetrics.set_devprof_metrics(DevprofMetrics(registry))
+            libdevprof.set_recorder(self.devprof_recorder)
+            compile_hook.install(self.devprof_recorder)
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -480,13 +495,18 @@ class Node(BaseService):
         if self.metrics_server is not None:
             # this node owns the process-wide device-metrics,
             # stage-tracer, and flight-recorder seams
+            from ..libs import devprof as libdevprof
             from ..libs import flightrec as libflightrec
             from ..libs import metrics as libmetrics
             from ..libs import trace as libtrace
+            from ..ops import compile_hook
             libmetrics.set_device_metrics(None)
             libmetrics.set_cache_metrics(None)
+            libmetrics.set_devprof_metrics(None)
             libtrace.set_tracer(None)
             libflightrec.set_recorder(None)
+            libdevprof.set_recorder(None)
+            compile_hook.uninstall()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if self.privileged_rpc_server is not None:

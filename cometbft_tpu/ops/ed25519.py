@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_hook
 from . import fe
 from . import limbs as lb
 from . import scalar25519 as sc
@@ -588,7 +589,9 @@ _rlc_jitted = jax.jit(rlc_verify_kernel)
 
 
 def rlc_verify_device(a_words, r_words, a_mag, a_neg, r_mag, r_neg):
-    return _rlc_jitted(a_words, r_words, a_mag, a_neg, r_mag, r_neg)
+    with compile_hook.dispatch_scope("ed25519_rlc", a_words.shape):
+        return _rlc_jitted(a_words, r_words, a_mag, a_neg, r_mag,
+                           r_neg)
 
 
 def rlc_verify_kernel_cached_a(a_tab, a_ok, r_words,
@@ -618,13 +621,17 @@ _rlc_cached_jitted = jax.jit(rlc_verify_kernel_cached_a)
 
 def build_a_tables_device(a_words):
     """One-time device build of an A-side table for the cache."""
-    return _a_tables_jitted(a_words)
+    with compile_hook.dispatch_scope("ed25519_a_tables",
+                                     a_words.shape):
+        return _a_tables_jitted(a_words)
 
 
 def rlc_verify_device_cached_a(a_tab, a_ok, r_words,
                                a_mag, a_neg, r_mag, r_neg):
-    return _rlc_cached_jitted(a_tab, a_ok, r_words,
-                              a_mag, a_neg, r_mag, r_neg)
+    with compile_hook.dispatch_scope("ed25519_rlc_cached",
+                                     r_words.shape):
+        return _rlc_cached_jitted(a_tab, a_ok, r_words,
+                                  a_mag, a_neg, r_mag, r_neg)
 
 
 # jitted entry with bucketed batch sizes to avoid re-compiles
@@ -641,7 +648,8 @@ def bucket_size(n: int) -> int:
 
 
 def verify_batch_device(a_words, r_words, s_limbs, h_limbs):
-    return _jitted(a_words, r_words, s_limbs, h_limbs)
+    with compile_hook.dispatch_scope("ed25519_persig", a_words.shape):
+        return _jitted(a_words, r_words, s_limbs, h_limbs)
 
 
 # ---------------------------------------------------------------------------
@@ -786,12 +794,16 @@ _hash_jitted = jax.jit(verify_hash_kernel)
 def rlc_verify_hash_device(a_words, r_words, base_limbs, z_limbs,
                            group_ids, blocks_hi, blocks_lo, n_blocks,
                            r_mag, r_neg):
-    return _rlc_hash_jitted(a_words, r_words, base_limbs, z_limbs,
-                            group_ids, blocks_hi, blocks_lo, n_blocks,
-                            r_mag, r_neg)
+    with compile_hook.dispatch_scope("ed25519_rlc_hash",
+                                     blocks_hi.shape):
+        return _rlc_hash_jitted(a_words, r_words, base_limbs, z_limbs,
+                                group_ids, blocks_hi, blocks_lo,
+                                n_blocks, r_mag, r_neg)
 
 
 def verify_batch_hash_device(a_words, r_words, s_limbs, blocks_hi,
                              blocks_lo, n_blocks):
-    return _hash_jitted(a_words, r_words, s_limbs, blocks_hi, blocks_lo,
-                        n_blocks)
+    with compile_hook.dispatch_scope("ed25519_persig_hash",
+                                     blocks_hi.shape):
+        return _hash_jitted(a_words, r_words, s_limbs, blocks_hi,
+                            blocks_lo, n_blocks)

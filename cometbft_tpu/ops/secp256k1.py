@@ -29,6 +29,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_hook
+
 from . import fe_secp as fs
 
 # secp256k1 group order
@@ -225,4 +227,6 @@ _jitted = jax.jit(verify_kernel)
 
 def verify_batch_device(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs,
                         rn_valid):
-    return _jitted(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs, rn_valid)
+    with compile_hook.dispatch_scope("secp256k1_persig", qx.shape):
+        return _jitted(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs,
+                       rn_valid)

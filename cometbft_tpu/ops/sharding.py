@@ -25,6 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import compile_hook
 from . import ed25519 as dev
 
 
@@ -99,4 +100,6 @@ def verify_batch_sharded(a_words, r_words, s_limbs, h_limbs):
     n = device_count()
     if n < 2 or a_words.shape[-1] % n != 0:
         return dev.verify_batch_device(a_words, r_words, s_limbs, h_limbs)
-    return _sharded_verify()(a_words, r_words, s_limbs, h_limbs)
+    with compile_hook.dispatch_scope("ed25519_persig_sharded",
+                                     a_words.shape):
+        return _sharded_verify()(a_words, r_words, s_limbs, h_limbs)

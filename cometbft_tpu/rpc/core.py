@@ -312,6 +312,18 @@ class Environment:
                 out["events"] = out["events"][-n:] if n else []
         return out
 
+    def devprof_handler(self) -> dict:
+        """Dump the device-time accounting plane (libs/devprof.py):
+        per-device busy/idle partition with idle-cause attribution,
+        occupancy fractions, and the XLA cold-compile ledger."""
+        rec = getattr(self.consensus_state, "devprof", None)
+        if rec is None:
+            from ..libs import devprof as _dp
+            rec = _dp.recorder()
+        if rec is None:
+            raise RPCError(-32603, "devprof recorder unavailable")
+        return rec.dump()
+
     # -- abci --------------------------------------------------------------
     def abci_info(self) -> dict:
         res = self.app_conns.query.info(at.InfoRequest())
@@ -669,6 +681,7 @@ ROUTES = {
     "dump_consensus_state": "dump_consensus_state_handler",
     "flightrec": "flightrec_handler",
     "tracetl": "tracetl_handler",
+    "devprof": "devprof_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

@@ -28,7 +28,9 @@ import os
 import time
 
 from ..crypto import sigcache
+from ..libs import devprof as libdevprof
 from ..libs import trace as libtrace
+from ..ops import compile_hook
 from .node import SimNode, clone_chain, grow_chain, make_sim_genesis
 from .transport import SimNetwork
 
@@ -88,6 +90,12 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
     tr = libtrace.StageTracer(
         metrics=prev_tracer.metrics if prev_tracer else None)
     libtrace.set_tracer(tr)
+    # a fresh device-time account for exactly this run's traffic
+    prev_devprof = libdevprof.recorder()
+    prev_ledger = compile_hook.ledger()
+    devprof_rec = libdevprof.DevprofRecorder()
+    libdevprof.set_recorder(devprof_rec)
+    compile_hook.install(devprof_rec)
     target = src.sync_target()
     try:
         src.start()
@@ -98,6 +106,11 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
         dt = time.perf_counter() - t0
     finally:
         libtrace.set_tracer(prev_tracer)
+        libdevprof.set_recorder(prev_devprof)
+        if prev_ledger is not None:
+            compile_hook.install(prev_ledger)
+        else:
+            compile_hook.uninstall()
         syncer.stop()
         src.stop()
     if not ok:
@@ -130,6 +143,18 @@ def bench_blocksync_e2e(n_blocks: int | None = None,
         "overlap_efficiency": round(stage_sum / dt, 4) if dt else 0.0,
         "device_overlap_seconds": device_overlap_s,
         "stages": stages,
+    }
+    devprof_snap = devprof_rec.snapshot()
+    occ = libdevprof.occupancy_summary(devprof_snap)
+    last_blocksync["device_occupancy_fraction"] = \
+        occ["device_occupancy_fraction"]
+    last_blocksync["host_bound_fraction"] = occ["host_bound_fraction"]
+    last_blocksync["compile_seconds_total"] = \
+        devprof_snap["compile"]["seconds_total"]
+    last_blocksync["devprof"] = {
+        "idle_cause_seconds": occ["idle_cause_seconds"],
+        "devices": devprof_snap["devices"],
+        "compile": devprof_snap["compile"],
     }
     return last_blocksync
 
@@ -193,6 +218,14 @@ def bench_consensus_e2e(n_blocks: int | None = None,
     sigcache.set_enabled(cache)
     sigcache.reset()
 
+    # a fresh device-time account for exactly this run's traffic,
+    # installed BEFORE the TraceSession so the session reuses it (its
+    # counter samples land in the exported trace)
+    prev_devprof = libdevprof.recorder()
+    prev_ledger = compile_hook.ledger()
+    devprof_rec = libdevprof.DevprofRecorder()
+    libdevprof.set_recorder(devprof_rec)
+    compile_hook.install(devprof_rec)
     session = None
     if attach_timeline:
         from .tracing import TraceSession
@@ -226,6 +259,11 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         if session is not None:
             trace = session.export()
             session.uninstall()
+        libdevprof.set_recorder(prev_devprof)
+        if prev_ledger is not None:
+            compile_hook.install(prev_ledger)
+        else:
+            compile_hook.uninstall()
     if not all(n.height() >= n_blocks for n in nodes):
         raise RuntimeError(
             "consensus e2e stalled at "
@@ -258,6 +296,18 @@ def bench_consensus_e2e(n_blocks: int | None = None,
         "app_hashes": [
             n.block_store.load_block_meta(n_blocks).header.app_hash.hex()
             for n in nodes],
+    }
+    devprof_snap = devprof_rec.snapshot()
+    occ = libdevprof.occupancy_summary(devprof_snap)
+    last_consensus["device_occupancy_fraction"] = \
+        occ["device_occupancy_fraction"]
+    last_consensus["host_bound_fraction"] = occ["host_bound_fraction"]
+    last_consensus["compile_seconds_total"] = \
+        devprof_snap["compile"]["seconds_total"]
+    last_consensus["devprof"] = {
+        "idle_cause_seconds": occ["idle_cause_seconds"],
+        "devices": devprof_snap["devices"],
+        "compile": devprof_snap["compile"],
     }
     if trace is not None:
         from ..libs import tracetl

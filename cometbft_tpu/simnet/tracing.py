@@ -28,6 +28,7 @@ Usage::
 
 from __future__ import annotations
 
+from ..libs import devprof
 from ..libs import tracetl
 
 # node-owned objects that honor a per-object `timeline` override
@@ -45,6 +46,9 @@ class TraceSession:
         self._nodes: list = []
         self._saved: list[tuple] = []       # (obj, prev timeline attr)
         self._prev_seam: tracetl.Timeline | None = None
+        self.devprof_recorder: devprof.DevprofRecorder | None = None
+        self._prev_devprof = None
+        self._owns_devprof = False
         self._installed = False
         self._flightrec_seq: dict[str, int] = {}
 
@@ -67,6 +71,16 @@ class TraceSession:
             node="crypto", capacity=self.capacity)
         self._prev_seam = tracetl.timeline()
         tracetl.set_timeline(self.crypto_timeline)
+        # device-time accounting rides along: reuse an already-installed
+        # recorder (a node's, a bench's) or install a session-owned one
+        # so export() always has occupancy counter tracks to merge
+        self._prev_devprof = devprof.recorder()
+        if self._prev_devprof is None:
+            self.devprof_recorder = devprof.DevprofRecorder()
+            devprof.set_recorder(self.devprof_recorder)
+            self._owns_devprof = True
+        else:
+            self.devprof_recorder = self._prev_devprof
         self._installed = True
         return self
 
@@ -81,6 +95,10 @@ class TraceSession:
                 node.timeline = None
         tracetl.set_timeline(self._prev_seam)
         self._prev_seam = None
+        if self._owns_devprof:
+            devprof.set_recorder(self._prev_devprof)
+            self._owns_devprof = False
+        self._prev_devprof = None
         self._installed = False
 
     def __enter__(self) -> "TraceSession":
@@ -116,7 +134,9 @@ class TraceSession:
         if self.crypto_timeline is not None \
                 and len(self.crypto_timeline):
             merged["crypto"] = self.crypto_timeline
-        return tracetl.perfetto_trace(merged)
+        rec = self.devprof_recorder
+        counters = rec.counter_samples() if rec is not None else None
+        return tracetl.perfetto_trace(merged, counters=counters)
 
     def critical_path(self, include_flightrec: bool = True) -> dict:
         """Convenience: export + proposal->commit decomposition."""

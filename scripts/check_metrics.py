@@ -10,7 +10,15 @@ Checks:
   3. every bundle field (self.X = reg.counter/gauge/histogram(...)) is
      OBSERVED somewhere — referenced as `.X` in cometbft_tpu/ or
      tests/ outside its own registration line.  A registered metric
-     nothing ever drives is a dashboard lie.
+     nothing ever drives is a dashboard lie;
+  4. literal label names are snake_case (chID grandfathered: the
+     reference's own p2p label);
+  5. a cumulative-seconds counter must end `_seconds_total`, not bare
+     `_seconds` (the Prometheus counter suffix convention the devprof
+     busy/idle series follow);
+  6. DevprofMetrics per-device time series (busy/idle/occupancy) must
+     carry a `device` label — an unlabeled aggregate cannot show one
+     starved chip in a busy mesh.
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -27,12 +35,17 @@ REPO = Path(__file__).resolve().parent.parent
 METRICS_PY = REPO / "cometbft_tpu" / "libs" / "metrics.py"
 SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
 REG_METHODS = ("counter", "gauge", "histogram")
+# the reference's own p2p metrics label a camelCase chID; renaming it
+# would break dashboard parity with upstream cometbft
+LABEL_GRANDFATHERED = {"chID"}
 
 
-def registered_metrics(path: Path = METRICS_PY) -> list[dict]:
+def registered_metrics(path: Path | None = None) -> list[dict]:
     """[{cls, attr, kind, subsystem, name, lineno}] for every
-    `self.<attr> = reg.<kind>("<subsystem>", "<name>", ...)`."""
-    tree = ast.parse(path.read_text())
+    `self.<attr> = reg.<kind>("<subsystem>", "<name>", ...)`.
+    Defaults to METRICS_PY, resolved at call time so tests can point
+    the module at a synthetic bundle."""
+    tree = ast.parse((path or METRICS_PY).read_text())
     out = []
     for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
         for node in ast.walk(cls):
@@ -54,9 +67,18 @@ def registered_metrics(path: Path = METRICS_PY) -> list[dict]:
                     isinstance(a, ast.Constant) and isinstance(a.value, str)
                     for a in args[:2]):
                 continue
+            labels = None
+            for kw in call.keywords:
+                if kw.arg == "labels" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    elts = kw.value.elts
+                    if all(isinstance(e, ast.Constant)
+                           and isinstance(e.value, str) for e in elts):
+                        labels = [e.value for e in elts]
             out.append({"cls": cls.name, "attr": target.attr,
                         "kind": fn.attr, "subsystem": args[0].value,
-                        "name": args[1].value, "lineno": node.lineno})
+                        "name": args[1].value, "labels": labels,
+                        "lineno": node.lineno})
     return out
 
 
@@ -101,6 +123,22 @@ def run_checks() -> list[str]:
                 findings.append(
                     f"{m['cls']}.{m['attr']}: {label} {part!r} is not "
                     "snake_case")
+        for lbl in (m["labels"] or ()):
+            if lbl not in LABEL_GRANDFATHERED and not SNAKE.match(lbl):
+                findings.append(
+                    f"{m['cls']}.{m['attr']}: label {lbl!r} is not "
+                    "snake_case")
+        if m["kind"] == "counter" and m["name"].endswith("_seconds"):
+            findings.append(
+                f"{m['cls']}.{m['attr']} ({full}): cumulative-seconds "
+                "counter should end '_seconds_total', not '_seconds'")
+        if (m["cls"] == "DevprofMetrics"
+                and m["name"].split("_")[0] in ("busy", "idle",
+                                                "occupancy")
+                and "device" not in (m["labels"] or ())):
+            findings.append(
+                f"{m['cls']}.{m['attr']} ({full}): per-device devprof "
+                "series must carry a 'device' label")
 
     for m in metrics:
         if _reference_count(m["attr"]) == 0:

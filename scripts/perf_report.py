@@ -112,11 +112,14 @@ def build_section() -> str:
     return "\n".join(lines)
 
 
-def critical_path_report(paths: list[str]) -> None:
+def critical_path_report(paths: list[str],
+                         occupancy: bool = False) -> None:
     """--critical-path mode: print the proposal->commit decomposition
     (scripts/trace_report.py summary shape, or a raw TraceSession
     export) next to the committed headline trajectory, so the device
-    share trend reads in one place."""
+    share trend reads in one place.  `occupancy` (--occupancy) adds the
+    devprof device_occupancy_fraction column (libs/devprof.py) beside
+    the cache hit rate."""
     import glob
     import re
 
@@ -129,22 +132,26 @@ def critical_path_report(paths: list[str]) -> None:
             extra = ((rec.get("parsed") or {}).get("extra") or {})
             share = extra.get("critical_path_device_share")
             hit_rate = extra.get("verdict_cache_hit_rate")
+            occ = extra.get("device_occupancy_fraction")
         except (json.JSONDecodeError, OSError):
             continue
         n = re.search(r"r(\d+)", os.path.basename(p))
         if v is not None:
-            heads.append((n.group(1) if n else "?", v, share, hit_rate))
+            heads.append((n.group(1) if n else "?", v, share, hit_rate,
+                          occ))
     if heads:
         # device share and verdict-cache hit rate print side by side:
         # a rising hit rate SHOULD pull the device share down (cached
         # verdicts skip the dispatch), so the pair reads as one story
         print("headline trajectory (BENCH_r*.json):")
-        for rnd, v, share, hit_rate in heads:
+        for rnd, v, share, hit_rate, occ in heads:
             share_s = f"  device_share={share:.1%}" \
                 if isinstance(share, (int, float)) else ""
             hit_s = f"  cache_hit_rate={hit_rate:.1%}" \
                 if isinstance(hit_rate, (int, float)) else ""
-            print(f"  r{rnd}: {fmt(v)} sigs/s{share_s}{hit_s}")
+            occ_s = f"  occupancy={occ:.1%}" \
+                if occupancy and isinstance(occ, (int, float)) else ""
+            print(f"  r{rnd}: {fmt(v)} sigs/s{share_s}{hit_s}{occ_s}")
         print()
     for path in paths:
         with open(path) as f:
@@ -164,8 +171,10 @@ def critical_path_report(paths: list[str]) -> None:
 
 def main() -> None:
     if "--critical-path" in sys.argv[1:]:
-        args = [a for a in sys.argv[1:] if a != "--critical-path"]
-        critical_path_report(args)
+        occupancy = "--occupancy" in sys.argv[1:]
+        args = [a for a in sys.argv[1:]
+                if a not in ("--critical-path", "--occupancy")]
+        critical_path_report(args, occupancy=occupancy)
         return
     with open(PERF) as f:
         text = f.read()

@@ -333,6 +333,12 @@ def main():
         tr = libtrace.StageTracer()
         prev_tracer = libtrace.tracer()
         libtrace.set_tracer(tr)
+        # device-time accounting over the pipelined arm: how busy the
+        # (stubbed) device lane was and WHY it was idle when it was
+        from cometbft_tpu.libs import devprof as libdevprof
+        prev_devprof = libdevprof.recorder()
+        devprof_rec = libdevprof.DevprofRecorder()
+        libdevprof.set_recorder(devprof_rec)
         pipe = vdispatch.VerifyPipeline(
             depth=depth,
             dispatch_fn=lambda w: (True, [True] * len(w.items)),
@@ -352,12 +358,27 @@ def main():
         finally:
             pipe.stop()
             libtrace.set_tracer(prev_tracer)
+            libdevprof.set_recorder(prev_devprof)
         snap = tr.snapshot()
         stage_sum = sum(v["seconds"] for v in snap.values())
         log(stage="overlap_pipelined",
             ms_per_block=round(1000 * dt_pipe / WINDOW, 2),
             window_s=round(dt_pipe, 3), depth=depth,
             workers=pipe.host_workers)
+        dp_snap = devprof_rec.snapshot()
+        occ = libdevprof.occupancy_summary(dp_snap)
+        log(stage="devprof",
+            device_occupancy_fraction=occ["device_occupancy_fraction"],
+            host_bound_fraction=occ["host_bound_fraction"],
+            idle_cause_seconds=occ["idle_cause_seconds"],
+            compile_seconds_total=dp_snap["compile"]["seconds_total"])
+        for dev_name, acct in sorted(dp_snap["devices"].items()):
+            log(stage="devprof_device", device=dev_name,
+                occupancy=acct["occupancy"],
+                busy_seconds=acct["busy_seconds"],
+                idle_seconds=acct["idle_seconds"],
+                wall_seconds=acct["wall_seconds"],
+                dispatches=acct["dispatches"])
 
         # parity: parallel parse+hash must be byte-identical to the
         # serial function on the full entry set ...

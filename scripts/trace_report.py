@@ -29,7 +29,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from cometbft_tpu.libs import tracetl  # noqa: E402
 
 
-def report(trace: dict) -> dict:
+def report(trace) -> dict:
+    """critical_path over a trace in either Chrome container shape:
+    the object form ({"traceEvents": [...]}) TraceSession exports or
+    the bare JSON-array form other tools emit.  Unknown event phases
+    ("C" devprof counter tracks, "M" metadata, "s"/"f" flows, anything
+    newer) are passed over by the decomposition, not errors."""
+    if isinstance(trace, list):
+        trace = {"traceEvents": trace}
     return tracetl.critical_path(trace)
 
 

@@ -282,6 +282,47 @@ class TestCheckMetrics:
         assert full.count("c_dup") == 2
         assert not mod.SNAKE.match("CamelCase")
 
+    def test_devprof_bundle_is_linted(self):
+        """The DevprofMetrics bundle (libs/metrics.py): per-device
+        series carry the device label, cumulative-seconds counters end
+        _seconds_total, and the parser captures literal labels= — the
+        rules scripts/check_metrics.py enforces for the device-time
+        accounting plane."""
+        mod = self._load()
+        metrics = {(m["subsystem"], m["name"]): m
+                   for m in mod.registered_metrics()}
+        busy = metrics[("devprof", "busy_seconds_total")]
+        assert busy["kind"] == "counter"
+        assert busy["labels"] == ["device"]
+        idle = metrics[("devprof", "idle_seconds_total")]
+        assert idle["labels"] == ["device", "cause"]
+        occ = metrics[("devprof", "occupancy_ratio")]
+        assert occ["kind"] == "gauge" and occ["labels"] == ["device"]
+        assert metrics[("devprof",
+                        "compile_seconds_total")]["labels"] is None
+        assert metrics[("devprof",
+                        "compile_count")]["labels"] == ["kind"]
+        assert mod.run_checks() == []
+
+    def test_lint_flags_devprof_rule_violations(self, tmp_path,
+                                                monkeypatch):
+        mod = self._load()
+        bad = tmp_path / "m.py"
+        bad.write_text(
+            "class DevprofMetrics:\n"
+            "    def __init__(self, reg):\n"
+            "        self.a = reg.counter('devprof', 'busy_seconds',\n"
+            "                             'H.')\n"
+            "        self.b = reg.gauge('devprof', 'occupancy_ratio',\n"
+            "                           'H.', labels=('BadLabel',))\n")
+        monkeypatch.setattr(mod, "METRICS_PY", bad)
+        findings = mod.run_checks()
+        # bare _seconds counter, missing device label (on both), and
+        # a non-snake_case label all surface as findings
+        assert any("_seconds_total" in f for f in findings)
+        assert any("'device' label" in f for f in findings)
+        assert any("BadLabel" in f for f in findings)
+
 
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
@@ -392,6 +433,43 @@ class TestPerfGate:
         assert all("critical_path_device_share" not in m
                    for _, m in traj)
         assert all(m["verdict_cache_hit_rate"] == 0.8 for _, m in traj)
+        assert mod.main(["--root", str(tmp_path), "--check-only"]) == 0
+
+    def test_devprof_extras_gate_direction(self, tmp_path):
+        """The devprof extras: device_occupancy_fraction gates
+        higher-is-better (chips going idle means the feed path
+        regressed); compile_seconds_total and host_bound_fraction are
+        diagnostics — SKIPped at load time, never gated (compile
+        seconds flap with persistent-cache warmth)."""
+        mod = self._load()
+        assert "device_occupancy_fraction" not in mod.LOWER_IS_BETTER
+        assert "device_occupancy_fraction" not in mod.SKIP
+        assert "compile_seconds_total" in mod.SKIP
+        assert "host_bound_fraction" in mod.SKIP
+        history = [{"headline": 100.0,
+                    "device_occupancy_fraction": 0.6}
+                   for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "device_occupancy_fraction": 0.2},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["device_occupancy_fraction"]["status"] == "regressed"
+        ok = mod.gate({"headline": 100.0,
+                       "device_occupancy_fraction": 0.58},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
+        # the skipped diagnostics never reach the gate
+        for i, (occ, comp) in enumerate(
+                ((0.6, 200.0), (0.62, 1.0), (0.61, 90.0)), start=1):
+            self._write(tmp_path, f"BENCH_r0{i}.json", 100.0,
+                        extra={"device_occupancy_fraction": occ,
+                               "compile_seconds_total": comp,
+                               "host_bound_fraction": 0.1 * i})
+        traj = mod.trajectory(str(tmp_path))
+        assert all("compile_seconds_total" not in m for _, m in traj)
+        assert all("host_bound_fraction" not in m for _, m in traj)
+        assert all("device_occupancy_fraction" in m for _, m in traj)
         assert mod.main(["--root", str(tmp_path), "--check-only"]) == 0
 
     def test_usage_errors_exit_2(self, tmp_path):

@@ -194,6 +194,42 @@ class TestPerfettoExport:
         tracetl.write_trace(str(path), trace)
         assert json.loads(path.read_text()) == trace
 
+    def test_counters_become_devprof_counter_tracks(self):
+        a, b = _mini_timelines()
+        counters = [(10.0, "occupancy_pct/dev0", 87.5),
+                    (10.1, "occupancy_pct/dev0", 42.0),
+                    (10.05, "pipeline_queue_depth", 3.0)]
+        trace = tracetl.perfetto_trace({"a": a, "b": b},
+                                       counters=counters)
+        assert trace["metadata"]["counters"] == 3
+        evs = trace["traceEvents"]
+        procs = {e["args"]["name"]: e["pid"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        # the counter tracks live under their own "devprof" process,
+        # numbered after every node pid
+        assert procs["devprof"] == max(procs.values())
+        cs = [e for e in evs if e["ph"] == "C"]
+        assert len(cs) == 3
+        assert all(e["pid"] == procs["devprof"] for e in cs)
+        by_name = {}
+        for e in cs:
+            by_name.setdefault(e["name"], []).append(e)
+        assert set(by_name) == {"occupancy_pct/dev0",
+                                "pipeline_queue_depth"}
+        assert [e["args"]["value"]
+                for e in by_name["occupancy_pct/dev0"]] == [87.5, 42.0]
+        # counter timestamps join the shared rebased axis
+        assert all(e["ts"] >= 0.0 for e in cs)
+        assert min(e["ts"] for e in evs if "ts" in e) == 0.0
+
+    def test_counters_alone_set_the_time_origin(self):
+        # a trace of only counter samples still rebases to its own
+        # earliest timestamp instead of crashing on an empty event min
+        trace = tracetl.perfetto_trace(
+            {}, counters=[(5.0, "c", 1.0), (6.0, "c", 2.0)])
+        cs = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert [e["ts"] for e in cs] == [0.0, pytest.approx(1e6)]
+
 
 class TestCriticalPathSweep:
     def _trace(self, spans, proposals, commits):
@@ -229,6 +265,27 @@ class TestCriticalPathSweep:
         assert segs["gossip"] == pytest.approx(0.4)   # residual
         assert sum(segs.values()) == pytest.approx(row["wall_seconds"])
         assert cp["summary"]["device_share"] == pytest.approx(0.2)
+
+    def test_sweep_tolerates_unknown_and_malformed_events(self):
+        trace = self._trace(
+            [("device", 0.2, 0.6)], proposals={1: 0.0},
+            commits={1: 1.0})
+        trace["traceEvents"] += [
+            {"ph": "C", "name": "occupancy_pct/dev0", "pid": 9,
+             "tid": 0, "ts": 0.5e6, "args": {"value": 50.0}},
+            {"ph": "M", "name": "process_name", "pid": 9,
+             "args": {"name": "devprof"}},
+            {"ph": "zz", "name": "future-phase", "ts": 0.1e6},
+            {"ph": "i", "name": None, "ts": 0.2e6},       # bogus name
+            {"ph": "i", "name": "commit", "ts": "late"},  # bogus ts
+            "not-even-a-dict",
+        ]
+        cp = tracetl.critical_path(trace)
+        row = cp["per_height"][0]
+        assert row["wall_seconds"] == pytest.approx(1.0)
+        assert row["segments"]["device"] == pytest.approx(0.4)
+        assert sum(row["segments"].values()) == pytest.approx(1.0)
+        assert cp["summary"]["device_share"] == pytest.approx(0.4)
 
     def test_window_is_earliest_proposal_to_latest_commit(self):
         # spans outside the window are clipped; heights without a
