@@ -296,14 +296,64 @@ def bench_secp(batch: int, iters: int) -> float:
     return batch / dt
 
 
+def bench_secp_msm(batch: int, iters: int) -> float:
+    """secp256k1 ECDSA verifies/sec through the unified MSM engine
+    (ops/msm.py shared-table multi-product) on the SAME fixture and
+    measurement discipline as bench_secp — both time only the device
+    dispatch (pack outside the loop), so the pair is the clean A/B of
+    the ladder -> MSM swap (~4224 vs ~1250 field-muls/signature)."""
+    import jax
+    from cometbft_tpu.crypto import secp256k1 as sk
+    from cometbft_tpu.ops import secp256k1 as dev
+
+    privs = [sk.PrivKey.generate(bytes([i & 0xFF, i >> 8] + [11] * 30))
+             for i in range(min(batch, 128))]
+    pks, msgs, sigs = [], [], []
+    for i in range(batch):
+        p = privs[i % len(privs)]
+        m = i.to_bytes(8, "little") * 8
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    pk = sk.pack_msm_batch(pks, msgs, sigs, batch)
+    qtab, q_corr = sk.q_table_cache().get(pk["key_id"], pk["keys_x"],
+                                          pk["keys_y"])
+    args = jax.device_put((qtab, q_corr, pk["gid"], pk["g_rows"],
+                           pk["g_neg"], pk["q_rows"], pk["q_neg"],
+                           pk["r_limbs"], pk["rn_limbs"],
+                           pk["rn_valid"], pk["s_pt"]))
+    assert np.asarray(dev.verify_batch_msm_device(*args)).all()
+    t0 = time.perf_counter()
+    outs = [dev.verify_batch_msm_device(*args) for _ in range(iters)]
+    np.asarray(outs[-1])
+    dt = (time.perf_counter() - t0) / iters
+    return batch / dt
+
+
+def bench_mixed_ladder(n_ed: int = 9000, n_secp: int = 1000) -> float:
+    """bench_mixed with the secp MSM engine forced off — the ladder
+    arm of the same-fixture mixed-commit A/B (the reading itself is
+    not gated; perf_gate SKIPs it as a comparison arm)."""
+    old = os.environ.get("COMETBFT_TPU_SECP_MSM")
+    os.environ["COMETBFT_TPU_SECP_MSM"] = "0"
+    try:
+        return bench_mixed(n_ed, n_secp)
+    finally:
+        if old is None:
+            os.environ.pop("COMETBFT_TPU_SECP_MSM", None)
+        else:
+            os.environ["COMETBFT_TPU_SECP_MSM"] = old
+
+
 def bench_mixed(n_ed: int = 9000, n_secp: int = 1000) -> float:
     """Mixed-keytype commit verify (VERDICT item 5): one 10k-power
     commit whose validator set mixes ed25519 and secp256k1 keys, routed
     through crypto/batch.MixedBatchVerifier — the per-type sub-batches
-    dispatch concurrently (ed25519 RLC + secp Straus kernels are
-    independent device programs).  The reference refuses mixed batches
-    outright (types/validation.go:18); this is the measured rate for
-    accepting them."""
+    dispatch concurrently (ed25519 RLC + secp MSM-engine kernels are
+    independent device programs; COMETBFT_TPU_SECP_MSM=0 reverts the
+    secp side to the Straus ladder, see bench_mixed_ladder).  The
+    reference refuses mixed batches outright (types/validation.go:18);
+    this is the measured rate for accepting them."""
     from cometbft_tpu.crypto import batch as cb
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.crypto import ed25519_ref as ref
@@ -900,6 +950,7 @@ def main() -> None:
         ("rlc_cached_a_sigs_per_sec", "rlc_cached_a_config"),
         ("light_client_headers_per_sec", "light_client_config"),
         ("secp256k1_sigs_per_sec", "secp256k1_config"),
+        ("secp256k1_msm_sigs_per_sec", "secp256k1_msm_config"),
         ("blocksync_blocks_per_sec", "blocksync_config"),
         ("blocksync_e2e_blocks_per_sec", "blocksync_e2e_config"),
         ("blocksync_pipelined_blocks_per_sec",
@@ -909,6 +960,8 @@ def main() -> None:
         ("chaos_recovery_seconds", "chaos_config"),
         ("chaos_faulted_blocks_per_sec", None),
         ("mixed_commit_sigs_per_sec", "mixed_commit_config"),
+        ("mixed_commit_sigs_per_sec_ladder",
+         "mixed_commit_ladder_config"),
         ("multichip_sharded_sigs_per_sec", "multichip_config"),
         ("multichip_scaling_efficiency", None),
         ("device_hash_sigs_per_sec", "device_hash_config"),
@@ -1130,6 +1183,14 @@ def main() -> None:
               "batch 4096, per-signature Straus kernel (A/B'd: "
               "6.6k/27.6k/27.4k sigs/s at 1024/4096/16383, "
               "ab_round5 secp_batch_ab)")
+    # unified MSM engine arm: SAME batch-4096 fixture and dispatch-only
+    # measurement as secp256k1_sigs_per_sec — the ladder->MSM A/B pair
+    run_extra("secp256k1_msm_sigs_per_sec",
+              lambda: round(bench_secp_msm(4096, 6), 1),
+              "secp256k1_msm_config",
+              "batch 4096, unified MSM engine (shared-table "
+              "multi-product, ops/msm.py): same fixture as "
+              "secp256k1_sigs_per_sec, ladder vs MSM A/B pair")
     run_extra("blocksync_blocks_per_sec",
               lambda: round(bench_blocksync(10_000, 12, 4), 2),
               "blocksync_config",
@@ -1291,7 +1352,18 @@ def main() -> None:
               "10k-power mixed commit: 9000 ed25519 + 1000 secp256k1"
               " through MixedBatchVerifier, per-type sub-batches"
               " dispatched concurrently (reference refuses mixed"
-              " batches outright)")
+              " batches outright); secp side on the unified MSM"
+              " engine")
+    # same-fixture A/B arm: secp MSM engine forced off.  A comparison
+    # reading, not a gated headline (perf_gate SKIPs it) — it exists so
+    # every capture records how much of the mixed-commit rate the
+    # engine is buying on that machine.
+    run_extra("mixed_commit_sigs_per_sec_ladder",
+              lambda: round(bench_mixed_ladder(9000, 1000), 1),
+              "mixed_commit_ladder_config",
+              "mixed_commit_sigs_per_sec fixture with"
+              " COMETBFT_TPU_SECP_MSM=0 (secp Straus ladder arm of"
+              " the A/B)")
     # mesh-sharded verify scaling (tentpole): runs on the CPU-forced
     # 8-virtual-device mesh in a subprocess — no TPU relay time; the
     # real-chip scaling arm rides the relay ledger (docs/PERF.md

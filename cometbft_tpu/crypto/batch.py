@@ -226,12 +226,18 @@ class CpuSecp256k1BatchVerifier(_CpuLoopVerifier):
 
 
 class TpuSecp256k1BatchVerifier(_SigCollector):
-    """ECDSA batch on the device: per-signature Straus double-scalar
-    multiplication with a verdict bitmap (ops/secp256k1.verify_kernel).
-    ECDSA admits no RLC whole-batch equation (each check compares an
-    x-coordinate), so the per-signature kernel IS the batch path —
-    still one dispatch for the whole batch.  The reference refuses to
-    batch secp256k1 at all (crypto/batch/batch.go:12)."""
+    """ECDSA batch on the device.  Default path: the unified MSM
+    engine (ops/msm.py + ops/secp256k1.msm_verify_kernel) — the whole
+    commit's checks become two shared-table multi-products (u1·G
+    against a baked G window table, u2·Q against QTableCache-resident
+    per-key tables), ~1250 field-muls/signature vs ~4224 for the
+    ladder.  ECDSA admits no RLC whole-batch equation (each check
+    compares an x-coordinate), so verdicts stay per-signature — which
+    also means rejects need no localization round.  Set
+    COMETBFT_TPU_SECP_MSM=0 to fall back to the per-signature Straus
+    ladder (ops/secp256k1.verify_kernel) — the bench A/B arm and the
+    operator escape hatch.  The reference refuses to batch secp256k1
+    at all (crypto/batch/batch.go:12)."""
 
     KEY_TYPE = "secp256k1"
 
@@ -245,10 +251,17 @@ class TpuSecp256k1BatchVerifier(_SigCollector):
         n = len(self._items)
         if n == 0:
             return False, []
+        pubkeys = [i[0] for i in self._items]
+        msgs = [i[1] for i in self._items]
+        sigs = [i[2] for i in self._items]
+        if sk.msm_enabled():
+            from . import mesh
+            out = mesh.maybe_split_secp_verify(pubkeys, msgs, sigs)
+            if out is None:
+                out = sk.verify_msm_batch(pubkeys, msgs, sigs)
+            return all(out) and bool(out), out
         bucket = ed_dev.bucket_size(n)      # same bucketing discipline
-        packed = sk.pack_batch([i[0] for i in self._items],
-                               [i[1] for i in self._items],
-                               [i[2] for i in self._items], bucket)
+        packed = sk.pack_batch(pubkeys, msgs, sigs, bucket)
         valid = packed[-1]
         verdict = np.asarray(dev.verify_batch_device(*packed[:-1]))
         verdict = verdict & valid

@@ -175,6 +175,49 @@ def verify_batch_mesh(pubkeys: list[bytes], parsed):
     return (verdict & valid)[:n].tolist()
 
 
+def split_secp_verify(pubkeys: list[bytes], msgs: list[bytes],
+                      sigs: list[bytes], devices):
+    """split_rlc_verify for the unified secp256k1 MSM path: chunk i
+    packs on the host (Joye-Tunstall recode + distinct-key table
+    lookup through the QTableCache, keyed per device so each chip
+    keeps its own resident copy) and dispatches its own MSM program;
+    all chips are in flight before any verdict is read back.  Returns
+    per-signature verdicts in submission order — the MSM verdicts are
+    already per-signature, so unlike the RLC split there is no
+    localization round to run on reject."""
+    from . import secp256k1 as sk
+
+    n = len(pubkeys)
+    spans = split_spans(n, len(devices))
+    outs = []
+    for i, ((a, b), dev_) in enumerate(zip(spans, devices)):
+        outs.append(sk.verify_msm_async(pubkeys[a:b], msgs[a:b],
+                                        sigs[a:b], device=dev_))
+        _count_dispatch(i, b - a)
+    verdicts: list[bool] = []
+    for verdict, valid, m in outs:
+        out = np.asarray(verdict) & valid
+        verdicts.extend(bool(v) for v in out[:m])
+    return verdicts
+
+
+def maybe_split_secp_verify(pubkeys: list[bytes], msgs: list[bytes],
+                            sigs: list[bytes],
+                            min_split: int | None = None):
+    """The TpuSecp256k1BatchVerifier hook: None when the mesh split
+    does not apply (mesh off, too few devices, window under
+    MIN_SPLIT); otherwise the per-signature verdict list."""
+    n = len(pubkeys)
+    if n < (min_split if min_split is not None else MIN_SPLIT):
+        return None
+    from ..ops import sharding
+
+    devices = sharding.mesh_device_list(None)
+    if devices is None:
+        return None
+    return split_secp_verify(pubkeys, msgs, sigs, devices)
+
+
 # -- CPU-mesh bench arm ------------------------------------------------------
 
 def _demo_sigs(n: int, n_keys: int = 16, n_unique: int = 64):

@@ -364,3 +364,254 @@ def pack_batch(pubkeys: list[bytes], msgs: list[bytes], sigs: list[bytes],
             np.ascontiguousarray(u1n.T), np.ascontiguousarray(u2n.T),
             np.ascontiguousarray(r_l.T), np.ascontiguousarray(rn_l.T),
             rn_ok, valid)
+
+
+# -- unified MSM batch path (ops/msm.py engine) ------------------------------
+
+def msm_enabled() -> bool:
+    """The engine on/off knob (A/B seam: bench arms, simnet parity
+    tests, and the operator escape hatch back to the ladder)."""
+    return os.environ.get("COMETBFT_TPU_SECP_MSM", "1") != "0"
+
+
+# distinct-key axis pad grid: bounds the number of compiled
+# (batch, nkeys) kernel shapes the same way ops/ed25519.pad_width
+# bounds MSM side widths
+_KEY_WIDTHS = (4, 8, 16, 32, 64, 96, 128, 192, 256)
+
+
+def _key_pad(k: int) -> int:
+    for w in _KEY_WIDTHS:
+        if k <= w:
+            return w
+    base = _KEY_WIDTHS[-1]
+    return ((k + base - 1) // base) * base
+
+
+def pack_msm_batch(pubkeys: list[bytes], msgs: list[bytes],
+                   sigs: list[bytes], batch_size: int) -> dict:
+    """Pack an ECDSA batch for ops/secp256k1.msm_verify_kernel.
+
+    Host work per signature: the same structural checks / u1, u2
+    derivation as pack_batch, then odd-normalization (u + n when u is
+    even — n*P = infinity, cofactor 1, so the value is unchanged and
+    u' < 2n < 2^257 stays inside the window span) and the vectorized
+    Joye-Tunstall odd recode (ops/msm.recode_jt) — NO per-signature
+    64-iteration digit loop, which made pack_batch itself a ~30k
+    sigs/s host ceiling.
+
+    Each pack draws a fresh blinding scalar t with ``secrets`` and
+    ships S = t*G; see the soundness note in ops/secp256k1.py.
+
+    Returns a dict: keys_x/keys_y (22, K) distinct-key affine coords
+    (K padded onto _KEY_WIDTHS, fillers = G), key_id bytes (cache key
+    for the per-key tables), gid (B,) int32 key slot per lane,
+    g_rows/g_neg (32, B) and q_rows/q_neg (52, B) odd-window digits,
+    r_limbs/rn_limbs (22, B), rn_valid/valid (B,), s_pt (3, 22).
+    """
+    import secrets
+
+    import numpy as np
+
+    from ..ops import fe_secp as fs
+    from ..ops import msm
+
+    n = len(pubkeys)
+    assert batch_size >= n
+    u1o = [1] * batch_size
+    u2o = [1] * batch_size
+    gid = np.zeros(batch_size, np.int32)
+    r_l = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    rn_l = np.zeros((batch_size, fs.NLIMBS), np.int32)
+    rn_ok = np.zeros(batch_size, bool)
+    valid = np.zeros(batch_size, bool)
+    key_slot: dict[bytes, int] = {}
+    key_xy: list[tuple[int, int]] = []
+    key_order: list[bytes] = []
+    decomp: dict[bytes, tuple[int, int] | None] = {}
+
+    for i in range(n):
+        parsed = parse_signature(sigs[i])
+        if parsed is None:
+            continue
+        r, s = parsed
+        pk = pubkeys[i]
+        if pk not in decomp:
+            decomp[pk] = _decompress(pk)
+        xy = decomp[pk]
+        if xy is None:
+            continue
+        e = int.from_bytes(sum_sha256(msgs[i]), "big")
+        w = _inv(s, N)
+        u1, u2 = e * w % N, r * w % N
+        slot = key_slot.get(pk)
+        if slot is None:
+            slot = key_slot[pk] = len(key_order)
+            key_order.append(pk)
+            key_xy.append(xy)
+        gid[i] = slot
+        u1o[i] = u1 if u1 & 1 else u1 + N
+        u2o[i] = u2 if u2 & 1 else u2 + N
+        r_l[i] = fs.int_to_limbs(r)
+        if r + N < P:
+            rn_l[i] = fs.int_to_limbs(r + N)
+            rn_ok[i] = True
+        valid[i] = True
+
+    nk = _key_pad(max(1, len(key_order)))
+    keys_x = np.zeros((nk, fs.NLIMBS), np.int32)
+    keys_y = np.zeros((nk, fs.NLIMBS), np.int32)
+    for k, (x, y) in enumerate(key_xy):
+        keys_x[k] = fs.int_to_limbs(x)
+        keys_y[k] = fs.int_to_limbs(y)
+    gx_l, gy_l = fs.int_to_limbs(GX), fs.int_to_limbs(GY)
+    for k in range(len(key_xy), nk):
+        keys_x[k], keys_y[k] = gx_l, gy_l
+
+    from ..ops.secp256k1 import MSM_NG, MSM_NQ, MSM_WG, MSM_WQ
+    g_rows, g_neg = msm.recode_jt(u1o, MSM_WG, MSM_NG)
+    q_rows, q_neg = msm.recode_jt(u2o, MSM_WQ, MSM_NQ)
+
+    t = secrets.randbelow(N - 1) + 1
+    sx, sy = _jaffine(_jmul(t, _G))
+    s_pt = np.stack([fs.int_to_limbs(sx), fs.int_to_limbs(sy),
+                     np.asarray(fs.ONE_LIMBS, np.int32)])
+
+    return {
+        "keys_x": np.ascontiguousarray(keys_x.T),
+        "keys_y": np.ascontiguousarray(keys_y.T),
+        "key_id": b"".join(key_order) + b"|%d" % nk,
+        "gid": gid,
+        "g_rows": g_rows, "g_neg": g_neg,
+        "q_rows": q_rows, "q_neg": q_neg,
+        "r_limbs": np.ascontiguousarray(r_l.T),
+        "rn_limbs": np.ascontiguousarray(rn_l.T),
+        "rn_valid": rn_ok, "valid": valid, "s_pt": s_pt,
+    }
+
+
+class QTableCache:
+    """Device cache of per-key secp256k1 MSM window tables.
+
+    The ATableCache access pattern (crypto/ed25519.py) in Weierstrass
+    flavor: a validator set's distinct pubkeys produce the same
+    (keys_x, keys_y) every commit, so the device-batched table build
+    (52 windows x 16 odd rows per key, ~215 KB/key of HBM) runs once
+    per key set and every later commit's MSM dispatch gathers from
+    resident tables.  Keyed by the packed key bytes + device;
+    LRU-bounded by a byte budget (COMETBFT_TPU_Q_CACHE_BYTES, default
+    128 MiB ~ 600 keys).  Thread-safe.
+    """
+
+    def __init__(self, max_bytes: int | None = None):
+        import collections
+        import threading
+
+        self._max_bytes = (max_bytes if max_bytes is not None else
+                           int(os.environ.get(
+                               "COMETBFT_TPU_Q_CACHE_BYTES",
+                               str(128 << 20))))
+        self._entries = collections.OrderedDict()  # key -> (entry, nbytes)
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def bytes_resident(self) -> int:
+        return self._bytes
+
+    def _gauge(self, dm) -> None:
+        if dm is not None:
+            dm.q_table_cache_bytes.set(self._bytes)
+
+    def get(self, key_id: bytes, keys_x, keys_y, device=None):
+        """(qtab, q_corr) device arrays for one packed key set,
+        building (and admitting) on miss.  `device` places the tables
+        on a specific mesh device and keys the entry by it — each chip
+        in a round-robin dispatch keeps its own resident copy."""
+        from ..libs import metrics as libmetrics
+        from ..ops import secp256k1 as dev_ops
+
+        dm = libmetrics.device_metrics()
+        key = (key_id, device)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if dm is not None:
+                    dm.q_table_cache_hits.inc()
+                return self._entries[key][0]
+        entry = dev_ops.build_q_msm_tables_device(keys_x, keys_y,
+                                                 device=device)
+        qtab, _ = entry
+        nbytes = int(qtab.size) * qtab.dtype.itemsize
+        with self._lock:
+            self.misses += 1
+            if dm is not None:
+                dm.q_table_cache_misses.inc()
+            if nbytes > self._max_bytes:
+                self._gauge(dm)
+                return entry
+            if key not in self._entries:
+                self._entries[key] = (entry, nbytes)
+                self._bytes += nbytes
+                while self._bytes > self._max_bytes and \
+                        len(self._entries) > 1:
+                    _, (_, freed) = self._entries.popitem(last=False)
+                    self._bytes -= freed
+                    self.evictions += 1
+            self._gauge(dm)
+            return self._entries[key][0]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+_Q_CACHE: QTableCache | None = None
+
+
+def q_table_cache() -> QTableCache:
+    global _Q_CACHE
+    if _Q_CACHE is None:
+        _Q_CACHE = QTableCache()
+    return _Q_CACHE
+
+
+def verify_msm_async(pubkeys: list[bytes], msgs: list[bytes],
+                     sigs: list[bytes], batch_size: int | None = None,
+                     device=None):
+    """Pack + table lookup + kernel dispatch WITHOUT the host sync:
+    returns (device verdict array, host valid mask, n).  The mesh
+    split (crypto/mesh.split_secp_verify) uses this to put every
+    chip's program in flight before reading any verdict back."""
+    from ..ops import ed25519 as ed_ops
+    from ..ops import secp256k1 as dev_ops
+
+    n = len(pubkeys)
+    if batch_size is None:
+        batch_size = ed_ops.bucket_size(n)      # same bucket discipline
+    pk = pack_msm_batch(pubkeys, msgs, sigs, batch_size)
+    qtab, q_corr = q_table_cache().get(
+        pk["key_id"], pk["keys_x"], pk["keys_y"], device=device)
+    verdict = dev_ops.verify_batch_msm_device(
+        qtab, q_corr, pk["gid"], pk["g_rows"], pk["g_neg"],
+        pk["q_rows"], pk["q_neg"], pk["r_limbs"], pk["rn_limbs"],
+        pk["rn_valid"], pk["s_pt"], device=device)
+    return verdict, pk["valid"], n
+
+
+def verify_msm_batch(pubkeys: list[bytes], msgs: list[bytes],
+                     sigs: list[bytes], device=None) -> list[bool]:
+    """Whole-batch ECDSA verdicts through the unified MSM engine:
+    per-signature booleans in submission order (the engine's verdicts
+    ARE per-signature, so rejects need no localization round)."""
+    import numpy as np
+
+    verdict, valid, n = verify_msm_async(pubkeys, msgs, sigs,
+                                         device=device)
+    out = np.asarray(verdict) & valid
+    return [bool(v) for v in out[:n]]

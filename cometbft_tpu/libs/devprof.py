@@ -65,6 +65,21 @@ STATES = (BUSY,) + IDLE_CAUSES
 COMPILE_FIRST = "first"
 COMPILE_RECOMPILE = "recompile"
 
+# Label registries — the closed vocabularies for kernel-time
+# attribution.  Every compile_hook.dispatch_scope kind and every
+# devprof busy-path / flush-path label used anywhere in the tree must
+# appear here; scripts/check_metrics.py lints the call sites against
+# these sets so new kernels cannot ship unlabeled (their device time
+# would silently pool under "other" on the occupancy dashboards).
+DISPATCH_KINDS = frozenset({
+    "ed25519_persig", "ed25519_persig_hash", "ed25519_persig_sharded",
+    "ed25519_rlc", "ed25519_rlc_cached", "ed25519_rlc_hash",
+    "ed25519_a_tables",
+    "secp256k1_persig", "secp256k1_msm", "secp256k1_q_tables",
+    "other",
+})
+BUSY_PATHS = frozenset({"device", "host", "cache", "drain", "error"})
+
 DEFAULT_SAMPLE_CAPACITY = 16384
 DEFAULT_LEDGER_CAPACITY = 512
 

@@ -454,8 +454,22 @@ def _msm_scan(tab, mags, negs):
     tab: (17, 4, 20, W); mags: (nwin, W) int32 digit magnitudes 0..16,
     MSB-first; negs: (nwin, W) bool signs.  5 doublings/window act on
     <= NPART_MAX lane-resident partials.  Returns a (4, 20, 1) point.
+
+    The bucket (Pippenger) arm swaps the per-window select cascade for
+    the generic engine's bucket accumulate+fold when the auto-tuned
+    crossover favors it (ops/msm.choose_engine; force with
+    COMETBFT_TPU_MSM_ENGINE=bucket).  tab[1] is -P (the table is built
+    on the negated point), which is exactly the base-point plane the
+    digits are aimed at — both arms consume the same tables and digit
+    streams, so the choice is invisible above this function.
     """
     w = tab.shape[-1]
+    from . import msm as msm_engine
+    if msm_engine.choose_engine(w, 5) == "bucket":
+        spec = msm_engine.ed25519_spec()
+        acc, _ = msm_engine.bucket_msm(spec, (tab[1], None),
+                                       mags, negs, 5)
+        return acc
     if USE_PALLAS_MSM_MAJOR and _pallas_capable():
         from . import pallas_msm
         blk = pallas_msm.blk_for(w)
@@ -738,21 +752,17 @@ def _add_mod_l(a, b):
 def _recode_w5_device(scalars):
     """(K, 16) limbs (< L) -> ((52, K), (52, K)) signed-window digit
     magnitudes and signs, MSB-first — bit-identical to the host
-    crypto/ed25519._recode_w5 (pinned by tests/test_device_hash.py)."""
+    crypto/ed25519._recode_w5 (pinned by tests/test_device_hash.py).
+    The bias addition stays here (it owns the scalar-limb carry
+    discipline); the digit extraction is the engine's generic
+    any-width form."""
+    from . import msm as msm_engine
+
     pad = jnp.zeros(scalars.shape[:-1] + (1,), dtype=jnp.uint32)
     xb, _ = lb.carry_prop(
         jnp.concatenate([scalars, pad], axis=-1) +
         jnp.asarray(_W5_BIAS_LIMBS))                      # (K, 17)
-    mags, negs = [], []
-    for j in range(_NDIG_A - 1, -1, -1):                  # MSB first
-        p = 5 * j
-        li, sh = p >> 4, p & 15
-        hi = xb[..., li + 1] if li + 1 < xb.shape[-1] else 0
-        word = xb[..., li] | (hi << 16)
-        d = ((word >> sh) & jnp.uint32(31)).astype(jnp.int32) - 16
-        negs.append(d < 0)
-        mags.append(jnp.abs(d))
-    return jnp.stack(mags, axis=0), jnp.stack(negs, axis=0)
+    return msm_engine.recode_biased_digits(xb, 5, _NDIG_A)
 
 
 def rlc_verify_hash_kernel(a_words, r_words, base_limbs, z_limbs,

@@ -87,6 +87,38 @@ def sharded_msm(tab, mags, negs, *, mesh, axis: str = "sig",
     return run(tab, mags, negs)
 
 
+def sharded_bucket_msm(tab, mags, negs, *, mesh, axis: str = "sig",
+                       width: int = 5):
+    """sharded_msm with the generic engine's bucket (Pippenger) arm as
+    the per-device core: each device bucket-accumulates and folds its
+    local lane shard (ops/msm.bucket_msm over tab[1] = -P, the same
+    base-point plane the digit streams are aimed at), then the tiny
+    per-device accumulator POINTS all_gather and tree-fold exactly like
+    the Straus form — bucket accumulation shards across the mesh for
+    free because buckets are per-device-local and the cross-device
+    combine stays group addition on out_l = 1 partials."""
+    from jax.experimental.shard_map import shard_map
+
+    from . import ed25519 as dev
+    from . import msm as engine
+
+    ndev = mesh.shape[axis]
+    assert tab.shape[-1] % ndev == 0, (tab.shape, ndev)
+    spec = engine.ed25519_spec()
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, None, None, axis), P(None, axis),
+                  P(None, axis)),
+        out_specs=P(), check_rep=False)
+    def run(tab_l, mags_l, negs_l):
+        part, _ = engine.bucket_msm(spec, (tab_l[1], None),
+                                    mags_l, negs_l, width)
+        return dev._tree_reduce(_gather_lanes(part, axis), 1)
+
+    return run(tab, mags, negs)
+
+
 def rlc_verify_sharded(a_words, r_words, a_mag, a_neg, r_mag, r_neg,
                        *, mesh, axis: str = "sig", interpret=False,
                        blk=None, group=None):

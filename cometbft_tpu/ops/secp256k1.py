@@ -230,3 +230,210 @@ def verify_batch_device(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs,
     with compile_hook.dispatch_scope("secp256k1_persig", qx.shape):
         return _jitted(qx, qy, u1_nibs, u2_nibs, r_limbs, rn_limbs,
                        rn_valid)
+
+
+# ---------------------------------------------------------------------------
+# unified batched MSM path (ops/msm.py engine)
+# ---------------------------------------------------------------------------
+#
+# The ladder above pays ~4224 field-muls per signature (64 windows x
+# (4 jdbl + 2 complete adds)) plus 256 exact-zero freezes and 128
+# 16-way select cascades — all doublings and branch machinery that a
+# shared-table product does not need.  This path verifies a whole
+# batch as N independent products R'_i = u1_i*G + u2_i*Q_{g(i)}
+# against PRECOMPUTED odd-multiple window tables:
+#
+#   u1*G : width-8 odd windows over a static affine G table
+#          (32 windows x 128 rows, ~740 KB, built once per process) —
+#          mixed Jacobian+affine adds, 7M+4S each;
+#   u2*Q : width-5 odd windows over per-distinct-key Jacobian tables
+#          (52 windows x 16 rows, ~215 KB/key) built device-batched
+#          over the key axis and cached across commits by
+#          crypto/secp256k1.QTableCache (the ATableCache discipline).
+#
+# Scalars arrive odd (u + n when even — n*P vanishes, cofactor 1) and
+# recoded with the all-odd Joye-Tunstall closed form
+# (ops/msm.recode_jt), so no digit ever selects the identity; the
+# accumulator starts at a host-random blinding point S (fresh per
+# pack, crypto/secp256k1.pack_msm_batch), so every in-loop add is the
+# incomplete jadd_fast/jadd_mixed — an H=0 collision needs the
+# adversary to hit +-S (~2^-247/dispatch, the RLC soundness class)
+# and degrades to the absorbing Z=0 point, which the epilogue
+# REJECTS: the failure mode is a negligible false reject, never a
+# false accept.  Total ~1250 field-muls/sig, zero in-loop doublings,
+# three freezes per BATCH (the epilogue's exact compares).
+
+MSM_WG, MSM_NG = 8, 32        # u1 side: 8-bit odd windows, 2^257 span
+MSM_WQ, MSM_NQ = 5, 52        # u2 side: 5-bit odd windows, 2^261 span
+
+
+def jadd_mixed(p, ax, ay):
+    """madd-2007-bl (Z2=1): Jacobian p + affine (ax, ay); incomplete
+    (callers rely on the blinded-accumulator argument above)."""
+    z1z1 = fs.sqr(p[_Z])
+    u2 = fs.mul(ax, z1z1)
+    s2 = fs.mul(fs.mul(ay, p[_Z]), z1z1)
+    h = fs.sub(u2, p[_X])
+    hh = fs.sqr(h)
+    i4 = fs.add(fs.add(hh, hh), fs.add(hh, hh))
+    j = fs.mul(h, i4)
+    rr = fs.sub(s2, p[_Y])
+    rr = fs.add(rr, rr)
+    v = fs.mul(p[_X], i4)
+    x3 = fs.sub(fs.sub(fs.sqr(rr), j), fs.add(v, v))
+    y1j = fs.mul(p[_Y], j)
+    y3 = fs.sub(fs.mul(rr, fs.sub(v, x3)), fs.add(y1j, y1j))
+    z3 = fs.sub(fs.sub(fs.sqr(fs.add(p[_Z], h)), z1z1), hh)
+    return _pt(x3, y3, z3)
+
+
+def _g_msm_table_np():
+    """Static affine odd-multiple G windows: (MSM_NG, 128, 2, 22)
+    int32 rows (2m+1)*2^(8j)*G plus the (2, 22) Joye-Tunstall
+    correction point 2^256*G.  Host bigint build (~4k affine
+    conversions), lazily computed once per process and embedded as a
+    kernel constant."""
+    from ..crypto import secp256k1 as host
+
+    rows = np.zeros((MSM_NG, 1 << (MSM_WG - 1), 2, fs.NLIMBS),
+                    np.int32)
+    for j in range(MSM_NG):
+        base = host._jmul(1 << (MSM_WG * j), host._G)
+        d2 = host._jdbl(base)
+        cur = base
+        for m in range(1 << (MSM_WG - 1)):
+            x, y = host._jaffine(cur)
+            rows[j, m, 0] = fs.int_to_limbs(x)
+            rows[j, m, 1] = fs.int_to_limbs(y)
+            cur = host._jadd(cur, d2)
+    corr = np.zeros((2, fs.NLIMBS), np.int32)
+    cx, cy = host._jaffine(host._jmul(1 << (MSM_WG * MSM_NG),
+                                      host._G))
+    corr[0] = fs.int_to_limbs(cx)
+    corr[1] = fs.int_to_limbs(cy)
+    return rows, corr
+
+
+_G_MSM_NP = None
+
+
+def _g_msm_table():
+    global _G_MSM_NP
+    if _G_MSM_NP is None:
+        _G_MSM_NP = _g_msm_table_np()
+    return _G_MSM_NP
+
+
+def q_msm_tables_kernel(qx, qy):
+    """(22, K) affine distinct pubkeys -> per-key odd-multiple window
+    tables ((MSM_NQ, 16, 3, 22, K) Jacobian) + the (3, 22, K)
+    correction points 2^260*Q_k, batched over the key axis.
+
+    The row chain is structurally collision-free for jadd_fast: rows
+    are m*2^(5j)*Q with odd m <= 31 and the chain adds 2*2^(5j)*Q
+    (odd + even multiples never coincide, and no small multiple of a
+    prime-order point vanishes — cofactor 1), so the exact-zero
+    branches of jadd_complete are provably unreachable here.
+    """
+    batch = qx.shape[1:]
+    base = _pt(qx, qy, _one_fe(batch))
+
+    def window(carry, _):
+        b = carry                              # 2^(5j) * Q
+        d2 = jdbl(b)
+
+        def chain(prev, __):
+            nxt = jadd_fast(prev, d2)
+            return nxt, nxt
+
+        _, odd = jax.lax.scan(chain, b, None, length=15)  # 3..31 odd
+        rows = jnp.concatenate([b[None], odd], axis=0)    # (16,3,22,K)
+        nxt = b
+        for _i in range(MSM_WQ):
+            nxt = jdbl(nxt)
+        return nxt, rows
+
+    corr, tabs = jax.lax.scan(window, base, None, length=MSM_NQ)
+    return tabs, corr
+
+
+def msm_verify_kernel(qtab, q_corr, gid, g_rows, g_neg, q_rows, q_neg,
+                      r_limbs, rn_limbs, rn_valid, s_pt):
+    """Batched ECDSA verify via the shared-table multi-product.
+
+    qtab: (MSM_NQ, 16, 3, 22, K) per-key window tables (see
+    q_msm_tables_kernel); q_corr: (3, 22, K); gid: (B,) int32 key slot
+    per signature; g_rows/g_neg: (MSM_NG, B) odd-row indices/signs of
+    the odd-normalized u1; q_rows/q_neg: (MSM_NQ, B) for u2;
+    r_limbs/rn_limbs/rn_valid as in verify_kernel; s_pt: (3, 22) the
+    pack's blinding point S = t*G.  Returns (B,) bool.
+    """
+    from . import msm as engine
+
+    batch = gid.shape
+    gtab_np, gcorr_np = _g_msm_table()
+    gtab = jnp.asarray(gtab_np)
+
+    acc = jnp.broadcast_to(s_pt[:, :, None],
+                           (3, fs.NLIMBS) + batch)
+
+    def g_gather(tab_j, rows_j):
+        return jnp.moveaxis(tab_j[rows_j], 0, -1)         # (2,22,B)
+
+    def g_add(a, ent, neg):
+        ay = jnp.where(neg[None], -ent[1], ent[1])
+        return jadd_mixed(a, ent[0], ay)
+
+    def q_gather(tab_j, rows_j):
+        return jnp.moveaxis(tab_j[rows_j, :, :, gid], 0, -1)
+
+    def q_add(a, ent, neg):
+        y = jnp.where(neg[None], -ent[1], ent[1])
+        return jadd_fast(a, _pt(ent[0], y, ent[2]))
+
+    acc = engine.multiprod_shared_tables(acc, [
+        (gtab, g_rows, g_neg, g_gather, g_add),
+        (qtab, q_rows, q_neg, q_gather, q_add)])
+
+    # Joye-Tunstall truncation corrections: + 2^256*G, + 2^260*Q_g(i)
+    gc = jnp.asarray(gcorr_np)
+    gcx = jnp.broadcast_to(gc[0][:, None], (fs.NLIMBS,) + batch)
+    gcy = jnp.broadcast_to(gc[1][:, None], (fs.NLIMBS,) + batch)
+    acc = jadd_mixed(acc, gcx, gcy)
+    acc = jadd_fast(acc, q_corr[:, :, gid])
+    # remove the blinding point: + (-S)
+    s_b = jnp.broadcast_to(s_pt[:, :, None], (3, fs.NLIMBS) + batch)
+    acc = jadd_fast(acc, _pt(s_b[_X], -s_b[_Y], s_b[_Z]))
+
+    # inversion-free epilogue: x(R') == r (mod n) as cross-multiplied
+    # field compares.  Z == 0 (infinity / absorbed collision) must be
+    # rejected explicitly — X == r*Z^2 degenerates to 0 == 0 there.
+    z2 = fs.sqr(acc[_Z])
+    not_inf = ~fs.is_zero(acc[_Z])
+    ok_r = fs.eq(acc[_X], fs.mul(r_limbs, z2))
+    ok_rn = fs.eq(acc[_X], fs.mul(rn_limbs, z2)) & rn_valid
+    return not_inf & (ok_r | ok_rn)
+
+
+_q_tabs_jitted = jax.jit(q_msm_tables_kernel)
+_msm_jitted = jax.jit(msm_verify_kernel)
+
+
+def build_q_msm_tables_device(qx, qy, device=None):
+    """One device build of the per-key window tables (cached across
+    commits by crypto/secp256k1.QTableCache)."""
+    with compile_hook.dispatch_scope("secp256k1_q_tables", qx.shape):
+        if device is not None:
+            qx, qy = jax.device_put((qx, qy), device)
+        return _q_tabs_jitted(qx, qy)
+
+
+def verify_batch_msm_device(qtab, q_corr, gid, g_rows, g_neg, q_rows,
+                            q_neg, r_limbs, rn_limbs, rn_valid, s_pt,
+                            device=None):
+    with compile_hook.dispatch_scope("secp256k1_msm", gid.shape):
+        args = (qtab, q_corr, gid, g_rows, g_neg, q_rows, q_neg,
+                r_limbs, rn_limbs, rn_valid, s_pt)
+        if device is not None:
+            args = jax.device_put(args, device)
+        return _msm_jitted(*args)

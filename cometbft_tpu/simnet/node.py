@@ -60,9 +60,13 @@ def _seed_bytes(tag: str, seed: int) -> bytes:
 
 
 def make_sim_genesis(n_vals: int = 4, chain_id: str = "simnet-chain",
-                     power: int = 10, seed: int = 0):
-    """Deterministic genesis + the validators' private keys."""
-    privs = [ed25519.PrivKey.generate(_seed_bytes(f"val-{i}", seed))
+                     power: int = 10, seed: int = 0,
+                     key_module=ed25519):
+    """Deterministic genesis + the validators' private keys.
+    key_module picks the validator key type (crypto/ed25519 default;
+    crypto/secp256k1 builds an ECDSA validator set — the simnet arm
+    for the unified-MSM engine A/B)."""
+    privs = [key_module.PrivKey.generate(_seed_bytes(f"val-{i}", seed))
              for i in range(n_vals)]
     genesis = GenesisDoc(
         chain_id=chain_id, genesis_time=GENESIS_TIME,

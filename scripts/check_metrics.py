@@ -18,7 +18,13 @@ Checks:
      busy/idle series follow);
   6. DevprofMetrics per-device time series (busy/idle/occupancy) must
      carry a `device` label — an unlabeled aggregate cannot show one
-     starved chip in a busy mesh.
+     starved chip in a busy mesh;
+  7. every literal `compile_hook.dispatch_scope("<kind>")` and every
+     literal busy/flush-path label (`rec.advance(..., path="...")` /
+     `rec.event(..., path="...")`) across cometbft_tpu/ appears in the
+     devprof.DISPATCH_KINDS / devprof.BUSY_PATHS registries — a new
+     kernel cannot ship with its device time pooling unlabeled under
+     "other" on the occupancy dashboards.
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -33,6 +39,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 METRICS_PY = REPO / "cometbft_tpu" / "libs" / "metrics.py"
+DEVPROF_PY = REPO / "cometbft_tpu" / "libs" / "devprof.py"
 SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
 REG_METHODS = ("counter", "gauge", "histogram")
 # the reference's own p2p metrics label a camelCase chID; renaming it
@@ -100,6 +107,76 @@ def _reference_count(attr: str, roots=("cometbft_tpu", "tests")) -> int:
     return count
 
 
+def registered_labels(path: Path | None = None) -> tuple[set, set]:
+    """(DISPATCH_KINDS, BUSY_PATHS) parsed out of libs/devprof.py —
+    AST only, same no-import discipline as the metrics parser."""
+    tree = ast.parse((path or DEVPROF_PY).read_text())
+    out = {"DISPATCH_KINDS": set(), "BUSY_PATHS": set()}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in out
+                and isinstance(node.value, ast.Call)):
+            continue
+        arg = node.value.args[0] if node.value.args else None
+        if isinstance(arg, (ast.Set, ast.Tuple, ast.List)):
+            out[node.targets[0].id] = {
+                e.value for e in arg.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return out["DISPATCH_KINDS"], out["BUSY_PATHS"]
+
+
+def label_call_sites(root: Path | None = None) -> list[dict]:
+    """[{file, lineno, kind, value}] for every literal compile-ledger
+    kind (`*.dispatch_scope("<kind>", ...)`) and busy/flush-path label
+    (`*.advance(..., path="<label>")` / `*.event(..., path="...")`)
+    under ``root`` (default cometbft_tpu/).  Only string literals are
+    linted — a variable path is forwarding an already-linted label."""
+    root = root or (REPO / "cometbft_tpu")
+    sites = []
+    for py in sorted(root.rglob("*.py")):
+        tree = ast.parse(py.read_text())
+        rel = str(py.relative_to(root.parent if root.is_dir() else root))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            fn = node.func.attr
+            if fn == "dispatch_scope" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                sites.append({"file": rel, "lineno": node.lineno,
+                              "kind": "dispatch",
+                              "value": node.args[0].value})
+            if fn in ("advance", "event"):
+                for kw in node.keywords:
+                    if kw.arg == "path" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        sites.append({"file": rel,
+                                      "lineno": node.lineno,
+                                      "kind": "path",
+                                      "value": kw.value.value})
+    return sites
+
+
+def run_label_checks(root: Path | None = None,
+                     labels_path: Path | None = None) -> list[str]:
+    """Rule 7 findings: every literal kind/path label is registered."""
+    kinds, paths = registered_labels(labels_path)
+    findings = []
+    for s in label_call_sites(root):
+        registry, name = ((kinds, "devprof.DISPATCH_KINDS")
+                          if s["kind"] == "dispatch"
+                          else (paths, "devprof.BUSY_PATHS"))
+        if s["value"] not in registry:
+            findings.append(
+                f"{s['file']}:{s['lineno']}: {s['kind']} label "
+                f"{s['value']!r} is not registered in {name} — "
+                "unregistered kernel time pools under 'other'")
+    return findings
+
+
 def run_checks() -> list[str]:
     """All findings as human-readable strings; empty means clean."""
     metrics = registered_metrics()
@@ -146,6 +223,7 @@ def run_checks() -> list[str]:
                 f"{m['cls']}.{m['attr']} ({m['subsystem']}_{m['name']}) "
                 "is registered but never observed anywhere in "
                 "cometbft_tpu/ or tests/")
+    findings.extend(run_label_checks())
     return findings
 
 

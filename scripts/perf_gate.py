@@ -50,7 +50,13 @@ SKIP = {"rlc_batch", "headline_passes", "vs_baseline",
         # off the device — both are readings, not rates to gate on.
         # device_occupancy_fraction does gate (default higher-is-better:
         # chips going idle means the feed path regressed).
-        "compile_seconds_total", "host_bound_fraction"}
+        "compile_seconds_total", "host_bound_fraction",
+        # the ladder arm of the mixed-commit A/B: a comparison reading
+        # against mixed_commit_sigs_per_sec (the gated headline is the
+        # MSM-engine arm; the ladder arm moving says nothing about the
+        # shipping path).  secp256k1_msm_sigs_per_sec DOES gate, with
+        # the default higher-is-better direction.
+        "mixed_commit_sigs_per_sec_ladder"}
 
 
 def load_record(path: str) -> dict | None:

@@ -39,9 +39,20 @@ device_hash row timing the fused rlc_verify_hash_device dispatch to
 set against the device row.  Together they decompose the
 COMETBFT_TPU_DEVICE_HASH=1 window exactly as tracetl's split spans do.
 
+--secp adds the mixed-curve arm: a validator-set-shaped fixture whose
+signatures split ed25519/secp256k1 (PROFILE_N_SECP secp sigs over
+PROFILE_SECP_KEYS distinct keys), decomposed into per-stage rows for
+the unified MSM path — secp_pack (host: parse + u1/u2 + Joye-Tunstall
+recode), secp_q_tables (cold per-key table build, the QTableCache
+miss cost), secp_device_msm (warm-table MSM dispatch; TPU-gated like
+the device stage), secp_device_ladder (the per-signature Straus
+kernel on the same signatures — the A/B denominator), and
+mixed_verify (the whole commit through MixedBatchVerifier).  The
+JSONL shows exactly where the remaining secp time lives.
+
 Usage: env PYTHONPATH=/root/repo:/root/.axon_site \
        flock /tmp/tpu.lock python scripts/profile_blocksync.py \
-           [out.jsonl] [--overlap] [--hash-device]
+           [out.jsonl] [--overlap] [--hash-device] [--secp]
 """
 
 from __future__ import annotations
@@ -56,10 +67,11 @@ sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 from _capture_util import already_done, append_log, wedged  # noqa: E402
 
-_FLAGS = {"--overlap", "--hash-device"}
+_FLAGS = {"--overlap", "--hash-device", "--secp"}
 _ARGS = [a for a in sys.argv[1:] if a not in _FLAGS]
 OVERLAP = "--overlap" in sys.argv[1:]
 HASH_DEVICE = "--hash-device" in sys.argv[1:]
+SECP = "--secp" in sys.argv[1:]
 OUT = _ARGS[0] if _ARGS else "/tmp/blocksync_profile.jsonl"
 
 import os
@@ -480,6 +492,129 @@ def main():
                         window_s=round(dt, 3), pipelined_iters=iters)
             except Exception as e:
                 log(stage="device_hash", err=repr(e)[:500])
+
+    # -- mixed-curve arm (--secp): where the remaining secp time lives -
+    if SECP:
+        n_secp = int(os.environ.get("PROFILE_N_SECP", "1000"))
+        n_keys = int(os.environ.get("PROFILE_SECP_KEYS", "64"))
+        from cometbft_tpu.crypto import secp256k1 as sk_mod
+
+        if "secp_fixture" not in done:
+            log(stage="secp_fixture", start=True)
+        t0 = time.time()
+        sk_privs = [sk_mod.PrivKey.generate(
+            bytes([i & 0xFF, i >> 8] + [13] * 30))
+            for i in range(n_keys)]
+        s_pks, s_msgs, s_sigs = [], [], []
+        for i in range(n_secp):
+            p = sk_privs[i % n_keys]
+            m = b"secp-profile-" + i.to_bytes(8, "little") * 4
+            s_pks.append(p.pub_key().bytes())
+            s_msgs.append(m)
+            s_sigs.append(p.sign(m))
+        if "secp_fixture" not in done:
+            log(stage="secp_fixture", dt=round(time.time() - t0, 1),
+                n_secp=n_secp, n_keys=n_keys)
+
+        # host pack: parse + u1/u2 + odd-normalize + JT recode
+        if "secp_pack" not in done:
+            log(stage="secp_pack", start=True)
+        t0 = time.time()
+        pk = sk_mod.pack_msm_batch(s_pks, s_msgs, s_sigs, len(s_pks))
+        dt = time.time() - t0
+        if "secp_pack" not in done:
+            log(stage="secp_pack", window_s=round(dt, 3),
+                us_per_sig=round(1e6 * dt / n_secp, 1),
+                n_keys_padded=int(pk["keys_x"].shape[-1]))
+
+        # TPU-gated device stages (same probe discipline as the
+        # blocksync device stage)
+        if "secp_device_msm" not in done:
+            log(stage="secp_device_msm", start=True)
+            try:
+                import jax
+                from cometbft_tpu.ops import secp256k1 as sdev
+
+                import threading
+                box = {}
+
+                def _probe_secp():
+                    try:
+                        box["d"] = jax.devices()[0]
+                    except Exception as e:  # pragma: no cover
+                        box["err"] = repr(e)
+
+                th = threading.Thread(target=_probe_secp, daemon=True)
+                th.start()
+                th.join(90)
+                d = box.get("d")
+                is_tpu = d is not None and (
+                    "tpu" in getattr(d, "device_kind", "").lower()
+                    or d.platform == "tpu")
+                if not is_tpu:
+                    log(stage="secp_device_msm",
+                        skipped="no TPU in this process")
+                else:
+                    # cold table build = the QTableCache miss cost
+                    t0 = time.time()
+                    qtab, q_corr = sdev.build_q_msm_tables_device(
+                        pk["keys_x"], pk["keys_y"])
+                    np.asarray(qtab)
+                    log(stage="secp_q_tables",
+                        window_s=round(time.time() - t0, 3),
+                        table_mb=round(qtab.size * 4 / 2**20, 1))
+                    args = jax.device_put(
+                        (qtab, q_corr, pk["gid"], pk["g_rows"],
+                         pk["g_neg"], pk["q_rows"], pk["q_neg"],
+                         pk["r_limbs"], pk["rn_limbs"],
+                         pk["rn_valid"], pk["s_pt"]))
+                    assert np.asarray(
+                        sdev.verify_batch_msm_device(*args)).all()
+                    iters = 4
+                    t0 = time.time()
+                    outs = [sdev.verify_batch_msm_device(*args)
+                            for _ in range(iters)]
+                    np.asarray(outs[-1])
+                    dt = (time.time() - t0) / iters
+                    log(stage="secp_device_msm",
+                        window_s=round(dt, 3),
+                        sigs_per_sec=round(n_secp / dt, 1))
+                    # ladder A/B on the same signatures
+                    lpk = sk_mod.pack_batch(s_pks, s_msgs, s_sigs,
+                                            len(s_pks))
+                    largs = jax.device_put(lpk[:-1])
+                    assert np.asarray(
+                        sdev.verify_batch_device(*largs)).all()
+                    t0 = time.time()
+                    outs = [sdev.verify_batch_device(*largs)
+                            for _ in range(iters)]
+                    np.asarray(outs[-1])
+                    dt_l = (time.time() - t0) / iters
+                    log(stage="secp_device_ladder",
+                        window_s=round(dt_l, 3),
+                        sigs_per_sec=round(n_secp / dt_l, 1),
+                        msm_vs_ladder=round(dt_l / dt, 2))
+            except Exception as e:
+                log(stage="secp_device_msm", err=repr(e)[:500])
+
+        # whole mixed commit through the shipping verifier
+        if "mixed_verify" not in done:
+            log(stage="mixed_verify", start=True)
+            from cometbft_tpu.crypto import batch as cb
+            from cometbft_tpu.crypto import ed25519 as ced
+
+            v = cb.MixedBatchVerifier()
+            n_ed_used = min(len(pks), 9 * n_secp)
+            for i in range(n_ed_used):
+                v.add(ced.PubKey(pks[i]), msgs[i], sigs_raw[i])
+            for pkb, m, s in zip(s_pks, s_msgs, s_sigs):
+                v.add(sk_mod.PubKey(pkb), m, s)
+            t0 = time.time()
+            ok, verdicts = v.verify()
+            dt = time.time() - t0
+            log(stage="mixed_verify", window_s=round(dt, 3),
+                ok=bool(ok), n_ed=n_ed_used, n_secp=n_secp,
+                sigs_per_sec=round((n_ed_used + n_secp) / dt, 1))
 
     log(stage="done", total_s=round(time.time() - t_start, 1))
 

@@ -472,3 +472,50 @@ class TestConsensusCacheAB:
         assert r["hit_rate_off"] == 0.0
         assert r["hit_rate_on"] > 0.0
         assert r["verdict_cache_on"]["hits"] > 0
+
+
+class TestMixedCurveCache:
+    """secp256k1 verdicts flow through the SAME sigcache seams as
+    ed25519 (the MSM engine's batch verifier is just another resolution
+    seam), and key_type length-framing partitions the keyspace — the
+    same raw bytes under different curves are distinct entries."""
+
+    @staticmethod
+    def _secp_triple(i: int, good: bool = True):
+        from cometbft_tpu.crypto import secp256k1 as sk
+
+        priv = sk.PrivKey.generate(bytes([40 + i]) * 4)
+        msg = b"sigcache-secp-" + i.to_bytes(4, "little")
+        sig = priv.sign(msg)
+        if not good:
+            sig = sig[:6] + bytes([sig[6] ^ 1]) + sig[7:]
+        return priv.pub_key(), msg, sig
+
+    def test_mixed_batch_inserts_both_curves_then_all_hits(self):
+        sigcache.set_enabled(True)
+        eds = [_triple(i) for i in range(3)]
+        secps = [self._secp_triple(i, good=(i != 1)) for i in range(3)]
+        bv = cb.MixedBatchVerifier(provider="cpu")
+        for pk, msg, sig in eds + secps:
+            bv.add(pk, msg, sig)
+        ok, verdicts = bv.verify()
+        assert not ok
+        assert verdicts == [True, True, True, True, False, True]
+        # every computed verdict (including the secp negative) was
+        # inserted at flush; a re-partition is all hits, no misses
+        got, miss = sigcache.partition(eds + secps)
+        assert miss == [] and got == verdicts
+        st = sigcache.cache().stats()
+        assert st["insertions"] >= 6
+
+    def test_key_type_partitions_identical_raw_bytes(self):
+        sigcache.set_enabled(True)
+        pk, msg, sig = _triple(7)
+        raw = pk.bytes()
+        sigcache.insert(raw, msg, sig, True, key_type="ed25519")
+        assert sigcache.get(raw, msg, sig, key_type="ed25519") is True
+        assert sigcache.get(raw, msg, sig, key_type="secp256k1") is None
+        sigcache.insert(raw, msg, sig, False, key_type="secp256k1")
+        assert sigcache.get(raw, msg, sig,
+                            key_type="secp256k1") is False
+        assert sigcache.get(raw, msg, sig, key_type="ed25519") is True

@@ -324,6 +324,44 @@ class TestCheckMetrics:
         assert any("BadLabel" in f for f in findings)
 
 
+class TestLabelRegistryLint:
+    """check_metrics rule 7: every literal dispatch_scope kind and
+    busy/flush-path label in cometbft_tpu/ must appear in the
+    devprof.DISPATCH_KINDS / devprof.BUSY_PATHS registries — a new
+    kernel cannot ship with its device time pooling under 'other'."""
+
+    def test_registries_parse_nonempty_and_cover_msm_kinds(self):
+        mod = TestCheckMetrics._load()
+        kinds, paths = mod.registered_labels()
+        assert {"secp256k1_msm", "secp256k1_q_tables",
+                "ed25519_rlc", "other"} <= kinds
+        assert {"device", "host", "cache", "drain"} <= paths
+
+    def test_repo_call_sites_all_registered(self):
+        mod = TestCheckMetrics._load()
+        sites = mod.label_call_sites()
+        assert len(sites) >= 10          # the lint actually sees code
+        assert mod.run_label_checks() == []
+
+    def test_lint_flags_unregistered_labels(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        bad = tmp_path / "k.py"
+        bad.write_text(
+            "def f(hook, rec, d, s, shape):\n"
+            "    with hook.dispatch_scope('bogus_kind', shape):\n"
+            "        pass\n"
+            "    rec.advance(d, s, path='bogus_path')\n"
+            "    rec.event(d, s, path='device')\n")
+        sites = mod.label_call_sites(tmp_path)
+        assert {(s["kind"], s["value"]) for s in sites} == {
+            ("dispatch", "bogus_kind"), ("path", "bogus_path"),
+            ("path", "device")}
+        findings = mod.run_label_checks(root=tmp_path)
+        assert len(findings) == 2
+        assert any("bogus_kind" in f for f in findings)
+        assert any("bogus_path" in f for f in findings)
+
+
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
     as a tier-1 test so a perf cliff fails CI before a round lands."""
