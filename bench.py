@@ -509,8 +509,10 @@ def bench_commit_reverify(n_sigs: int | None = None,
 def bench_chaos() -> dict:
     """Recovery metrics from the chaos nemesis engine (docs/CHAOS.md):
     seeded deterministic fault scenarios over simnet — a partition/heal
-    cycle (time-to-first-commit after heal) and a device-fault burst
-    through the verify pipeline's drain path (blocks/s under faults).
+    cycle (time-to-first-commit after heal), a device-fault burst
+    through the verify pipeline's drain path (blocks/s under faults),
+    and a flapping-chip quarantine/probe cycle (seconds from
+    quarantine entry to the probe that restores the chip).
     A scenario that violates an invariant raises instead of reporting:
     numbers measured on a broken cluster are worse than no numbers.
     Sizes via CHAOS_BENCH_BLOCKS / seed via CHAOS_BENCH_SEED."""
@@ -959,6 +961,7 @@ def main() -> None:
         ("light_e2e_headers_per_sec", "light_e2e_config"),
         ("chaos_recovery_seconds", "chaos_config"),
         ("chaos_faulted_blocks_per_sec", None),
+        ("chaos_flap_recovery_seconds", None),
         ("mixed_commit_sigs_per_sec", "mixed_commit_config"),
         ("mixed_commit_sigs_per_sec_ladder",
          "mixed_commit_ladder_config"),
@@ -1315,9 +1318,9 @@ def main() -> None:
               " over an already-verified commit's triples — SHA-256"
               " keying + striped LRU hits only (SIGCACHE_BENCH_SIGS x"
               " SIGCACHE_BENCH_ITERS, defaults 1024 x 50)")
-    # chaos recovery metrics: both numbers come from ONE bench_chaos()
+    # chaos recovery metrics: every number comes from ONE bench_chaos()
     # run (seeded deterministic scenarios, CPU-only — no device time);
-    # the second metric and the detail ride the recovery extra's run
+    # the companion metrics and the detail ride the recovery extra's run
     run_extra("chaos_recovery_seconds",
               lambda: bench_chaos()["chaos_recovery_seconds"],
               "chaos_config",
@@ -1338,9 +1341,14 @@ def main() -> None:
         if isinstance(rate, (int, float)):
             extra["chaos_faulted_blocks_per_sec"] = rate
             carried_keys.discard("chaos_faulted_blocks_per_sec")
+        flap = _last_chaos.get("chaos_flap_recovery_seconds")
+        if isinstance(flap, (int, float)):
+            extra["chaos_flap_recovery_seconds"] = flap
+            carried_keys.discard("chaos_flap_recovery_seconds")
         extra["chaos_detail"] = {
             k: _last_chaos.get(k) for k in ("partition_heal",
-                                            "device_fault_drain")}
+                                            "device_fault_drain",
+                                            "device_flap_quarantine")}
         _sync_carried()
         persist()
 

@@ -21,6 +21,10 @@ MAX_PENDING_REQUESTS = 64        # window size: >= the 48-block
                                  # rewards (reactor.VERIFY_WINDOW)
 MAX_PENDING_REQUESTS_PER_PEER = 20
 PEER_TIMEOUT = 15.0              # pool.go peerTimeout
+# retry jitter bound for refetches (_redo_request): N peers that all
+# timed out on the same stalled height otherwise re-request in
+# lockstep, hammering whichever peer the random choice converges on
+RETRY_JITTER = 0.05
 
 
 class _Peer:
@@ -31,15 +35,17 @@ class _Peer:
         self.num_pending = 0
         self.timeout_at: float | None = None
 
-    def arm_timeout(self) -> None:
+    def arm_timeout(self, timeout: float | None = None) -> None:
         if self.timeout_at is None:
-            self.timeout_at = time.monotonic() + PEER_TIMEOUT
+            self.timeout_at = time.monotonic() + (
+                timeout if timeout is not None else PEER_TIMEOUT)
 
-    def reset_timeout(self) -> None:
+    def reset_timeout(self, timeout: float | None = None) -> None:
         """On every delivered block: an actively responsive peer must
         not expire mid-sync (pool.go decrPending)."""
         if self.num_pending > 0:
-            self.timeout_at = time.monotonic() + PEER_TIMEOUT
+            self.timeout_at = time.monotonic() + (
+                timeout if timeout is not None else PEER_TIMEOUT)
         else:
             self.timeout_at = None
 
@@ -57,23 +63,38 @@ class _Requester:
         self.block = None
         self.ext_commit = None
         self.excluded: set[str] = set()  # peers that failed this height
+        self.not_before = 0.0            # jittered refetch hold-off
 
 
 class BlockPool(BaseService):
     def __init__(self, start_height: int, send_request,
-                 on_peer_error=None):
+                 on_peer_error=None, peer_timeout: float | None = None,
+                 retry_jitter: float | None = None):
         """send_request(height, peer_id) issues a BlockRequest;
-        on_peer_error(peer_id, reason) reports misbehaving peers."""
+        on_peer_error(peer_id, reason) reports misbehaving peers.
+        peer_timeout/retry_jitter of None defer to the module knobs
+        (PEER_TIMEOUT / RETRY_JITTER) at use time, the late binding
+        the simnet tuner and tests monkeypatch."""
         super().__init__("BlockPool")
         self._mtx = threading.RLock()
         self.start_height = start_height
         self.height = start_height       # next height to sync
+        self.peer_timeout = peer_timeout
+        self.retry_jitter = retry_jitter
         self._peers: dict[str, _Peer] = {}
         self._requesters: dict[int, _Requester] = {}
         self._send_request = send_request
         self._on_peer_error = on_peer_error or (lambda pid, r: None)
         self.last_advance = time.monotonic()
         self._thread: threading.Thread | None = None
+
+    def _peer_timeout(self) -> float:
+        return self.peer_timeout if self.peer_timeout is not None \
+            else PEER_TIMEOUT
+
+    def _retry_jitter(self) -> float:
+        return self.retry_jitter if self.retry_jitter is not None \
+            else RETRY_JITTER
 
     # -- lifecycle ---------------------------------------------------------
     def on_start(self) -> None:
@@ -98,9 +119,12 @@ class BlockPool(BaseService):
                         next_height not in self._requesters:
                     self._requesters[next_height] = _Requester(
                         next_height)
-                # all unassigned requesters are assignment candidates
+                # all unassigned requesters past their jittered
+                # hold-off are assignment candidates
+                now = time.monotonic()
                 todo = [r for r in self._requesters.values()
-                        if r.peer_id is None and r.block is None]
+                        if r.peer_id is None and r.block is None
+                        and r.not_before <= now]
             progressed = False
             for req in todo:
                 if self._assign_and_send(req):
@@ -128,7 +152,7 @@ class BlockPool(BaseService):
             peer = random.choice(candidates)
             req.peer_id = peer.id
             peer.num_pending += 1
-            peer.arm_timeout()
+            peer.arm_timeout(self._peer_timeout())
         try:
             self._send_request(req.height, peer.id)
             return True
@@ -192,6 +216,12 @@ class BlockPool(BaseService):
             req.peer_id = None
             req.block = None
             req.ext_commit = None
+            # jitter the refetch so simultaneous timeouts across many
+            # heights do not re-request (and re-time-out) in lockstep
+            jitter = self._retry_jitter()
+            if jitter > 0:
+                req.not_before = time.monotonic() + \
+                    random.uniform(0, jitter)
 
     def _max_peer_height(self) -> int:
         with self._mtx:
@@ -219,7 +249,7 @@ class BlockPool(BaseService):
             p = self._peers.get(peer_id)
             if p is not None:
                 p.num_pending -= 1
-                p.reset_timeout()
+                p.reset_timeout(self._peer_timeout())
 
     def no_block_response(self, peer_id: str, height: int) -> None:
         self._redo_request(height, peer_id)

@@ -48,7 +48,7 @@ MESH_DEVICES = int(os.environ.get(
 
 class BlocksyncReactor(Reactor):
     def __init__(self, state, block_exec, block_store, block_sync: bool,
-                 consensus_reactor=None):
+                 consensus_reactor=None, peer_timeout: float | None = None):
         super().__init__("BlocksyncReactor")
         self.initial_state = state
         self.state = state
@@ -56,9 +56,11 @@ class BlocksyncReactor(Reactor):
         self.store = block_store
         self.block_sync = block_sync       # actively syncing?
         self.consensus_reactor = consensus_reactor
+        self.peer_timeout = peer_timeout   # None -> pool.PEER_TIMEOUT
         self.pool = BlockPool(
             max(self.store.height() + 1, state.initial_height),
-            self._send_block_request, self._on_peer_error)
+            self._send_block_request, self._on_peer_error,
+            peer_timeout=peer_timeout)
         self._stop_sync = threading.Event()
         self.synced = not block_sync
         self.metrics = None        # BlockSyncMetrics when the node meters
@@ -122,7 +124,8 @@ class BlocksyncReactor(Reactor):
                                   state.last_block_height + 1,
                                   state.initial_height),
                               self._send_block_request,
-                              self._on_peer_error)
+                              self._on_peer_error,
+                              peer_timeout=self.peer_timeout)
         for peer in (self.switch.peers.list() if self.switch else []):
             peer.try_send(BLOCKSYNC_CHANNEL, bm.wrap(bm.StatusRequest()))
         self.pool.start()

@@ -41,27 +41,53 @@ class DeviceFaultController:
     (host safe_verify — byte-deterministic, no accelerator); an armed
     window either raises (mode='drain': the pipeline drains it and
     everything staged behind it through the host path, exactly like a
-    real device error) or — mode='forge', the deliberately broken
-    injector for the oracle self-test — returns all-true WITHOUT
-    verifying anything, which is precisely the bug the commit-validity
-    invariant must catch.
+    real device error), wedges forever (mode='hang': the dispatch
+    thread blocks until release(), exercising the watchdog's
+    abandon-and-replace path), or — mode='forge', the deliberately
+    broken injector for the oracle self-test — returns all-true
+    WITHOUT verifying anything, which is precisely the bug the
+    commit-validity invariant must catch.
+
+    Arm with ``windows < 0`` for an unbounded burst (mode='kill': the
+    chip never comes back — every window AND every health probe on it
+    faults, so the pipeline quarantines it permanently and, once every
+    chip is gone, degrades to brownout).  ``device=`` scopes the burst
+    to one mesh chip by ``win.device_index``; probe windows count
+    against the armed budget too, so a bounded flap burst produces ONE
+    quarantine cycle — probes keep failing while the burst lasts and
+    the first post-burst probe restores the chip.
     """
+
+    MODES = ("drain", "forge", "hang", "kill")
 
     def __init__(self):
         self._mtx = threading.Lock()
         self._armed = 0
         self.mode = "drain"
+        self.device: int | None = None
         self.faults_fired = 0
         self.windows_seen = 0
+        self.probes_seen = 0
         self.first_fault_t: float | None = None
         self.last_fault_t: float | None = None
+        self._release = threading.Event()
 
-    def arm(self, windows: int, mode: str = "drain") -> None:
-        if mode not in ("drain", "forge"):
+    def arm(self, windows: int, mode: str = "drain",
+            device: int | None = None) -> None:
+        if mode not in self.MODES:
             raise ValueError(f"unknown device-fault mode {mode!r}")
         with self._mtx:
             self._armed = int(windows)
             self.mode = mode
+            self.device = int(device) if device is not None else None
+            if mode == "hang":
+                self._release.clear()
+
+    def release(self) -> None:
+        """Unblock every dispatch wedged in hang mode.  The cluster
+        calls this BEFORE stopping a node's pipeline so thread joins
+        cannot deadlock on a still-wedged dispatch."""
+        self._release.set()
 
     @property
     def armed(self) -> int:
@@ -71,10 +97,16 @@ class DeviceFaultController:
     def dispatch(self, win):
         import time
 
+        hang = False
         with self._mtx:
             self.windows_seen += 1
-            if self._armed > 0:
-                self._armed -= 1
+            if getattr(win.handle, "subsystem", "") == "probe":
+                self.probes_seen += 1
+            mine = self.device is None or \
+                getattr(win, "device_index", 0) == self.device
+            if mine and self._armed != 0:
+                if self._armed > 0:
+                    self._armed -= 1
                 self.faults_fired += 1
                 now = time.monotonic()
                 if self.first_fault_t is None:
@@ -85,7 +117,17 @@ class DeviceFaultController:
                     # resolves the window valid without verifying —
                     # the commit-validity checker MUST trip on this
                     return True, [True] * len(win.items)
-                raise RuntimeError("chaos: injected device fault")
+                if self.mode == "hang":
+                    hang = True
+                else:
+                    raise RuntimeError("chaos: injected device fault")
+        if hang:
+            # wedge OUTSIDE the mutex so the watchdog, later arms, and
+            # the honest windows on other chips keep flowing; once
+            # released, raise — the window was already abandoned and
+            # host-resolved, the pipeline drops this stale verdict
+            self._release.wait()
+            raise RuntimeError("chaos: hung dispatch released")
         if win.mode == "mixed":
             return win.verifier.verify()
         from ..crypto.batch import safe_verify
@@ -119,6 +161,10 @@ class ChaosCluster:
         self._specs: dict[str, dict] = {}
         self._edges: list[tuple[str, str, bool]] = []
         self.device_controllers: dict[str, DeviceFaultController] = {}
+        # per-node HealthRegistry for chaos pipelines: scoped here (not
+        # the process seam) so scenarios read quarantine/recovery facts
+        # after stop_all, and so restarts reuse the same health view
+        self.device_health: dict[str, object] = {}
         self._saved_deferred_threshold: int | None = None
         self._saved_tuning: dict | None = None
         self._started = False
@@ -241,6 +287,11 @@ class ChaosCluster:
     def stop_all(self) -> None:
         from ..libs import flightrec
         flightrec.set_recorder(self._saved_recorder)
+        # unwedge hung dispatches FIRST: pipeline stop joins its device
+        # threads, and a thread parked in a hang-mode dispatch would
+        # deadlock the join
+        for ctl in self.device_controllers.values():
+            ctl.release()
         for name, node in list(self.nodes.items()):
             try:
                 node.stop()
@@ -254,6 +305,7 @@ class ChaosCluster:
                     pass
         for pipe in list(self.device_controllers):
             self.device_controllers.pop(pipe, None)
+        self.device_health.clear()
         if self._saved_deferred_threshold is not None:
             validation.DeferredSigBatch.DEVICE_THRESHOLD = \
                 self._saved_deferred_threshold
@@ -291,6 +343,7 @@ class ChaosCluster:
         # it models the chaos HARNESS, not node state
         if name in self.device_controllers and \
                 node.blocksync_reactor._pipeline is not None:
+            self.device_controllers[name].release()
             node.blocksync_reactor._pipeline.stop()
             node.blocksync_reactor._pipeline = None
         node.stop()
@@ -322,28 +375,62 @@ class ChaosCluster:
         return node
 
     # -- chaos device seam -------------------------------------------------
-    def install_chaos_device(self, name: str,
-                             depth: int = 2) -> DeviceFaultController:
+    def install_chaos_device(self, name: str, depth: int = 2,
+                             devices: int = 0,
+                             deadline: float | None = None,
+                             probe_backoff_s: float = 0.05,
+                             quarantine_after: int = 3,
+                             ) -> DeviceFaultController:
         """Route `name`'s blocksync verify windows through a
         controller-driven pipeline and force the deferred threshold
         low enough that windows actually take the device lane (the
-        fixture idiom tests/test_simnet.py established)."""
+        fixture idiom tests/test_simnet.py established).
+
+        ``devices >= 2`` builds a mesh pipeline over that many fake
+        chips (ints stand in for jax devices — the controller seam
+        never touches them), so per-chip quarantine and round-robin
+        skip become observable; ``deadline`` arms the hung-dispatch
+        watchdog with a chaos-scale budget (the 600s production
+        default would outlive the scenario); the probe/quarantine
+        knobs shrink the health registry's recovery constants the
+        same way tune_blocksync shrinks the pool's."""
         if self._saved_deferred_threshold is None:
             self._saved_deferred_threshold = \
                 validation.DeferredSigBatch.DEVICE_THRESHOLD
             validation.DeferredSigBatch.DEVICE_THRESHOLD = 1
-        self._specs[name]["chaos_device"] = depth
-        return self._install_device(name, depth)
+        spec = {"depth": depth, "devices": devices, "deadline": deadline,
+                "probe_backoff_s": probe_backoff_s,
+                "quarantine_after": quarantine_after}
+        self._specs[name]["chaos_device"] = spec
+        return self._install_device(name, spec)
 
     def _install_device(self, name: str,
-                        depth: int) -> DeviceFaultController:
+                        spec) -> DeviceFaultController:
+        if isinstance(spec, int):    # pre-health spec shape: bare depth
+            spec = {"depth": spec, "devices": 0, "deadline": None,
+                    "probe_backoff_s": 0.05, "quarantine_after": 3}
         ctl = self.device_controllers.get(name)
         if ctl is None:
             ctl = DeviceFaultController()
             self.device_controllers[name] = ctl
+        health = self.device_health.get(name)
+        if health is None:
+            from ..crypto.devhealth import HealthRegistry
+            health = HealthRegistry(
+                quarantine_after=spec["quarantine_after"],
+                probe_backoff_s=spec["probe_backoff_s"],
+                probe_backoff_max_s=max(0.2,
+                                        spec["probe_backoff_s"] * 4))
+            self.device_health[name] = health
         node = self.nodes[name]
+        devices = (list(range(spec["devices"]))
+                   if spec["devices"] >= 2 else None)
+        depth = (spec["depth"] if devices is None
+                 else max(spec["depth"], 2 * len(devices)))
         pipe = VerifyPipeline(depth=depth, dispatch_fn=ctl.dispatch,
-                              name=f"chaos-{name}")
+                              name=f"chaos-{name}", devices=devices,
+                              health=health,
+                              dispatch_deadline_s=spec["deadline"])
         pipe.start()
         reactor = node.blocksync_reactor
         if reactor._pipeline is not None:

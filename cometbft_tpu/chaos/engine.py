@@ -173,7 +173,8 @@ class NemesisEngine:
             for chk in self.checkers:
                 if isinstance(chk, EvidenceCommitted):
                     chk.arm(info["address"])
-        elif step.action == "device_fault" and info:
+        elif step.action in ("device_fault", "device_hang",
+                             "device_flap", "device_kill") and info:
             node = self.cluster.nodes.get(info["node"])
             self._burst = (time.monotonic(),
                            self._applied_height(node) if node else 0,
@@ -205,10 +206,16 @@ class NemesisEngine:
                         self.metrics.faulted_blocks_per_sec.set(rate)
         ctl_stats = {
             n: {"windows_seen": c.windows_seen,
-                "faults_fired": c.faults_fired}
+                "faults_fired": c.faults_fired,
+                "probes_seen": c.probes_seen}
             for n, c in self.cluster.device_controllers.items()}
         if ctl_stats:
             timing["device"] = ctl_stats
+        health_stats = {
+            n: reg.snapshot()
+            for n, reg in self.cluster.device_health.items()}
+        if health_stats:
+            timing["device_health"] = health_stats
 
     # -- reporting ---------------------------------------------------------
     def _fingerprint(self, executed) -> None:

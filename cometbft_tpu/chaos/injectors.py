@@ -102,16 +102,67 @@ def clock_skew(cluster, node, factor):
 # -- device ------------------------------------------------------------------
 
 @injector
-def device_fault(cluster, node, windows=2, mode="drain"):
+def device_fault(cluster, node, windows=2, mode="drain", device=None):
     """Arm a burst of device faults on the node's chaos verify
     pipeline (install_chaos_device must have run at cluster build).
     mode='drain' raises like a real device error — the pipeline must
     drain the faulted window and everything staged behind it through
     the host path; mode='forge' is the BROKEN oracle-proving variant
-    that skips the drain and claims every signature valid."""
+    that skips the drain and claims every signature valid.  `device`
+    scopes the burst to one mesh chip (win.device_index); None hits
+    whichever chip dequeues first — on a mesh pipeline, pass the chip
+    explicitly or the burst lands nondeterministically."""
     ctl = cluster.device_controllers[node]
-    ctl.arm(windows, mode=mode)
-    return {"node": node, "windows": int(windows), "mode": mode}
+    ctl.arm(windows, mode=mode, device=device)
+    info = {"node": node, "windows": int(windows), "mode": mode}
+    if device is not None:
+        info["device"] = int(device)
+    return info
+
+
+@injector
+def device_hang(cluster, node, windows=1, device=None):
+    """Wedge the next armed dispatch forever: the dispatch thread
+    blocks inside the device call until the controller's release()
+    (cluster teardown) — the hung-dispatch watchdog must detect it
+    within the pipeline's deadline, host-resolve the window, abandon
+    the thread, and quarantine the chip."""
+    ctl = cluster.device_controllers[node]
+    ctl.arm(windows, mode="hang", device=device)
+    info = {"node": node, "windows": int(windows), "mode": "hang"}
+    if device is not None:
+        info["device"] = int(device)
+    return info
+
+
+@injector
+def device_flap(cluster, node, windows=6, device=None):
+    """A flapping chip: a bounded burst of drain faults long enough to
+    cross the quarantine threshold AND fail the first probes (probe
+    windows consume the armed budget too).  The health machine must
+    quarantine once — not thrash fault->resume — and return the chip
+    only after a post-burst probe passes."""
+    ctl = cluster.device_controllers[node]
+    ctl.arm(windows, mode="drain", device=device)
+    info = {"node": node, "windows": int(windows), "mode": "flap"}
+    if device is not None:
+        info["device"] = int(device)
+    return info
+
+
+@injector
+def device_kill(cluster, node, device=None):
+    """Kill a chip (or with device=None, every chip) permanently:
+    unbounded faults, probes included, so the chip never returns.
+    Killing every chip must push the pipeline into brownout — pure
+    host verify with shrunken windows and a bounded queue — and the
+    node must STILL commit blocks."""
+    ctl = cluster.device_controllers[node]
+    ctl.arm(-1, mode="kill", device=device)
+    info = {"node": node, "mode": "kill"}
+    if device is not None:
+        info["device"] = int(device)
+    return info
 
 
 # -- byzantine ---------------------------------------------------------------

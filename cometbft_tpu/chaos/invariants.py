@@ -241,6 +241,52 @@ class BoundedLiveness(Checker):
         return []
 
 
+class PipelineConservation(Checker):
+    """No verdict lost by the verify plane: at scenario end the named
+    node's chaos pipeline must have resolved EVERY submitted window
+    (hung ones via the watchdog's host drain, brownout ones via the
+    host path) with nothing left in flight.  This is the futures-
+    never-dropped contract the watchdog/brownout machinery makes —
+    a pipeline that quietly dropped a window would wedge blocksync
+    (caught by liveness) OR double-resolve (caught here)."""
+
+    name = "pipeline_conservation"
+
+    def __init__(self, node: str, settle_s: float = 2.0):
+        self.node_name = node
+        self.settle_s = settle_s
+
+    def check(self, cluster, final: bool = False) -> list[Violation]:
+        if not final:
+            return []
+        node = cluster.nodes.get(self.node_name)
+        if node is None:
+            return []
+        pipe = getattr(node.blocksync_reactor, "_pipeline", None)
+        if pipe is None:
+            return []
+        # the goal (applied height) can be met a beat before the last
+        # window's counters tick; give resolution a short settle
+        deadline = time.monotonic() + self.settle_s
+        while time.monotonic() < deadline:
+            if pipe.resolved == pipe.submitted and not pipe._windows:
+                return []
+            time.sleep(0.02)
+        out = []
+        if pipe.resolved != pipe.submitted:
+            out.append(Violation(
+                self.name, node=self.node_name,
+                detail=f"pipeline resolved {pipe.resolved} of "
+                       f"{pipe.submitted} submitted windows"))
+        inflight = len(pipe._windows)
+        if inflight:
+            out.append(Violation(
+                self.name, node=self.node_name,
+                detail=f"{inflight} windows still in flight at "
+                       "scenario end"))
+        return out
+
+
 def default_checkers(liveness_budget_s: float = 60.0) -> list[Checker]:
     return [Agreement(), CommitValidity(), HeightMonotonic(),
             BoundedLiveness(liveness_budget_s)]

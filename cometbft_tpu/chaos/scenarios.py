@@ -25,7 +25,7 @@ from .cluster import ChaosCluster
 from .engine import NemesisEngine, ScenarioResult
 from .invariants import (
     Agreement, BoundedLiveness, CommitValidity, EvidenceCommitted,
-    HeightMonotonic, default_checkers,
+    HeightMonotonic, PipelineConservation, default_checkers,
 )
 from .plan import Plan
 
@@ -207,6 +207,86 @@ def partition_devicefault_crash(seed, blocks=32, artifact_dir=None,
                 artifact_dir, metrics)
 
 
+@scenario(deterministic=True)
+def device_hang_watchdog(seed, blocks=24, artifact_dir=None,
+                         workdir=None, metrics=None, timeout=90.0):
+    """A dispatch wedges forever mid-sync: the watchdog must detect it
+    within the pipeline's deadline, resolve the hung window through
+    the host path (no verdict lost — PipelineConservation), abandon
+    the wedged thread, quarantine the chip, and let a probe return it
+    to rotation.  The sync still converges to the seed's exact
+    chain."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer", deadline=0.5,
+                           probe_backoff_s=0.05, quarantine_after=1)
+    c.dial("syncer", "src0")
+    plan = (Plan("device_hang_watchdog")
+            .setup("device_hang", node="syncer", windows=1)
+            .goal(["syncer"], blocks, timeout=timeout))
+    checkers = default_checkers(liveness_budget_s=45)
+    checkers.append(PipelineConservation("syncer"))
+    return _run(c, plan, checkers, artifact_dir, metrics)
+
+
+@scenario(deterministic=True)
+def device_flap_quarantine(seed, blocks=24, artifact_dir=None,
+                           workdir=None, metrics=None, timeout=90.0):
+    """A flapping chip on a two-chip mesh: chip 0 faults its first
+    window AND its first probes (the armed budget covers both), so
+    the health machine must quarantine it ONCE — not thrash
+    fault->resume — keep traffic on chip 1 meanwhile, and return
+    chip 0 only after a post-burst probe passes.  The quarantine ->
+    probe-ok duration lands in timing as flap_recovery_seconds (the
+    bench extra)."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer", devices=2,
+                           probe_backoff_s=0.05, quarantine_after=1)
+    c.dial("syncer", "src0")
+    plan = (Plan("device_flap_quarantine")
+            .setup("device_flap", node="syncer", windows=3, device=0)
+            .goal(["syncer"], blocks, timeout=timeout))
+    checkers = default_checkers(liveness_budget_s=45)
+    checkers.append(PipelineConservation("syncer"))
+    res = _run(c, plan, checkers, artifact_dir, metrics)
+    dh = res.timing.get("device_health", {}).get("syncer", {})
+    recov = [t for s in dh.values() for t in s["recovery_seconds"]]
+    if recov:
+        res.timing["flap_recovery_seconds"] = round(recov[-1], 4)
+    return res
+
+
+@scenario(deterministic=True)
+def device_kill_brownout(seed, blocks=24, artifact_dir=None,
+                         workdir=None, metrics=None, timeout=90.0):
+    """Every chip dies permanently (faults forever, probes included):
+    the pipeline must quarantine both, enter brownout — pure host
+    verify, bounded queue, shrunken windows — and the node must STILL
+    sync the full chain.  Liveness under total accelerator loss is
+    the whole point of the degradation ladder."""
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer", devices=2,
+                           probe_backoff_s=0.05, quarantine_after=1)
+    c.dial("syncer", "src0")
+    plan = (Plan("device_kill_brownout")
+            .setup("device_kill", node="syncer")
+            .goal(["syncer"], blocks, timeout=timeout))
+    checkers = default_checkers(liveness_budget_s=45)
+    checkers.append(PipelineConservation("syncer"))
+    return _run(c, plan, checkers, artifact_dir, metrics)
+
+
 # -- live-consensus scenarios ------------------------------------------------
 
 @scenario(deterministic=False)
@@ -360,11 +440,13 @@ def selftest_evidence_disabled(seed, target=4, artifact_dir=None,
 # -- bench surfacing ---------------------------------------------------------
 
 def bench_chaos(seed: int = 29, blocks: int = 24) -> dict:
-    """The two chaos_* bench extras in one record: recovery time after
-    a partition heal (partition_heal scenario) and blocks/s across a
-    device-fault burst (device_fault_drain).  Deterministic scenarios,
-    zero expected violations — a violation fails the bench loudly
-    rather than shipping a number measured on a broken cluster."""
+    """The chaos_* bench extras in one record: recovery time after a
+    partition heal (partition_heal scenario), blocks/s across a
+    device-fault burst (device_fault_drain), and quarantine-to-
+    probe-ok time for a flapping chip (device_flap_quarantine).
+    Deterministic scenarios, zero expected violations — a violation
+    fails the bench loudly rather than shipping a number measured on
+    a broken cluster."""
     global last_chaos
     from ..crypto import sigcache
     # same per-process realism as run_scenario: the shared in-process
@@ -374,9 +456,10 @@ def bench_chaos(seed: int = 29, blocks: int = 24) -> dict:
     try:
         r1 = partition_heal(seed, blocks=blocks)
         r2 = device_fault_drain(seed + 1, blocks=blocks)
+        r3 = device_flap_quarantine(seed + 2, blocks=blocks)
     finally:
         sigcache.set_enabled(prev)
-    for r in (r1, r2):
+    for r in (r1, r2, r3):
         if not r.ok:
             raise RuntimeError(
                 f"chaos bench scenario {r.name!r} failed: "
@@ -385,7 +468,10 @@ def bench_chaos(seed: int = 29, blocks: int = 24) -> dict:
         "chaos_recovery_seconds": r1.timing.get("recovery_seconds"),
         "chaos_faulted_blocks_per_sec":
             r2.timing.get("faulted_blocks_per_sec"),
+        "chaos_flap_recovery_seconds":
+            r3.timing.get("flap_recovery_seconds"),
         "partition_heal": r1.to_dict(),
         "device_fault_drain": r2.to_dict(),
+        "device_flap_quarantine": r3.to_dict(),
     }
     return last_chaos

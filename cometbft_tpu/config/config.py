@@ -110,6 +110,9 @@ class StateSyncConfig:
 @dataclass
 class BlockSyncConfig:
     version: str = "v0"
+    # per-request peer timeout (blocksync/pool.py peerTimeout); 0 (or
+    # negative) defers to the module default, keeping old configs valid
+    peer_timeout: float = 0.0
 
 
 @dataclass

@@ -244,24 +244,55 @@ class WAL:
 
     On open, the head chunk is scanned and any torn/corrupt tail from a
     crash mid-write is truncated so new records append after the last
-    whole record (rolled chunks were fsync'd at rotation and need no
-    repair)."""
+    whole record.  When the head is empty or missing, the NEWEST rolled
+    chunk gets the same scan: a crash inside rotate_file (after the
+    rename, before the write that would have populated the new head —
+    or with the renamed file's tail torn because the fsync never hit
+    the platter) leaves the torn record in the rolled chunk instead,
+    and replay() concatenates chunks — so appending fresh records after
+    an unrepaired torn rolled tail would turn a tolerable torn-tail
+    into mid-log corruption that fails every later replay."""
 
     def __init__(self, head_path: str, **group_kwargs):
         self._repair_head(head_path)
         self._group = Group(head_path, **group_kwargs)
 
     @staticmethod
-    def _repair_head(head_path: str) -> None:
+    def _repair_tail_of(path: str) -> None:
         try:
-            with open(head_path, "rb") as f:
+            with open(path, "rb") as f:
                 buf = f.read()
         except FileNotFoundError:
             return
         good = _valid_prefix_len(buf)
         if good < len(buf):
-            with open(head_path, "r+b") as f:
+            with open(path, "r+b") as f:
                 f.truncate(good)
+
+    @classmethod
+    def _repair_head(cls, head_path: str) -> None:
+        import os
+        import re
+
+        cls._repair_tail_of(head_path)
+        try:
+            if os.path.getsize(head_path) > 0:
+                return
+        except OSError:
+            pass
+        # head empty/missing: the last write before the crash landed in
+        # the just-rotated chunk — repair the newest one too
+        d = os.path.dirname(head_path) or "."
+        base = os.path.basename(head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        indexes = [int(m.group(1)) for m in map(pat.match, names) if m]
+        if indexes:
+            cls._repair_tail_of(os.path.join(
+                d, f"{base}.{max(indexes):03d}"))
 
     def write(self, msg) -> None:
         """Buffered write (wal.go Write: internal msgs use WriteSync)."""

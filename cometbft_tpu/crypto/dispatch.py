@@ -66,6 +66,23 @@ _MIN_PARALLEL_CHUNK = 256
 PARSE_INLINE_THRESHOLD = int(os.environ.get(
     "COMETBFT_TPU_PARSE_INLINE_THRESHOLD",
     str(2 * _MIN_PARALLEL_CHUNK)))
+# hung-dispatch watchdog: a device call in flight past this deadline
+# marks the device hung — the window (and everything staged behind it)
+# resolves on the host, the wedged thread is abandoned + replaced, and
+# the device quarantines (crypto/devhealth.py).  The default is
+# deliberately generous: a COLD XLA compile on CPU legitimately runs
+# minutes, and a tripped watchdog on a merely-compiling chip would
+# quarantine every device at first use.  0 disables the watchdog.
+DEFAULT_DISPATCH_DEADLINE_S = float(os.environ.get(
+    "COMETBFT_TPU_DISPATCH_DEADLINE_S", "600"))
+# brownout shape: with EVERY device quarantined the pipeline degrades
+# to pure host fallback — a tighter queue bound and a shrunken window
+# cap (max_window(), consumed by blocksync's collector) keep the
+# consensus hot path latency-bounded instead of livelocked
+BROWNOUT_DEPTH = int(os.environ.get(
+    "COMETBFT_TPU_BROWNOUT_DEPTH", "2"))
+BROWNOUT_MAX_WINDOW = int(os.environ.get(
+    "COMETBFT_TPU_BROWNOUT_MAX_WINDOW", "256"))
 
 
 def parse_and_hash_parallel(pubkeys, msgs, sigs, pool=None,
@@ -140,24 +157,37 @@ class WindowHandle:
     def add_done_callback(self, fn) -> None:
         self._future.add_done_callback(lambda _f: fn(self))
 
-    # internal
+    # internal — idempotent: the watchdog may host-resolve a hung
+    # window while its wedged dispatch thread is still inside the
+    # device call; whichever lands second is a no-op, never an error
     def _resolve(self, ok: bool, verdicts: list, path: str) -> None:
+        if self._future.done():
+            return
         self.path = path
         self.resolved_at = time.monotonic()
-        if self._future.set_running_or_notify_cancel():
-            self._future.set_result((ok, list(verdicts)))
+        try:
+            if self._future.set_running_or_notify_cancel():
+                self._future.set_result((ok, list(verdicts)))
+        except Exception:      # lost the watchdog race mid-set
+            pass
 
     def _fail(self, exc: BaseException) -> None:
+        if self._future.done():
+            return
         self.resolved_at = time.monotonic()
-        if self._future.set_running_or_notify_cancel():
-            self._future.set_exception(exc)
+        try:
+            if self._future.set_running_or_notify_cancel():
+                self._future.set_exception(exc)
+        except Exception:      # lost the watchdog race mid-set
+            pass
 
 
 class _Window:
     __slots__ = ("items", "handle", "threshold", "mode", "pks",
                  "msgs", "parsed", "packed", "verifier", "staged",
                  "device_s", "device_index", "dispatching", "result",
-                 "all_items", "cached")
+                 "all_items", "cached", "dispatch_started",
+                 "abandoned")
 
     def __init__(self, items, handle, threshold):
         # items = the MISSES after the verdict-cache partition (what
@@ -183,6 +213,11 @@ class _Window:
         self.device_index = 0
         self.dispatching = False
         self.result = None
+        # watchdog state: when the dispatch call started, and whether
+        # the watchdog host-resolved this window out from under a
+        # wedged dispatch thread (the thread discards its result)
+        self.dispatch_started = None
+        self.abandoned = False
 
 
 class VerifyPipeline(BaseService):
@@ -191,7 +226,8 @@ class VerifyPipeline(BaseService):
     def __init__(self, depth: int = DEFAULT_DEPTH,
                  host_workers: int | None = None,
                  dispatch_fn=None, name: str = "VerifyPipeline",
-                 devices=None):
+                 devices=None, health=None,
+                 dispatch_deadline_s: float | None = None):
         super().__init__(name)
         self.depth = max(1, depth)
         self.host_workers = (host_workers if host_workers is not None
@@ -217,6 +253,18 @@ class VerifyPipeline(BaseService):
                 devices = None
         self.devices = list(devices) if devices is not None \
             and len(devices) > 1 else None
+        # device health circuit breaker (crypto/devhealth.py): the
+        # dispatch rotation skips quarantined devices, faults feed the
+        # state machine, and recovery probes return chips to rotation.
+        # None adopts the process registry (node wiring) or a private
+        # one, so a bare VerifyPipeline() still has the full machinery.
+        from . import devhealth as _devhealth
+
+        self.health = health if health is not None else \
+            (_devhealth.registry() or _devhealth.HealthRegistry())
+        self.dispatch_deadline_s = (
+            dispatch_deadline_s if dispatch_deadline_s is not None
+            else DEFAULT_DISPATCH_DEADLINE_S)
         self._cv = threading.Condition()
         self._windows: list[_Window] = []
         self._slots = threading.BoundedSemaphore(self.depth)
@@ -227,6 +275,17 @@ class VerifyPipeline(BaseService):
         self._stopping = False
         self._faulted = False      # draining after a device error
         self._dev_faulted: set[int] = set()   # per-device drain (mesh)
+        # watchdog plumbing: per-device thread GENERATIONS (a wedged
+        # dispatch thread is abandoned by bumping its device's gen and
+        # spawning a replacement; the old thread sees the stale gen and
+        # discards everything), in-flight probe registrations, the
+        # health-aware round-robin cursor, and brownout latch
+        self._gens: dict[str, int] = {}
+        self._probe_inflight: dict[str, tuple[float, _Window]] = {}
+        self._rr = 0
+        self._brownout = False
+        self._watchdog: threading.Thread | None = None
+        self._wd_wake = threading.Event()
         # per-object timeline override (libs/tracetl.py): lets a harness
         # attribute this pipeline's host_pack/device spans to one node's
         # timeline; None defers to the process seam
@@ -243,6 +302,10 @@ class VerifyPipeline(BaseService):
 
     def on_start(self) -> None:
         self._stopping = False
+        self._gens = {}
+        self._probe_inflight = {}
+        self._wd_wake = threading.Event()
+        self._brownout = self.in_brownout()
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, self.host_workers),
             thread_name_prefix=f"{self._name}-host")
@@ -253,22 +316,29 @@ class VerifyPipeline(BaseService):
         if self.devices is not None:
             self._dev_threads = [
                 threading.Thread(
-                    target=self._mesh_device_loop, args=(i,),
+                    target=self._mesh_device_loop, args=(i, 0),
                     name=f"{self._name}-device-{i}", daemon=True)
                 for i in range(len(self.devices))]
             for th in self._dev_threads:
                 th.start()
         else:
             self._device = threading.Thread(
-                target=self._device_loop, name=f"{self._name}-device",
-                daemon=True)
+                target=self._device_loop, args=(0,),
+                name=f"{self._name}-device", daemon=True)
             self._device.start()
+        if self.dispatch_deadline_s and self.dispatch_deadline_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name=f"{self._name}-watchdog", daemon=True)
+            self._watchdog.start()
 
     def on_stop(self) -> None:
         with self._cv:
             self._stopping = True
             self._cv.notify_all()
-        for th in (self._staging, self._device, *self._dev_threads):
+        self._wd_wake.set()
+        for th in (self._staging, self._device, self._watchdog,
+                   *self._dev_threads):
             if th is not None:
                 th.join(timeout=5)
         if self._pool is not None:
@@ -308,6 +378,58 @@ class VerifyPipeline(BaseService):
         """Windows packed and waiting on the device thread."""
         with self._cv:
             return sum(1 for w in self._windows if w.staged)
+
+    # -- device health / brownout ------------------------------------------
+
+    def _device_keys(self) -> list[str]:
+        if self.devices is not None:
+            return [str(i) for i in range(len(self.devices))]
+        return ["0"]
+
+    def in_brownout(self) -> bool:
+        """True when EVERY device this pipeline dispatches to is
+        quarantined: verdicts still flow (pure host fallback) but the
+        queue bound tightens to BROWNOUT_DEPTH and max_window() asks
+        callers to shrink their windows."""
+        return self.health.all_quarantined(self._device_keys())
+
+    def max_window(self) -> int | None:
+        """Advisory window-size cap for collectors; None = no cap."""
+        return BROWNOUT_MAX_WINDOW if self._brownout else None
+
+    def _check_brownout(self) -> None:
+        """Re-derive the brownout latch from health state; record the
+        edge transitions so the operator sees when the verify plane
+        degraded to host-only and when a probe lifted it."""
+        now_bo = self.in_brownout()
+        with self._cv:
+            was, self._brownout = self._brownout, now_bo
+            if was != now_bo:
+                self._cv.notify_all()
+        if was != now_bo:
+            from ..libs import flightrec
+
+            flightrec.record(flightrec.EV_BROWNOUT, entered=now_bo,
+                             depth=BROWNOUT_DEPTH,
+                             max_window=BROWNOUT_MAX_WINDOW)
+            rec = flightrec.recorder()
+            if rec is not None and now_bo:
+                rec.dump_to_log("verify-plane brownout: every device "
+                                "quarantined, host-only fallback")
+
+    def _pick_device_locked(self) -> int:
+        """Health-aware round-robin over usable devices (called under
+        self._cv at submit).  All quarantined -> plain rotation: the
+        windows stage host-mode anyway and keep per-device queues
+        drained."""
+        if self.devices is None:
+            return 0
+        n = len(self.devices)
+        usable = [i for i in range(n)
+                  if self.health.usable(str(i))] or list(range(n))
+        pick = usable[self._rr % len(usable)]
+        self._rr += 1
+        return pick
 
     def _gauge(self) -> None:
         from ..libs import devprof
@@ -355,10 +477,14 @@ class VerifyPipeline(BaseService):
         if device_index is None:
             if self._faulted:
                 return devprof.IDLE_DRAIN
+            if not self.health.usable("0"):
+                return devprof.IDLE_QUARANTINE
             mine = self._windows
         else:
             if device_index in self._dev_faulted:
                 return devprof.IDLE_DRAIN
+            if not self.health.usable(str(device_index)):
+                return devprof.IDLE_QUARANTINE
             mine = [w for w in self._windows
                     if w.device_index == device_index]
         if any(not w.staged for w in mine):
@@ -415,8 +541,13 @@ class VerifyPipeline(BaseService):
         win.all_items = items
         win.cached = cached
         with self._cv:
-            if self.devices is not None:
-                win.device_index = self.submitted % len(self.devices)
+            # brownout: beyond the depth-K slot bound, hold submitters
+            # to a tighter queue so host-only verify latency stays
+            # bounded instead of piling K windows of backlog
+            while not self._stopping and self._brownout \
+                    and len(self._windows) >= BROWNOUT_DEPTH:
+                self._cv.wait(timeout=0.05)
+            win.device_index = self._pick_device_locked()
             self._windows.append(win)
             self.submitted += 1
             self._cv.notify_all()
@@ -489,6 +620,11 @@ class VerifyPipeline(BaseService):
         device dispatch needs, done while the PREVIOUS window is on
         device."""
         items = win.items
+        if self._brownout:
+            # every device quarantined: skip the device staging work
+            # entirely, the window can only resolve on the host
+            win.mode = "host"
+            return
         provider = os.environ.get("COMETBFT_TPU_PROVIDER", "auto")
         all_ed = all(_key_type(pk) == "ed25519" for pk, _, _ in items)
         if provider == "cpu" or len(items) < max(1, win.threshold):
@@ -555,7 +691,7 @@ class VerifyPipeline(BaseService):
 
     # -- device (ordered dispatch) -------------------------------------
 
-    def _device_loop(self) -> None:
+    def _device_loop(self, gen: int = 0) -> None:
         from ..libs import devprof
 
         dev = "0"
@@ -567,10 +703,21 @@ class VerifyPipeline(BaseService):
             # device's wall-clock exactly
             rec = devprof.recorder()
             cause = devprof.IDLE_NO_WORK
+            probe = False
             with self._cv:
                 while True:
-                    if self._windows and self._windows[0].staged:
+                    if gen != self._gens.get(dev, 0):
+                        # the watchdog abandoned this thread (hung
+                        # dispatch) and a replacement owns the queue
+                        return
+                    if self._probe_due_locked(dev):
+                        probe = True
+                        break
+                    if self._windows and self._windows[0].staged \
+                            and not self._windows[0].abandoned:
                         win = self._windows[0]
+                        win.dispatching = True
+                        win.dispatch_started = time.monotonic()
                         break
                     if self._stopping and not self._windows:
                         return
@@ -585,7 +732,17 @@ class VerifyPipeline(BaseService):
                 # close the residual gap (lock wakeup to dispatch
                 # start) under the last known cause
                 rec.advance(dev, cause)
+            if probe:
+                self._run_probe(dev, None, gen)
+                continue
             self._resolve_window(win)
+            with self._cv:
+                stale = gen != self._gens.get(dev, 0) or win.abandoned
+            if stale:
+                # the watchdog host-resolved this window (and did the
+                # pop/release bookkeeping) while we were wedged in the
+                # device call; everything downstream is not ours
+                return
             if rec is not None:
                 path = win.handle.path
                 if path in ("device", "host"):
@@ -605,7 +762,8 @@ class VerifyPipeline(BaseService):
             self._gauge()
 
     def _compute_verdicts(self, win: _Window, faulted: bool,
-                          device=None, device_index=None):
+                          device=None, device_index=None,
+                          quarantined: bool = False):
         """The path decision + verdict computation shared by the
         single-device loop and the per-device mesh loops; returns
         (ok, verdicts, path)."""
@@ -619,11 +777,30 @@ class VerifyPipeline(BaseService):
             ok, verdicts = self._host_fallback(win)
             self.host_windows += 1
             return ok, verdicts, "host"
+        if quarantined:
+            # circuit breaker open: the staged work is not trusted to
+            # this device — host path, NOT a drain (the pipeline is
+            # healthy, only this chip is benched awaiting a probe)
+            ok, verdicts = self._host_fallback(win)
+            self.host_windows += 1
+            return ok, verdicts, "host"
         try:
             ok, verdicts = self._device_dispatch(win, device=device)
+            if win.abandoned:
+                return ok, verdicts, "device"
             self.device_windows += 1
+            self.health.note_ok(str(device_index)
+                                if device_index is not None else "0")
             return ok, verdicts, "device"
         except Exception as e:
+            if win.abandoned:
+                # a wedged device call erupting AFTER the watchdog
+                # already handled this window: the hang was counted
+                # (note_hang, quarantine) when the thread was
+                # abandoned — feeding this stale error to the health
+                # machine would re-quarantine a chip that may have
+                # since probed back to healthy
+                return False, [False] * len(win.items), "error"
             # device trouble mid-pipeline: drain.  The host
             # path is still correct; the operator must see
             # the fault and the drain in the timeline.
@@ -711,15 +888,22 @@ class VerifyPipeline(BaseService):
                         cache=self._cache_hits(win),
                         **tracetl.ctx_fields(win.handle.ctx)):
                 ok, verdicts, path = self._compute_verdicts(
-                    win, self._faulted)
+                    win, self._faulted,
+                    quarantined=not self.health.usable("0"))
+            if win.abandoned:
+                # the watchdog already host-resolved this window
+                return
             win.device_s = time.monotonic() - t0
             ok, verdicts = self._merge_cache(win, ok, verdicts)
             win.handle._resolve(ok, verdicts, path)
         except BaseException as e:  # pragma: no cover - defensive
+            if win.abandoned:
+                return
             win.handle._fail(e)
             path = "error"
         finally:
-            self._record_flush(win, path, t0)
+            if not win.abandoned:
+                self._record_flush(win, path, t0)
 
     # -- mesh round-robin (one dispatch thread per device) ---------------
 
@@ -730,7 +914,7 @@ class VerifyPipeline(BaseService):
                 return w
         return None
 
-    def _mesh_device_loop(self, idx: int) -> None:
+    def _mesh_device_loop(self, idx: int, gen: int = 0) -> None:
         from ..libs import devprof
         from ..libs import trace as libtrace
         from ..libs import tracetl
@@ -742,11 +926,20 @@ class VerifyPipeline(BaseService):
             # attribute the gap on wake
             rec = devprof.recorder()
             cause = devprof.IDLE_NO_WORK
+            probe = False
             with self._cv:
                 while True:
+                    if gen != self._gens.get(dev, 0):
+                        # abandoned by the watchdog; the replacement
+                        # thread owns this device's queue now
+                        return
+                    if self._probe_due_locked(dev):
+                        probe = True
+                        break
                     win = self._next_for_device(idx)
                     if win is not None:
                         win.dispatching = True
+                        win.dispatch_started = time.monotonic()
                         break
                     if self._stopping and not any(
                             w.device_index == idx and w.result is None
@@ -758,8 +951,12 @@ class VerifyPipeline(BaseService):
                     if rec is not None:
                         rec.advance(dev, cause)
                 faulted = idx in self._dev_faulted
+                quarantined = not self.health.usable(dev)
             if rec is not None:
                 rec.advance(dev, cause)
+            if probe:
+                self._run_probe(dev, self.devices[idx], gen)
+                continue
             t0 = time.monotonic()
             path = "host"
             dev_span = "device_hash" if win.mode == "ed_hash" \
@@ -774,12 +971,20 @@ class VerifyPipeline(BaseService):
                             **tracetl.ctx_fields(win.handle.ctx)):
                     ok, verdicts, path = self._compute_verdicts(
                         win, faulted, device=self.devices[idx],
-                        device_index=idx)
+                        device_index=idx, quarantined=quarantined)
                 win.device_s = time.monotonic() - t0
                 ok, verdicts = self._merge_cache(win, ok, verdicts)
-                win.result = (ok, verdicts, path)
+                with self._cv:
+                    if gen != self._gens.get(dev, 0) or win.abandoned:
+                        # the watchdog resolved this window while we
+                        # were wedged; discard everything
+                        return
+                    win.result = (ok, verdicts, path)
             except BaseException as e:  # pragma: no cover - defensive
-                win.result = (None, e, "error")
+                with self._cv:
+                    if gen != self._gens.get(dev, 0) or win.abandoned:
+                        return
+                    win.result = (None, e, "error")
                 path = "error"
             if rec is not None:
                 if path in ("device", "host"):
@@ -870,6 +1075,242 @@ class VerifyPipeline(BaseService):
         if rec is not None:
             rec.dump_to_log(
                 "pipeline device dispatch failed, draining: %r" % exc)
+        # feed the health state machine: repeated faults inside the
+        # window trip the quarantine circuit breaker and pull this
+        # device out of the dispatch rotation
+        self.health.note_fault(
+            str(device_index) if device_index is not None else "0",
+            reason=type(exc).__name__)
+        self._check_brownout()
+
+    # -- hung-dispatch watchdog ------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Deadline enforcement for in-flight device work: a dispatch
+        (or probe) that outlives dispatch_deadline_s is resolved on the
+        host, its wedged thread abandoned + replaced, and its device
+        quarantined as hung.  The futures contract survives a wedge:
+        no window is ever left unresolved."""
+        deadline = self.dispatch_deadline_s
+        interval = max(0.02, min(1.0, deadline / 4.0))
+        while not self._stopping:
+            self._wd_wake.wait(timeout=interval)
+            if self._stopping:
+                return
+            self._scan_hung()
+
+    def _scan_hung(self) -> None:
+        deadline = self.dispatch_deadline_s
+        now = time.monotonic()
+        hung = None
+        hung_probe = None
+        with self._cv:
+            for w in self._windows:
+                if w.dispatching and not w.abandoned \
+                        and w.result is None \
+                        and not w.handle.done() \
+                        and w.dispatch_started is not None \
+                        and now - w.dispatch_started > deadline:
+                    hung = w
+                    break
+            if hung is None:
+                for d, (t0, _w) in self._probe_inflight.items():
+                    if now - t0 > deadline:
+                        hung_probe = d
+                        break
+        if hung is not None:
+            self._handle_hang(hung, now)
+        elif hung_probe is not None:
+            self._handle_probe_hang(hung_probe, now)
+
+    def _handle_hang(self, win: _Window, now: float) -> None:
+        idx = win.device_index if self.devices is not None else None
+        dev = str(idx) if idx is not None else "0"
+        with self._cv:
+            # re-check under the lock: the wedged thread may have
+            # finished between the scan and here
+            if win.abandoned or win.result is not None \
+                    or win.handle.done() \
+                    or win not in self._windows:
+                return
+            win.abandoned = True
+            waited = now - (win.dispatch_started or now)
+            self.faults += 1
+            if idx is None:
+                self._faulted = True
+            else:
+                self._dev_faulted.add(idx)
+            # abandon the wedged thread: bump its generation (it will
+            # discard its result and exit when the device call ever
+            # returns) and hand the queue to a fresh replacement
+            gen = self._gens.get(dev, 0) + 1
+            self._gens[dev] = gen
+            staged_behind = sum(1 for w in self._windows if w.staged)
+            self._cv.notify_all()
+        self._spawn_dispatch_thread(idx, gen)
+        self.health.note_hang(dev)
+        self._check_brownout()
+        self._record_watchdog(dev, win, waited, staged_behind)
+        # answer the hung window on the host so its future resolves —
+        # the consumer contract survives the wedge
+        ok, verdicts = self._host_fallback(win)
+        ok, verdicts = self._merge_cache(win, ok, verdicts)
+        self.drained_windows += 1
+        if self.devices is None:
+            win.handle._resolve(ok, verdicts, "drain")
+            with self._cv:
+                if self._windows and self._windows[0] is win:
+                    self._windows.pop(0)
+                else:  # pragma: no cover - head is always the hang
+                    try:
+                        self._windows.remove(win)
+                    except ValueError:
+                        pass
+                if not self._windows:
+                    # the hung window was the whole queue: the drain
+                    # ends here, same as _device_loop's post-resolve —
+                    # otherwise the fault latch outlives the outage and
+                    # a probed-healthy chip never gets work again
+                    self._faulted = False
+                self.resolved += 1
+                self._cv.notify_all()
+            self._slots.release()
+            self._record_flush(win, "drain",
+                               win.dispatch_started or now)
+            self._gauge()
+        else:
+            # mesh: park the verdicts on the window and let the
+            # in-order publisher resolve it (submission-order contract)
+            with self._cv:
+                win.result = (ok, verdicts, "drain")
+            self._record_flush(win, "drain",
+                               win.dispatch_started or now)
+            self._publish_resolved(idx)
+
+    def _handle_probe_hang(self, dev: str, now: float) -> None:
+        with self._cv:
+            entry = self._probe_inflight.pop(dev, None)
+            if entry is None:
+                return
+            t0, win = entry
+            waited = now - t0
+            gen = self._gens.get(dev, 0) + 1
+            self._gens[dev] = gen
+        idx = int(dev) if self.devices is not None else None
+        self._spawn_dispatch_thread(idx, gen)
+        self._record_watchdog(dev, win, waited, 0)
+        # a hung probe is a failed probe: stay quarantined, back off
+        self.health.probe_result(dev, "fail")
+        self._check_brownout()
+
+    def _spawn_dispatch_thread(self, idx: int | None,
+                               gen: int) -> None:
+        if idx is None:
+            th = threading.Thread(
+                target=self._device_loop, args=(gen,),
+                name=f"{self._name}-device-r{gen}", daemon=True)
+            self._device = th
+        else:
+            th = threading.Thread(
+                target=self._mesh_device_loop, args=(idx, gen),
+                name=f"{self._name}-device-{idx}-r{gen}", daemon=True)
+            self._dev_threads.append(th)
+        th.start()
+
+    def _record_watchdog(self, dev: str, win: _Window, waited: float,
+                         staged_behind: int) -> None:
+        from ..libs import flightrec
+        from ..libs import metrics as libmetrics
+
+        dm = libmetrics.device_metrics()
+        if dm is not None:
+            dm.watchdog_timeouts.labels(dev).inc()
+        flightrec.record(flightrec.EV_WATCHDOG_TIMEOUT, device=dev,
+                         batch=len(win.items), waited_s=round(waited, 3),
+                         deadline_s=self.dispatch_deadline_s,
+                         staged=staged_behind,
+                         subsystem=win.handle.subsystem)
+        rec = flightrec.recorder()
+        if rec is not None:
+            rec.dump_to_log(
+                "pipeline dispatch hung on device %s (%.1fs > %.1fs "
+                "deadline), host-resolving" %
+                (dev, waited, self.dispatch_deadline_s))
+
+    # -- recovery probes (known-answer batches) --------------------------
+
+    def _probe_due_locked(self, dev: str) -> bool:
+        """Called under self._cv from the dispatch wait loops: True
+        when this quarantined device's probe backoff has elapsed (the
+        health registry flips it to PROBING as a side effect)."""
+        if self._stopping or dev in self._probe_inflight:
+            return False
+        return self.health.due_probe(dev)
+
+    def _run_probe(self, dev: str, device, gen: int) -> None:
+        """Dispatch the known-answer probe batch on a quarantined
+        device.  Expected verdicts (one lane deliberately corrupt)
+        must match EXACTLY — a chip that forges or flips lanes stays
+        benched.  Probe verdicts never touch the verdict cache."""
+        from . import devhealth as _devhealth
+        from ..libs import devprof
+        from ..libs import trace as libtrace
+        from ..libs import tracetl
+
+        if self._stopping:
+            self.health.transition(dev, "quarantined")
+            return
+        win = self._make_probe_window(dev)
+        with self._cv:
+            self._probe_inflight[dev] = (time.monotonic(), win)
+        passed = False
+        try:
+            with libtrace.span("pipeline", "device_probe",
+                               device=dev), \
+                    tracetl.span_for(self, "pipeline", "device_probe",
+                                     device=dev):
+                _ok, verdicts = self._device_dispatch(
+                    win, device=device)
+            passed = [bool(v) for v in verdicts] == \
+                _devhealth.probe_expected()
+        except Exception:
+            passed = False
+        with self._cv:
+            self._probe_inflight.pop(dev, None)
+            stale = gen != self._gens.get(dev, 0)
+        if stale:
+            # the watchdog already failed this probe and replaced us
+            return
+        rec = devprof.recorder()
+        if rec is not None:
+            rec.advance(dev, devprof.BUSY, path="probe")
+        if passed:
+            self.health.probe_result(dev, "ok")
+        else:
+            self.health.probe_result(dev, "fail")
+        self._check_brownout()
+
+    def _make_probe_window(self, dev: str) -> _Window:
+        """Hand-staged known-answer window: bypasses _stage (whose
+        provider/threshold gates would route it to the host — the
+        whole point is to exercise the DEVICE path)."""
+        from . import devhealth as _devhealth
+        from . import ed25519 as ed
+
+        items = list(_devhealth.probe_items())
+        handle = WindowHandle(len(items), "probe", None)
+        win = _Window(items, handle, 1)
+        pks = [_pk_bytes(pk) for pk, _, _ in items]
+        msgs = [m for _, m, _ in items]
+        sigs = [s for _, _, s in items]
+        win.pks = pks
+        win.parsed = ed.parse_and_hash(pks, msgs, sigs)
+        win.packed = ed.pack_rlc(pks, [b""] * len(pks),
+                                 [b""] * len(pks), parsed=win.parsed)
+        win.mode = "ed"
+        win.staged = True
+        win.device_index = int(dev) if self.devices is not None else 0
+        return win
 
 
 # -- process-wide default instance ------------------------------------------

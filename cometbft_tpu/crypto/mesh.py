@@ -52,6 +52,22 @@ def split_spans(n: int, ndev: int) -> list[tuple[int, int]]:
     return spans
 
 
+def _healthy_devices(devices):
+    """Filter the mesh rotation through the process health registry
+    (crypto/devhealth.py): quarantined chips drop out of the split.
+    Falls back to the full list when no registry is installed or when
+    EVERY chip is benched — a split onto quarantined chips is still
+    better than an unannounced behavior change here; the pipeline's
+    brownout path is what actually owns the all-dead case."""
+    from . import devhealth
+
+    reg = devhealth.registry()
+    if reg is None:
+        return devices
+    usable = [d for i, d in enumerate(devices) if reg.usable(str(i))]
+    return usable if usable else devices
+
+
 def _count_dispatch(i: int, n: int = 0) -> None:
     from ..libs import devprof
     from ..libs import metrics as libmetrics
@@ -108,6 +124,9 @@ def maybe_split_verify(pubkeys: list[bytes], parsed,
     devices = sharding.mesh_device_list(None)
     if devices is None:
         return None
+    devices = _healthy_devices(devices)
+    if len(devices) < 2:
+        return None
     verdicts = split_rlc_verify(pubkeys, parsed, devices)
     if verdicts is None:
         return False
@@ -152,6 +171,9 @@ def maybe_split_verify_hash(pubkeys: list[bytes], msgs: list[bytes],
 
     devices = sharding.mesh_device_list(None)
     if devices is None:
+        return None
+    devices = _healthy_devices(devices)
+    if len(devices) < 2:
         return None
     verdicts = split_rlc_verify_hash(pubkeys, msgs, parsed, devices)
     if verdicts is None:
@@ -214,6 +236,9 @@ def maybe_split_secp_verify(pubkeys: list[bytes], msgs: list[bytes],
 
     devices = sharding.mesh_device_list(None)
     if devices is None:
+        return None
+    devices = _healthy_devices(devices)
+    if len(devices) < 2:
         return None
     return split_secp_verify(pubkeys, msgs, sigs, devices)
 

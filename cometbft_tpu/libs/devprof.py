@@ -58,8 +58,9 @@ IDLE_STAGING = "staging"
 IDLE_BACKPRESSURE = "backpressure"
 IDLE_NO_WORK = "no_work"
 IDLE_DRAIN = "drain"
+IDLE_QUARANTINE = "quarantine"
 IDLE_CAUSES = (IDLE_STAGING, IDLE_BACKPRESSURE, IDLE_NO_WORK,
-               IDLE_DRAIN)
+               IDLE_DRAIN, IDLE_QUARANTINE)
 STATES = (BUSY,) + IDLE_CAUSES
 
 COMPILE_FIRST = "first"
@@ -78,7 +79,8 @@ DISPATCH_KINDS = frozenset({
     "secp256k1_persig", "secp256k1_msm", "secp256k1_q_tables",
     "other",
 })
-BUSY_PATHS = frozenset({"device", "host", "cache", "drain", "error"})
+BUSY_PATHS = frozenset({"device", "host", "cache", "drain", "error",
+                        "probe"})
 
 DEFAULT_SAMPLE_CAPACITY = 16384
 DEFAULT_LEDGER_CAPACITY = 512

@@ -55,6 +55,24 @@ EV_DEVICE_HASH_FALLBACK = "device_hash_fallback"  # a window left the
 #                                       exceeded the static SHA-512
 #                                       block bucket) and re-staged
 #                                       through host hashing
+EV_DEVICE_QUARANTINE = "device_quarantine"  # devhealth circuit breaker
+#                                       opened: the device left the
+#                                       dispatch rotation (fault rate,
+#                                       hang, or a failed probe)
+EV_DEVICE_PROBE = "device_probe"     # known-answer probe batch verdict
+#                                       on a quarantined device (result
+#                                       ok -> back in rotation, fail ->
+#                                       backoff doubles)
+EV_WATCHDOG_TIMEOUT = "watchdog_timeout"  # a device dispatch outlived
+#                                       its deadline: window resolved
+#                                       on the host, wedged thread
+#                                       abandoned + replaced, device
+#                                       quarantined
+EV_BROWNOUT = "brownout"             # every device quarantined
+#                                       (entered=True): pure host
+#                                       fallback with bounded depth and
+#                                       shrunken windows; entered=False
+#                                       when a probe returns a chip
 
 
 class FlightRecorder:

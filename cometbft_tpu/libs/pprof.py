@@ -11,6 +11,8 @@ Endpoints (GET):
   /debug/pprof/flightrec  - consensus flight recorder dump
   /debug/pprof/devprof    - device-time accounting dump (occupancy,
                             idle causes, compile ledger)
+  /debug/pprof/devhealth  - device health states (quarantines, probe
+                            history, circuit-breaker backoffs)
 """
 
 from __future__ import annotations
@@ -23,7 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
 _ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec",
-              "tracetl", "devprof")
+              "tracetl", "devprof", "devhealth")
 
 
 def _dump_threads() -> str:
@@ -144,6 +146,13 @@ class PprofServer:
                         self._text("no devprof recorder installed", 404)
                     else:
                         self._text(rec.dump_text())
+                elif name == "devhealth":
+                    from ..crypto import devhealth as _dh
+                    reg = _dh.registry()
+                    if reg is None:
+                        self._text("no health registry installed", 404)
+                    else:
+                        self._text(reg.dump_text())
                 else:
                     self._text("unknown profile", 404)
 

@@ -258,7 +258,10 @@ class Node(BaseService):
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_exec, self.block_store,
             block_sync and not self._statesync_enabled,
-            consensus_reactor=self.consensus_reactor)
+            consensus_reactor=self.consensus_reactor,
+            peer_timeout=(config.blocksync.peer_timeout
+                          if config.blocksync.peer_timeout > 0
+                          else None))
 
         # p2p (node.go createTransport/createSwitch)
         self.node_key = NodeKey.load_or_gen(config.node_key_file())
@@ -348,6 +351,16 @@ class Node(BaseService):
         from ..libs import devprof as libdevprof
         self.devprof_recorder = libdevprof.DevprofRecorder()
         self.consensus_state.devprof = self.devprof_recorder
+
+        # device health circuit breaker (crypto/devhealth.py): always-on
+        # and process-wide — every VerifyPipeline constructed after this
+        # point (and mesh.maybe_split_verify) adopts it, so quarantines
+        # survive pipeline restarts; dumpable via /debug/pprof/devhealth
+        from ..crypto import devhealth as libdevhealth
+        self._owns_device_health = libdevhealth.registry() is None
+        if self._owns_device_health:
+            libdevhealth.set_registry(libdevhealth.HealthRegistry())
+        self.device_health = libdevhealth.registry()
 
         # Prometheus metrics (node.go:868 startPrometheusServer;
         # per-package metrics.go structs)
@@ -492,6 +505,10 @@ class Node(BaseService):
         self.blocksync_reactor.switch_to_blocksync(state)
 
     def on_stop(self) -> None:
+        from ..crypto import devhealth as libdevhealth
+        if self._owns_device_health \
+                and libdevhealth.registry() is self.device_health:
+            libdevhealth.set_registry(None)
         if self.metrics_server is not None:
             # this node owns the process-wide device-metrics,
             # stage-tracer, and flight-recorder seams

@@ -117,7 +117,8 @@ class SimNode:
                  network: SimNetwork, *, priv_validator=None,
                  block_sync: bool = False,
                  consensus_active: bool = False,
-                 seed: int = 0, app=None, dbs=None, wal=None):
+                 seed: int = 0, app=None, dbs=None, wal=None,
+                 peer_timeout: float | None = None):
         self.name = name
         self.genesis = genesis
         self.network = network
@@ -189,7 +190,8 @@ class SimNode:
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_exec, self.block_store, block_sync,
             consensus_reactor=(self.consensus_reactor
-                               if consensus_active else None))
+                               if consensus_active else None),
+            peer_timeout=peer_timeout)
 
         self.node_key = NodeKey(ed25519.PrivKey.generate(
             _seed_bytes(f"node-key-{name}", seed)))

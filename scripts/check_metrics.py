@@ -24,7 +24,15 @@ Checks:
      `rec.event(..., path="...")`) across cometbft_tpu/ appears in the
      devprof.DISPATCH_KINDS / devprof.BUSY_PATHS registries — a new
      kernel cannot ship with its device time pooling unlabeled under
-     "other" on the occupancy dashboards.
+     "other" on the occupancy dashboards.  The same closed-registry
+     rule covers the verify-plane health vocabularies: literal
+     `.transition(dev, "<state>")` states against
+     devhealth.HEALTH_STATES, literal `.probe_result(dev, "<result>")`
+     results against devhealth.PROBE_RESULTS, and literal
+     `rec.advance(dev, "<state>")` occupancy states against
+     devprof.STATES (BUSY + IDLE_CAUSES, which now include the
+     `quarantine` idle cause) — a misspelled state would silently
+     split a gauge series or pool idle time under the wrong cause.
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -40,6 +48,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 METRICS_PY = REPO / "cometbft_tpu" / "libs" / "metrics.py"
 DEVPROF_PY = REPO / "cometbft_tpu" / "libs" / "devprof.py"
+DEVHEALTH_PY = REPO / "cometbft_tpu" / "crypto" / "devhealth.py"
 SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
 REG_METHODS = ("counter", "gauge", "histogram")
 # the reference's own p2p metrics label a camelCase chID; renaming it
@@ -126,6 +135,65 @@ def registered_labels(path: Path | None = None) -> tuple[set, set]:
     return out["DISPATCH_KINDS"], out["BUSY_PATHS"]
 
 
+def registered_health_labels(path: Path | None = None) -> tuple[set, set]:
+    """(HEALTH_STATES, PROBE_RESULTS) parsed out of crypto/devhealth.py
+    — the closed vocabularies behind the device_health_state gauge and
+    the device_probes_total{result} counter.  Same AST-only discipline
+    as registered_labels; Name elements resolve through earlier
+    module-level string constants (HEALTH_HEALTHY = "healthy", ...)."""
+    tree = ast.parse((path or DEVHEALTH_PY).read_text())
+    env: dict[str, str] = {}
+    out = {"HEALTH_STATES": set(), "PROBE_RESULTS": set()}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            env[name] = node.value.value
+        elif name in out and isinstance(node.value, ast.Call):
+            arg = node.value.args[0] if node.value.args else None
+            if isinstance(arg, (ast.Set, ast.Tuple, ast.List)):
+                out[name] = {
+                    env[e.id] if isinstance(e, ast.Name) else e.value
+                    for e in arg.elts
+                    if (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+                    or (isinstance(e, ast.Name) and e.id in env)}
+    return out["HEALTH_STATES"], out["PROBE_RESULTS"]
+
+
+def registered_idle_states(path: Path | None = None) -> set:
+    """BUSY plus IDLE_CAUSES resolved out of libs/devprof.py — the
+    closed vocabulary for the literal `state` positional of
+    rec.advance(device, "<state>").  IDLE_CAUSES is a tuple of Names
+    (IDLE_STAGING, ...), so earlier module-level string constants
+    resolve through a name environment."""
+    tree = ast.parse((path or DEVPROF_PY).read_text())
+    env: dict[str, str] = {}
+    states: set[str] = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            env[name] = node.value.value
+        elif name == "IDLE_CAUSES" and isinstance(
+                node.value, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str):
+                    states.add(e.value)
+                elif isinstance(e, ast.Name) and e.id in env:
+                    states.add(env[e.id])
+    if "BUSY" in env:
+        states.add(env["BUSY"])
+    return states
+
+
 def label_call_sites(root: Path | None = None) -> list[dict]:
     """[{file, lineno, kind, value}] for every literal compile-ledger
     kind (`*.dispatch_scope("<kind>", ...)`) and busy/flush-path label
@@ -157,23 +225,52 @@ def label_call_sites(root: Path | None = None) -> list[dict]:
                                       "lineno": node.lineno,
                                       "kind": "path",
                                       "value": kw.value.value})
+            # health vocabularies ride the same lint: the literal 2nd
+            # positional of transition()/probe_result() and a literal
+            # occupancy state handed to Recorder.advance(device, state)
+            if fn in ("transition", "probe_result", "advance") and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    isinstance(node.args[1].value, str):
+                kind = {"transition": "health_state",
+                        "probe_result": "probe_result",
+                        "advance": "idle_state"}[fn]
+                sites.append({"file": rel, "lineno": node.lineno,
+                              "kind": kind,
+                              "value": node.args[1].value})
     return sites
 
 
 def run_label_checks(root: Path | None = None,
-                     labels_path: Path | None = None) -> list[str]:
-    """Rule 7 findings: every literal kind/path label is registered."""
+                     labels_path: Path | None = None,
+                     health_path: Path | None = None) -> list[str]:
+    """Rule 7 findings: every literal kind/path/state label is
+    registered in its closed vocabulary."""
     kinds, paths = registered_labels(labels_path)
+    states, results = registered_health_labels(health_path)
+    registries = {
+        "dispatch": (kinds, "devprof.DISPATCH_KINDS",
+                     "unregistered kernel time pools under 'other'"),
+        "path": (paths, "devprof.BUSY_PATHS",
+                 "unregistered kernel time pools under 'other'"),
+        "health_state": (states, "devhealth.HEALTH_STATES",
+                         "a misspelled state splits the "
+                         "device_health_state gauge series"),
+        "probe_result": (results, "devhealth.PROBE_RESULTS",
+                         "a misspelled result splits the "
+                         "device_probes_total counter series"),
+        "idle_state": (registered_idle_states(labels_path),
+                       "devprof.STATES",
+                       "an unregistered state pools occupancy time "
+                       "under the wrong cause"),
+    }
     findings = []
     for s in label_call_sites(root):
-        registry, name = ((kinds, "devprof.DISPATCH_KINDS")
-                          if s["kind"] == "dispatch"
-                          else (paths, "devprof.BUSY_PATHS"))
+        registry, name, why = registries[s["kind"]]
         if s["value"] not in registry:
             findings.append(
                 f"{s['file']}:{s['lineno']}: {s['kind']} label "
-                f"{s['value']!r} is not registered in {name} — "
-                "unregistered kernel time pools under 'other'")
+                f"{s['value']!r} is not registered in {name} — {why}")
     return findings
 
 

@@ -34,7 +34,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # metrics where smaller is the improvement.  NOTE
 # verdict_cache_hit_rate stays in the default higher-is-better set: a
 # hit-rate drop means commits started re-verifying signatures.
-LOWER_IS_BETTER = {"chaos_recovery_seconds", "commit_splice_ms"}
+LOWER_IS_BETTER = {"chaos_recovery_seconds",
+                   "chaos_flap_recovery_seconds", "commit_splice_ms"}
 # non-metric extras (configs, notes, lists) are skipped by the numeric
 # filter; these numerics are ratios/counters, not rates to gate on.
 # critical_path_device_share moved here when the signature-verdict

@@ -166,6 +166,57 @@ class TestChaosSmoke:
         assert r.fingerprint["heights"]["syncer"] == 16
 
 
+class TestDeviceHealthScenarios:
+    """Tentpole acceptance: hung dispatch, flapping chip, and
+    every-chip-dead brownout — all must reach the goal height with
+    zero invariant violations."""
+
+    def test_hang_watchdog_detects_and_recovers(self):
+        r = run_scenario("device_hang_watchdog", seed=101, blocks=24)
+        assert r.ok, r.violations
+        assert r.fingerprint["heights"]["syncer"] == 24
+        # the hang really wedged a dispatch and the watchdog caught it
+        assert r.timing["device"]["syncer"]["faults_fired"] >= 1
+        dh = r.timing["device_health"]["syncer"]
+        assert sum(s["quarantines"] for s in dh.values()) >= 1
+        # the probe cycle brought the chip back
+        assert any(s["recovery_seconds"] for s in dh.values())
+
+    def test_flap_quarantines_once_and_probe_gates_return(self):
+        r = run_scenario("device_flap_quarantine", seed=103, blocks=24)
+        assert r.ok, r.violations
+        assert r.fingerprint["heights"]["syncer"] == 24
+        dh = r.timing["device_health"]["syncer"]
+        flapped = dh["0"]
+        # ONE quarantine cycle — no quarantine/resume thrash while
+        # the flap burst lasted
+        assert flapped["quarantines"] == 1
+        # the burst outlived at least one probe, so the chip returned
+        # only after a LATER probe passed
+        assert flapped["probes_failed"] >= 1
+        assert flapped["probes_ok"] >= 1
+        assert flapped["state"] == "healthy"
+        assert r.timing["flap_recovery_seconds"] > 0
+
+    def test_kill_all_chips_brownout_still_commits(self):
+        r = run_scenario("device_kill_brownout", seed=105, blocks=24)
+        assert r.ok, r.violations
+        # every chip dead forever: the sync still reaches the goal on
+        # the brownout host path, and no probe ever passes
+        assert r.fingerprint["heights"]["syncer"] == 24
+        dh = r.timing["device_health"]["syncer"]
+        assert all(s["state"] == "quarantined" for s in dh.values())
+        assert all(s["probes_ok"] == 0 for s in dh.values())
+        assert sum(s["quarantines"] for s in dh.values()) == len(dh)
+
+    def test_hang_seed_replay_identical_fingerprint(self):
+        a = run_scenario("device_hang_watchdog", seed=107, blocks=16)
+        b = run_scenario("device_hang_watchdog", seed=107, blocks=16)
+        assert a.ok and b.ok
+        assert json.dumps(a.fingerprint, sort_keys=True) == \
+            json.dumps(b.fingerprint, sort_keys=True)
+
+
 class TestSeedReplay:
     def test_fingerprint_bit_deterministic(self):
         """Acceptance: two runs of the same seed produce the identical

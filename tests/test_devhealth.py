@@ -1,0 +1,209 @@
+"""Per-device health state machine (crypto/devhealth.py): the
+circuit-breaker walk HEALTHY -> SUSPECT -> QUARANTINED -> PROBING ->
+HEALTHY, exponential probe backoff, known-answer probe fixtures, the
+metrics/flightrec observability seams, and the process-wide registry
+seam the pipeline and node wiring share.
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import devhealth
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def make_registry(**kw):
+    clock = FakeClock()
+    kw.setdefault("quarantine_after", 3)
+    kw.setdefault("fault_window_s", 10.0)
+    kw.setdefault("probe_backoff_s", 1.0)
+    kw.setdefault("probe_backoff_max_s", 4.0)
+    return devhealth.HealthRegistry(clock=clock, **kw), clock
+
+
+class TestStateWalk:
+    def test_single_fault_is_suspect_not_ejected(self):
+        reg, _ = make_registry()
+        assert reg.note_fault("0") is False
+        assert reg.state("0") == devhealth.HEALTH_SUSPECT
+        assert reg.usable("0")               # still in rotation
+
+    def test_fault_rate_trips_quarantine(self):
+        reg, _ = make_registry()
+        assert not reg.note_fault("0")
+        assert not reg.note_fault("0")
+        assert reg.note_fault("0") is True   # 3rd fault in window
+        assert reg.state("0") == devhealth.HEALTH_QUARANTINED
+        assert not reg.usable("0")
+        assert reg.quarantines("0") == 1
+
+    def test_faults_outside_window_age_out(self):
+        reg, clock = make_registry()
+        reg.note_fault("0")
+        reg.note_fault("0")
+        clock.tick(11.0)                     # both age past the window
+        assert reg.note_fault("0") is False
+        assert reg.state("0") == devhealth.HEALTH_SUSPECT
+
+    def test_note_ok_clears_suspect_after_window_drains(self):
+        reg, clock = make_registry()
+        reg.note_fault("0")
+        reg.note_ok("0")                     # fault still in window
+        assert reg.state("0") == devhealth.HEALTH_SUSPECT
+        clock.tick(11.0)
+        reg.note_ok("0")
+        assert reg.state("0") == devhealth.HEALTH_HEALTHY
+
+    def test_hang_quarantines_immediately(self):
+        reg, _ = make_registry()
+        reg.note_hang("0")
+        assert reg.state("0") == devhealth.HEALTH_QUARANTINED
+        assert reg.quarantines("0") == 1
+
+    def test_all_quarantined_is_the_brownout_predicate(self):
+        reg, _ = make_registry()
+        reg.note_hang("0")
+        assert reg.all_quarantined(["0"])
+        assert not reg.all_quarantined(["0", "1"])   # 1 still healthy
+        reg.note_hang("1")
+        assert reg.all_quarantined(["0", "1"])
+        assert not reg.all_quarantined([])           # vacuous = False
+
+
+class TestProbeCycle:
+    def test_backoff_gates_probe_then_ok_recovers(self):
+        reg, clock = make_registry()
+        reg.note_hang("0")
+        assert not reg.due_probe("0")        # inside the 1.0s backoff
+        clock.tick(1.1)
+        assert reg.due_probe("0")
+        assert reg.state("0") == devhealth.HEALTH_PROBING
+        assert not reg.due_probe("0")        # probe slot already claimed
+        reg.probe_result("0", "ok")
+        assert reg.state("0") == devhealth.HEALTH_HEALTHY
+        assert reg.usable("0")
+        recov = reg.recovery_seconds("0")
+        assert len(recov) == 1
+        assert recov[0] == pytest.approx(1.1)
+
+    def test_probe_fail_doubles_backoff_to_cap(self):
+        reg, clock = make_registry()
+        reg.note_hang("0")
+        backoffs = []
+        for _ in range(4):
+            clock.tick(10.0)
+            assert reg.due_probe("0")
+            reg.probe_result("0", "fail")
+            backoffs.append(reg.snapshot()["0"]["backoff_s"])
+        assert backoffs == [2.0, 4.0, 4.0, 4.0]      # doubles, capped
+        # a failed-probe re-entry is NOT a fresh outage
+        assert reg.quarantines("0") == 1
+        clock.tick(10.0)
+        assert reg.due_probe("0")
+        reg.probe_result("0", "ok")
+        assert reg.state("0") == devhealth.HEALTH_HEALTHY
+        # recovery measured from the ORIGINAL quarantine entry
+        assert reg.recovery_seconds("0")[0] == pytest.approx(50.0)
+        # backoff resets for the next outage
+        assert reg.snapshot()["0"]["backoff_s"] == 1.0
+
+    def test_faults_while_quarantined_are_ignored(self):
+        reg, _ = make_registry()
+        reg.note_hang("0")
+        assert reg.note_fault("0") is False
+        assert reg.quarantines("0") == 1
+
+    def test_unknown_state_and_result_rejected(self):
+        reg, _ = make_registry()
+        with pytest.raises(ValueError):
+            reg.transition("0", "limping")
+        with pytest.raises(ValueError):
+            reg.probe_result("0", "maybe")
+
+
+class TestProbeFixture:
+    def test_probe_items_shape_and_expected_vector(self):
+        items = devhealth.probe_items()
+        want = devhealth.probe_expected()
+        assert len(items) == len(want)
+        assert want.count(False) == 1 and want[-1] is False
+
+    def test_probe_vector_matches_host_verify(self):
+        """The known answers really are the host-verify verdicts — a
+        device that returns anything else (all-true included) fails."""
+        from cometbft_tpu.crypto.batch import safe_verify
+        got = [safe_verify(pk, m, s)
+               for pk, m, s in devhealth.probe_items()]
+        assert got == devhealth.probe_expected()
+
+
+class TestObservability:
+    def test_transitions_drive_metrics_and_flightrec(self):
+        from cometbft_tpu.libs import flightrec
+        from cometbft_tpu.libs import metrics as libmetrics
+        from cometbft_tpu.libs.metrics import DeviceMetrics, Registry
+
+        mreg = Registry("cometbft_tpu")
+        dm = DeviceMetrics(mreg)
+        libmetrics.set_device_metrics(dm)
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        try:
+            reg, clock = make_registry()
+            reg.note_hang("0")
+            clock.tick(1.1)
+            assert reg.due_probe("0")
+            reg.probe_result("0", "fail")
+            clock.tick(2.1)
+            assert reg.due_probe("0")
+            reg.probe_result("0", "ok")
+        finally:
+            libmetrics.set_device_metrics(None)
+            flightrec.set_recorder(None)
+        text = mreg.expose()
+        assert 'cometbft_tpu_device_health_state{device="0"} 0' in text
+        assert ('cometbft_tpu_device_quarantines_total{device="0"} 1'
+                in text)
+        assert ('cometbft_tpu_device_probes_total'
+                '{device="0",result="fail"} 1' in text)
+        assert ('cometbft_tpu_device_probes_total'
+                '{device="0",result="ok"} 1' in text)
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds.count(flightrec.EV_DEVICE_QUARANTINE) == 2
+        assert kinds.count(flightrec.EV_DEVICE_PROBE) == 2
+        quar = [e for e in rec.events()
+                if e["kind"] == flightrec.EV_DEVICE_QUARANTINE]
+        assert quar[0]["fresh"] is True and quar[0]["reason"] == "hang"
+        assert quar[1]["fresh"] is False
+        assert quar[1]["reason"] == "probe_fail"
+
+    def test_snapshot_and_dump_text(self):
+        reg, _ = make_registry()
+        reg.note_fault("1", reason="RuntimeError")
+        snap = reg.snapshot()
+        assert snap["1"]["state"] == "suspect"
+        assert snap["1"]["faults_in_window"] == 1
+        assert snap["1"]["last_reason"] == "RuntimeError"
+        assert "dev 1" in reg.dump_text()
+        assert "suspect" in reg.dump_text()
+
+
+class TestProcessSeam:
+    def test_set_and_clear_registry(self):
+        prev = devhealth.registry()
+        reg = devhealth.HealthRegistry()
+        try:
+            devhealth.set_registry(reg)
+            assert devhealth.registry() is reg
+        finally:
+            devhealth.set_registry(prev)
+        assert devhealth.registry() is prev

@@ -73,7 +73,8 @@ class TestGapAttribution:
             "staging": pytest.approx(0.3),
             "backpressure": pytest.approx(0.25),
             "no_work": pytest.approx(1.0),
-            "drain": pytest.approx(0.75)}
+            "drain": pytest.approx(0.75),
+            "quarantine": pytest.approx(0.0)}
         assert d["dispatches"] == 2
         assert d["occupancy"] == pytest.approx(0.7 / 3.0, abs=1e-6)
         assert_exact_partition(d)

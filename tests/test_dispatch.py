@@ -422,6 +422,203 @@ class TestDeferredVerifyAsync:
             batch.verify_async(pipe, subsystem="light").wait(timeout=60)
 
 
+def judge_staged(win):
+    """Honest stub dispatch: judge from the staged parse results with
+    the host oracle.  Handles both raw-bytes pubkeys (real windows)
+    and PubKey objects (devhealth probe windows)."""
+    out = []
+    for p, (pk, m, s) in zip(win.parsed, win.items):
+        if p is None:
+            out.append(False)
+            continue
+        pub = PubKey(pk) if isinstance(pk, (bytes, bytearray)) else pk
+        out.append(cb.safe_verify(pub, m, s))
+    return all(out) and bool(out), out
+
+
+def wait_until(pred, timeout=10.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class TestHealthWatchdog:
+    def test_hung_dispatch_host_resolved_device_quarantined(self):
+        """A wedged device dispatch: the watchdog must host-resolve
+        the hung window within the deadline (serial-oracle parity, no
+        verdict lost), quarantine the chip, and a known-answer probe
+        must return it to rotation — after which dispatch goes back
+        on-device."""
+        from cometbft_tpu.crypto import devhealth
+        from cometbft_tpu.libs import flightrec
+        from cometbft_tpu.libs import metrics as libmetrics
+        from cometbft_tpu.libs.metrics import DeviceMetrics, Registry
+
+        release = threading.Event()
+        state = {"hung": False}
+
+        def hang_once(win):
+            if not state["hung"]:
+                state["hung"] = True
+                release.wait(timeout=30)
+                raise RuntimeError("released after abandonment")
+            return judge_staged(win)
+
+        health = devhealth.HealthRegistry(
+            quarantine_after=1, probe_backoff_s=0.05,
+            probe_backoff_max_s=0.2)
+        mreg = Registry("cometbft_tpu")
+        libmetrics.set_device_metrics(DeviceMetrics(mreg))
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        fixtures = [make_items(6, seed=w, bad=((1,) if w == 0 else ()))
+                    for w in range(2)]
+        try:
+            sigcache.reset()
+            with vd.VerifyPipeline(depth=3, dispatch_fn=hang_once,
+                                   health=health,
+                                   dispatch_deadline_s=0.3) as pipe:
+                handles = [pipe.submit(list(f), device_threshold=1)
+                           for f in fixtures]
+                results = [h.result(timeout=30) for h in handles]
+                assert handles[0].path == "drain"
+                # probe recovery: the chip returns to rotation...
+                assert wait_until(lambda: health.usable("0"))
+                # ...and a new window dispatches on-device again
+                sigcache.reset()
+                again = pipe.submit(make_items(4, seed=9),
+                                    device_threshold=1)
+                assert again.result(timeout=30)[0] is True
+                assert again.path == "device"
+        finally:
+            release.set()
+            flightrec.set_recorder(None)
+            libmetrics.set_device_metrics(None)
+        for f, (ok, verdicts) in zip(fixtures, results):
+            assert verdicts == serial_verdicts(f)
+        assert results[0][0] is False and results[1][0] is True
+        assert health.quarantines("0") == 1
+        assert len(health.recovery_seconds("0")) == 1
+        kinds = [e["kind"] for e in rec.events()]
+        assert flightrec.EV_WATCHDOG_TIMEOUT in kinds
+        assert flightrec.EV_DEVICE_QUARANTINE in kinds
+        assert flightrec.EV_DEVICE_PROBE in kinds
+        wd = next(e for e in rec.events()
+                  if e["kind"] == flightrec.EV_WATCHDOG_TIMEOUT)
+        assert wd["device"] == "0"
+        assert wd["waited_s"] >= 0.3
+        text = mreg.expose()
+        assert ('cometbft_tpu_device_watchdog_timeouts_total'
+                '{device="0"} 1' in text)
+
+    def test_flap_quarantines_once_not_thrash(self):
+        """A flapping chip whose faults keep coming during probing:
+        ONE quarantine cycle, probes fail while the flap lasts, and
+        the chip returns only after a probe passes."""
+        from cometbft_tpu.crypto import devhealth
+
+        flap = {"remaining": 3}
+
+        def flaky(win):
+            if flap["remaining"] > 0:
+                flap["remaining"] -= 1
+                raise RuntimeError("chip flap")
+            return judge_staged(win)
+
+        health = devhealth.HealthRegistry(
+            quarantine_after=1, probe_backoff_s=0.05,
+            probe_backoff_max_s=0.2)
+        items = make_items(5, seed=21, bad=(2,))
+        sigcache.reset()
+        with vd.VerifyPipeline(depth=2, dispatch_fn=flaky,
+                               health=health) as pipe:
+            ok, verdicts = pipe.submit(list(items),
+                                       device_threshold=1).result(
+                                           timeout=30)
+            assert wait_until(lambda: health.usable("0"))
+        assert verdicts == serial_verdicts(items) and not ok
+        snap = health.snapshot()["0"]
+        assert health.quarantines("0") == 1     # no thrash
+        assert snap["probes_failed"] >= 1       # flap hit the probes
+        assert snap["probes_ok"] == 1
+        assert snap["state"] == "healthy"
+
+    def test_brownout_all_quarantined_still_answers_on_host(self):
+        """Every chip dead (all dispatches fault, probes kept away by
+        a long backoff): the pipeline must enter brownout — host-only
+        verify, shrunken max window — and keep resolving submissions
+        with oracle parity."""
+        from cometbft_tpu.crypto import devhealth
+        from cometbft_tpu.libs import flightrec
+
+        def dead(win):
+            raise RuntimeError("dead chip")
+
+        health = devhealth.HealthRegistry(
+            quarantine_after=1, probe_backoff_s=60.0)
+        rec = flightrec.FlightRecorder()
+        flightrec.set_recorder(rec)
+        fixtures = [make_items(5, seed=w, bad=((3,) if w == 1 else ()))
+                    for w in range(3)]
+        try:
+            sigcache.reset()
+            with vd.VerifyPipeline(depth=2, dispatch_fn=dead,
+                                   health=health) as pipe:
+                assert pipe.max_window() is None
+                first = pipe.submit(list(fixtures[0]),
+                                    device_threshold=1)
+                assert first.result(timeout=30)[1] == \
+                    serial_verdicts(fixtures[0])
+                assert wait_until(pipe.in_brownout)
+                assert pipe.max_window() == vd.BROWNOUT_MAX_WINDOW
+                rest = [pipe.submit(list(f), device_threshold=1)
+                        for f in fixtures[1:]]
+                for f, h in zip(fixtures[1:], rest):
+                    assert h.result(timeout=30)[1] == serial_verdicts(f)
+                    assert h.path == "host"     # never touches a chip
+        finally:
+            flightrec.set_recorder(None)
+        brown = [e for e in rec.events()
+                 if e["kind"] == flightrec.EV_BROWNOUT]
+        assert brown and brown[0]["entered"] is True
+        assert brown[0]["max_window"] == vd.BROWNOUT_MAX_WINDOW
+
+    def test_mesh_quarantine_skips_chip_and_recovers(self):
+        """Two-chip mesh, chip 0 flaps: its windows drain, the
+        round-robin routes follow-on traffic to chip 1 (which never
+        faults), and chip 0 rejoins after a probe passes."""
+        from cometbft_tpu.crypto import devhealth
+
+        flap = {"remaining": 2}
+
+        def flaky_dev0(win):
+            if win.device_index == 0 and flap["remaining"] > 0:
+                flap["remaining"] -= 1
+                raise RuntimeError("dev0 flap")
+            return judge_staged(win)
+
+        health = devhealth.HealthRegistry(
+            quarantine_after=1, probe_backoff_s=0.05,
+            probe_backoff_max_s=0.2)
+        fixtures = [make_items(4, seed=w, bad=((0,) if w == 2 else ()))
+                    for w in range(4)]
+        sigcache.reset()
+        with vd.VerifyPipeline(depth=4, dispatch_fn=flaky_dev0,
+                               devices=[0, 1], health=health) as pipe:
+            handles = [pipe.submit(list(f), device_threshold=1)
+                       for f in fixtures]
+            results = [h.result(timeout=30) for h in handles]
+            assert wait_until(lambda: health.usable("0"))
+        for f, (ok, verdicts) in zip(fixtures, results):
+            assert verdicts == serial_verdicts(f)
+        assert health.quarantines("0") == 1
+        assert health.quarantines("1") == 0
+        assert health.state("1") == "healthy"
+
+
 class TestMixedBatchConcurrency:
     def test_mixed_verdicts_merge_in_order(self):
         """The concurrent per-keytype dispatch must preserve the

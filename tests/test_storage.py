@@ -365,6 +365,77 @@ def test_wal_append_after_torn_tail(tmp_path):
     wal2.close()
 
 
+def _record_boundaries(buf):
+    """Byte offsets of whole-record boundaries in a WAL chunk."""
+    import struct
+    offs = [0]
+    pos = 0
+    while pos + 8 <= len(buf):
+        _, length = struct.unpack_from(">II", buf, pos)
+        pos += 8 + length
+        offs.append(pos)
+    return offs
+
+
+def test_wal_torn_tail_every_byte_offset(tmp_path):
+    """Crash-mid-write sweep: the head chunk cut at EVERY byte offset
+    inside the final record must repair on reopen — replay yields the
+    whole records, and a fresh append + replay works cleanly."""
+    pristine_path = str(tmp_path / "pristine")
+    wal = WAL(pristine_path)
+    wal.write_sync(EndHeightMessage(5))
+    wal.write_sync(MsgInfo("peer-z", b"\xab" * 24))
+    wal.close()
+    pristine = open(pristine_path, "rb").read()
+    first, full = _record_boundaries(pristine)[1:3]
+    assert full == len(pristine)
+    for cut in range(first, full):
+        path = str(tmp_path / "wal")
+        with open(path, "wb") as f:
+            f.write(pristine[:cut])
+        wal2 = WAL(path)
+        msgs = wal2.replay()
+        assert len(msgs) == 1 and msgs[0].msg.height == 5, cut
+        wal2.write_sync(EndHeightMessage(6))
+        msgs = wal2.replay()
+        assert [m.msg.height for m in msgs] == [5, 6], cut
+        wal2.close()
+        os.remove(path)
+
+
+def test_wal_torn_tail_after_rotation_every_byte_offset(tmp_path):
+    """The rotation-boundary twin: a crash inside rotate_file leaves an
+    EMPTY head and the torn final record in the just-rotated chunk.
+    Reopen must repair the ROLLED chunk's tail at every cut offset so
+    replay spans the boundary and appends land cleanly in the head."""
+    wal = WAL(str(tmp_path / "pristine"), head_size_limit=1)
+    wal.write_sync(EndHeightMessage(3))
+    wal.write_sync(MsgInfo("peer-r", b"\xcd" * 24))
+    wal.maybe_rotate()          # both records roll into pristine.000
+    wal.flush_and_sync()
+    assert wal._group.max_index() > 0
+    wal.close()
+    chunk = open(str(tmp_path / "pristine.000"), "rb").read()
+    first, full = _record_boundaries(chunk)[1:3]
+    assert full == len(chunk)
+    for cut in range(first, full):
+        head = str(tmp_path / "wal")
+        open(head, "wb").close()            # crash left the head empty
+        with open(str(tmp_path / "wal.000"), "wb") as f:
+            f.write(chunk[:cut])
+        wal2 = WAL(head, head_size_limit=1)
+        msgs = wal2.replay()
+        assert len(msgs) == 1 and msgs[0].msg.height == 3, cut
+        wal2.write_sync(EndHeightMessage(4))
+        msgs = wal2.replay()
+        assert [m.msg.height for m in msgs] == [3, 4], cut
+        found, after = wal2.search_for_end_height(3)
+        assert found and len(after) == 1, cut
+        wal2.close()
+        os.remove(head)
+        os.remove(str(tmp_path / "wal.000"))
+
+
 def test_wal_search_spans_rotated_chunks(tmp_path):
     path = str(tmp_path / "wal")
     wal = WAL(path, head_size_limit=128)

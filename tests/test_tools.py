@@ -361,6 +361,46 @@ class TestLabelRegistryLint:
         assert any("bogus_kind" in f for f in findings)
         assert any("bogus_path" in f for f in findings)
 
+    def test_health_registries_parse_nonempty(self):
+        """Rule 7 extension: the devhealth HEALTH_STATES /
+        PROBE_RESULTS registries and the devprof idle-state set
+        (busy + idle causes, quarantine included) parse out of the
+        source."""
+        mod = TestCheckMetrics._load()
+        states, results = mod.registered_health_labels()
+        assert states == {"healthy", "suspect", "quarantined",
+                          "probing"}
+        assert results == {"ok", "fail"}
+        idle = mod.registered_idle_states()
+        assert {"busy", "staging", "backpressure", "no_work",
+                "drain", "quarantine"} <= idle
+
+    def test_lint_flags_unregistered_health_labels(self, tmp_path):
+        """A misspelled literal in transition()/probe_result()/
+        advance() splits a metric series silently — the lint must
+        flag each, and pass the registered spellings."""
+        mod = TestCheckMetrics._load()
+        bad = tmp_path / "h.py"
+        bad.write_text(
+            "def f(health, rec, d, now):\n"
+            "    health.transition(d, 'limping')\n"
+            "    health.transition(d, 'quarantined')\n"
+            "    health.probe_result(d, 'maybe')\n"
+            "    rec.advance(d, 'bogus_idle')\n"
+            "    rec.advance(d, 'quarantine')\n")
+        sites = mod.label_call_sites(tmp_path)
+        assert {(s["kind"], s["value"]) for s in sites} == {
+            ("health_state", "limping"),
+            ("health_state", "quarantined"),
+            ("probe_result", "maybe"),
+            ("idle_state", "bogus_idle"),
+            ("idle_state", "quarantine")}
+        findings = mod.run_label_checks(root=tmp_path)
+        assert len(findings) == 3
+        assert any("limping" in f for f in findings)
+        assert any("maybe" in f for f in findings)
+        assert any("bogus_idle" in f for f in findings)
+
 
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
@@ -509,6 +549,27 @@ class TestPerfGate:
         assert all("host_bound_fraction" not in m for _, m in traj)
         assert all("device_occupancy_fraction" in m for _, m in traj)
         assert mod.main(["--root", str(tmp_path), "--check-only"]) == 0
+
+    def test_flap_recovery_gates_lower_is_better(self):
+        """chaos_flap_recovery_seconds (bench_chaos: quarantine-entry
+        to probe-pass wall time on the flapped chip) gates
+        lower-is-better — recovery getting SLOWER is the regression."""
+        mod = self._load()
+        assert "chaos_flap_recovery_seconds" in mod.LOWER_IS_BETTER
+        history = [{"headline": 100.0,
+                    "chaos_flap_recovery_seconds": 0.8}
+                   for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "chaos_flap_recovery_seconds": 1.5},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["chaos_flap_recovery_seconds"]["status"] == \
+            "regressed"
+        ok = mod.gate({"headline": 100.0,
+                       "chaos_flap_recovery_seconds": 0.4},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
 
     def test_usage_errors_exit_2(self, tmp_path):
         import json
