@@ -18,6 +18,7 @@ import socket
 import threading
 from collections import deque
 
+from ..libs import lockrank
 from ..libs import protowire as pw
 from . import types as at
 from .application import Application
@@ -36,7 +37,7 @@ class ReqRes:
         self.response = None
         self._done = threading.Event()
         self._cb = None
-        self._lock = threading.Lock()
+        self._lock = lockrank.RankedLock("abci.reqres")
 
     def set_callback(self, cb) -> None:
         """cb(response); fires immediately if already done."""
@@ -142,7 +143,7 @@ class LocalClient(ABCIClient):
     def __init__(self, app: Application,
                  shared_lock: threading.Lock | None = None):
         self._app = app
-        self._lock = shared_lock or threading.Lock()
+        self._lock = shared_lock or lockrank.RankedLock("abci.client")
 
     def _do(self, method: str, req):
         if method == "echo":
@@ -164,9 +165,9 @@ class SocketClient(ABCIClient):
         self._addr = addr
         self._timeout = timeout
         self._sock: socket.socket | None = None
-        self._wlock = threading.Lock()
+        self._wlock = lockrank.RankedLock("abci.client_write")
         self._pending: deque[ReqRes] = deque()
-        self._plock = threading.Lock()
+        self._plock = lockrank.RankedLock("abci.client_pending")
         self._reader: threading.Thread | None = None
         self._err: Exception | None = None
         self._stopped = False

@@ -10,7 +10,7 @@ hand-written wire codecs (abci/types.py to_proto/from_proto) as the
 
 from __future__ import annotations
 
-import threading
+from ..libs import lockrank
 from concurrent import futures
 
 from . import types as at
@@ -110,7 +110,7 @@ class GRPCClient(ABCIClient):
         self.timeout = timeout
         self._channel = None
         self._calls = {}
-        self._lock = threading.Lock()
+        self._lock = lockrank.RankedLock("abci.grpc")
 
     def start(self) -> None:
         import grpc

@@ -13,6 +13,8 @@ import os
 import socket
 import threading
 
+
+from ..libs import lockrank
 from ..libs import protowire as pw
 from . import types as at
 from .application import Application
@@ -22,7 +24,7 @@ class SocketServer:
     def __init__(self, addr: str, app: Application):
         self.addr = addr
         self._app = app
-        self._app_lock = threading.Lock()
+        self._app_lock = lockrank.RankedLock("abci.server_app")
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._stopped = False

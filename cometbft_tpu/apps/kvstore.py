@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import base64
 import json
-import threading
+
+from ..libs import lockrank
 
 from ..abci import types as at
 from ..abci.application import BaseApplication
@@ -36,7 +37,7 @@ class KVStoreApplication(BaseApplication):
         many to retain.  keep * interval is the serving WINDOW — a
         statesyncing peer must fetch all chunks before the chain
         advances past it, so fast chains want interval > 1."""
-        self._lock = threading.RLock()
+        self._lock = lockrank.RankedRLock("apps.kvstore")
         self.kv: dict[str, str] = {}
         self.height = 0
         self.app_hash = b"\x00" * 8

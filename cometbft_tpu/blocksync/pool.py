@@ -13,6 +13,8 @@ import random
 import threading
 import time
 
+from ..libs import lockrank
+
 from ..libs.service import BaseService
 
 REQUEST_INTERVAL = 0.01          # pool.go requestInterval (10ms)
@@ -76,7 +78,7 @@ class BlockPool(BaseService):
         (PEER_TIMEOUT / RETRY_JITTER) at use time, the late binding
         the simnet tuner and tests monkeypatch."""
         super().__init__("BlockPool")
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("blocksync.pool")
         self.start_height = start_height
         self.height = start_height       # next height to sync
         self.peer_timeout = peer_timeout

@@ -24,6 +24,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ..libs import lockrank
+
 from ..consensus.replay import ErrWALMissingEndHeight, catchup_replay
 from ..consensus.wal import WAL, DataCorruptionError
 from ..crypto.dispatch import VerifyPipeline
@@ -61,7 +63,7 @@ class DeviceFaultController:
     MODES = ("drain", "forge", "hang", "kill")
 
     def __init__(self):
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("chaos.cluster")
         self._armed = 0
         self.mode = "drain"
         self.device: int | None = None

@@ -14,6 +14,8 @@ from __future__ import annotations
 import threading
 import time
 
+
+from ..libs import lockrank
 from ..libs import tracetl
 from ..libs.bits import BitArray
 from ..p2p.base_reactor import Envelope, Reactor
@@ -41,7 +43,7 @@ class PeerState:
 
     def __init__(self, peer):
         self.peer = peer
-        self.mtx = threading.RLock()
+        self.mtx = lockrank.RankedRLock("consensus.peerstate")
         # PeerRoundState (internal/consensus/types/peer_round_state.go)
         self.height = 0
         self.round = -1

@@ -23,6 +23,7 @@ _log = logging.getLogger(__name__)
 
 from ..crypto import sigcache
 from ..libs import flightrec
+from ..libs import lockrank
 from ..libs import trace as libtrace
 from ..libs import tracetl
 from ..libs.fail import fail_point
@@ -156,7 +157,7 @@ class ConsensusState(BaseService):
         self.triggered_timeout_precommit = False
 
         self.state = None  # sm.State
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("consensus.state")
 
         # restart: rebuild last_commit from the stored seen commit BEFORE
         # update_to_state asserts on it (state.go NewState ordering)

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import threading
 
+from ..libs import lockrank
+
 from ..libs.service import BaseService
 from .wal import TimeoutInfo
 
@@ -24,7 +26,7 @@ class TimeoutTicker(BaseService):
         """tock: callable receiving the fired TimeoutInfo."""
         super().__init__("TimeoutTicker")
         self._tock = tock
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("consensus.ticker")
         self._pending: TimeoutInfo | None = None
         self._timer: threading.Timer | None = None
         # clock-skew multiplier on every scheduled duration: 1.0 is an

@@ -19,7 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+from ..libs import lockrank
 from dataclasses import dataclass
 
 from .hash import sum_sha256
@@ -48,7 +48,7 @@ _NATIVE_DIR = os.path.join(
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libbls12381.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = lockrank.RankedLock("bls12381.lib")
 
 
 def _load():

@@ -48,8 +48,9 @@ DISPATCH_KINDS.
 from __future__ import annotations
 
 import os
-import threading
 import time
+
+from ..libs import lockrank
 
 HEALTH_HEALTHY = "healthy"
 HEALTH_SUSPECT = "suspect"
@@ -127,7 +128,7 @@ class HealthRegistry:
         self._clock = clock
         # RLock: the note_*/probe_result entry points hold it while
         # funneling through transition()
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("devhealth.registry")
         self._recs: dict[str, _DeviceRecord] = {}
 
     def _rec(self, device: str) -> _DeviceRecord:

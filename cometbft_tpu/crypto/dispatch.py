@@ -44,6 +44,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from ..libs import lockrank
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..libs.service import BaseService
@@ -140,7 +141,11 @@ class WindowHandle:
                  "submitted_at", "resolved_at")
 
     def __init__(self, n: int, subsystem: str, ctx):
-        self._future: Future = Future()
+        # TrackedFuture is the sanitizer seam: a window future that
+        # gets garbage-collected carrying an unretrieved exception is
+        # a swallowed verify failure, and the leak fixture fails the
+        # test that dropped it (libs/lockrank.py)
+        self._future: Future = lockrank.TrackedFuture()
         self.ctx = ctx
         self.subsystem = subsystem
         self.path: str | None = None
@@ -265,7 +270,7 @@ class VerifyPipeline(BaseService):
         self.dispatch_deadline_s = (
             dispatch_deadline_s if dispatch_deadline_s is not None
             else DEFAULT_DISPATCH_DEADLINE_S)
-        self._cv = threading.Condition()
+        self._cv = lockrank.RankedCondition(name="dispatch.cv")
         self._windows: list[_Window] = []
         self._slots = threading.BoundedSemaphore(self.depth)
         self._pool: ThreadPoolExecutor | None = None
@@ -1316,7 +1321,7 @@ class VerifyPipeline(BaseService):
 # -- process-wide default instance ------------------------------------------
 
 _default: VerifyPipeline | None = None
-_default_lock = threading.Lock()
+_default_lock = lockrank.RankedLock("dispatch.default")
 
 
 def default_pipeline() -> VerifyPipeline:

@@ -18,6 +18,7 @@ import numpy as np
 from functools import lru_cache
 
 from . import ed25519_ref as ref
+from ..libs import lockrank
 from .hash import sum_sha256
 
 KEY_TYPE = "ed25519"
@@ -630,7 +631,6 @@ class ATableCache:
 
     def __init__(self, capacity: int = 128, max_bytes: int | None = None):
         import collections
-        import threading
 
         self._cap = capacity
         self._max_bytes = (max_bytes if max_bytes is not None else
@@ -640,7 +640,7 @@ class ATableCache:
         self._entries = collections.OrderedDict()   # key -> (entry, nbytes)
         self._bytes = 0
         self._seen: collections.OrderedDict = collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockrank.RankedLock("ed25519.atable")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

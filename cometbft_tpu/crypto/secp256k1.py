@@ -22,6 +22,7 @@ import os
 from dataclasses import dataclass
 
 from .hash import sum_sha256
+from ..libs import lockrank
 
 KEY_TYPE = "secp256k1"
 PRIVKEY_SIZE = 32
@@ -505,7 +506,6 @@ class QTableCache:
 
     def __init__(self, max_bytes: int | None = None):
         import collections
-        import threading
 
         self._max_bytes = (max_bytes if max_bytes is not None else
                            int(os.environ.get(
@@ -513,7 +513,7 @@ class QTableCache:
                                str(128 << 20))))
         self._entries = collections.OrderedDict()  # key -> (entry, nbytes)
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = lockrank.RankedLock("secp256k1.qtable")
         self.hits = 0
         self.misses = 0
         self.evictions = 0

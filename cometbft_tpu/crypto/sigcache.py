@@ -40,6 +40,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+from ..libs import lockrank
 from collections import OrderedDict
 
 DEFAULT_CAPACITY = int(os.environ.get(
@@ -118,7 +119,8 @@ class SigVerdictCache:
         self.stripes = stripes
         # ceil-divide so stripes * per_stripe >= capacity
         self._per_stripe = -(-self.capacity // stripes)
-        self._locks = [threading.Lock() for _ in range(stripes)]
+        self._locks = [lockrank.RankedLock("sigcache.stripe")
+                       for _ in range(stripes)]
         self._maps: list[OrderedDict] = [
             OrderedDict() for _ in range(stripes)]
         self.hits = 0
@@ -187,7 +189,7 @@ class SigVerdictCache:
 # -- process-wide default instance -------------------------------------------
 
 _cache: SigVerdictCache | None = None
-_cache_lock = threading.Lock()
+_cache_lock = lockrank.RankedLock("sigcache.global")
 # tri-state runtime override: None defers to COMETBFT_TPU_SIGCACHE
 # (default on); the A/B bench arms and the parity tests flip this
 _enabled_override: bool | None = None

@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from ..libs import lockrank
 from concurrent.futures import Future
 
 from ..libs.service import BaseService
@@ -64,7 +65,7 @@ class StreamingVerifier(BaseService):
         # in-flight dedupe: triple -> the future already queued for it,
         # so two peers flooding the same vote share one batch slot
         self._inflight: dict[tuple, Future] = {}
-        self._cv = threading.Condition()
+        self._cv = lockrank.RankedCondition(name="votestream.cv")
         self._thread: threading.Thread | None = None
         self._stopping = False
         self.flushes = 0
@@ -159,7 +160,7 @@ class StreamingVerifier(BaseService):
           returned, one device verification serves both."""
         from . import sigcache
 
-        fut: Future = Future()
+        fut: Future = lockrank.TrackedFuture()
         if sigcache.enabled():
             v = sigcache.get(pubkey, msg, sig, key_type="ed25519",
                              label="consensus")
@@ -386,7 +387,7 @@ def _host_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
 # -- process-wide default instance ------------------------------------------
 
 _default: StreamingVerifier | None = None
-_default_lock = threading.Lock()
+_default_lock = lockrank.RankedLock("votestream.default")
 
 
 def default_verifier() -> StreamingVerifier:

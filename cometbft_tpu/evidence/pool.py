@@ -9,8 +9,9 @@ blocks.
 
 from __future__ import annotations
 
-import threading
 
+
+from ..libs import lockrank
 from ..libs import protowire as pw
 from ..types.evidence import (
     DuplicateVoteEvidence, evidence_from_proto_wrapped,
@@ -41,7 +42,7 @@ class EvidencePool:
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("evidence.pool")
         self.state = state_store.load()
         # votes reported by consensus before their height is committed
         self._consensus_buffer: list = []

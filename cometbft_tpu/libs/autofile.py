@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import os
 import re
-import threading
+
+from . import lockrank
 
 
 class Group:
@@ -21,7 +22,7 @@ class Group:
         self.head_path = head_path
         self.head_size_limit = head_size_limit
         self.total_size_limit = total_size_limit
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("autofile")
         os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
         self._head = open(head_path, "ab")
         self._min_index, self._max_index = self._scan_indexes()

@@ -50,8 +50,9 @@ the exported spans.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from . import lockrank
 
 BUSY = "busy"
 IDLE_STAGING = "staging"
@@ -153,7 +154,7 @@ class DevprofRecorder:
         self.sample_capacity = sample_capacity
         self.ledger_capacity = ledger_capacity
         self._clock = clock
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("devprof.ring")
         self._accounts: dict[str, DeviceAccount] = {}
         # counter-track samples: (t, track, value) ring, same
         # recorded/dropped discipline as flightrec

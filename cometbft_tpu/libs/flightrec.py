@@ -26,8 +26,9 @@ and no allocation beyond the caller's field dict.
 from __future__ import annotations
 
 import logging
-import threading
 import time
+
+from . import lockrank
 
 _log = logging.getLogger(__name__)
 
@@ -90,7 +91,7 @@ class FlightRecorder:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
         self._clock = clock
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("flightrec.ring")
         self._ring: list = [None] * capacity
         self._recorded = 0
 

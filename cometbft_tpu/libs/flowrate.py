@@ -7,8 +7,9 @@ under a target rate, used by MConnection's send/recv routines.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from . import lockrank
 
 
 class Monitor:
@@ -16,7 +17,7 @@ class Monitor:
 
     def __init__(self, sample_period: float = 0.1,
                  window: float = 1.0):
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("flowrate")
         self._sample_period = sample_period
         self._window = window
         self._start = time.monotonic()

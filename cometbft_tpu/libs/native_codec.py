@@ -17,7 +17,8 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+
+from . import lockrank
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
@@ -30,7 +31,7 @@ MIN_SIGS = int(os.environ.get("COMETBFT_TPU_NATIVE_CODEC_MIN", "64"))
 
 _lib = None
 _failed = False          # sticky: one bad load/build attempt ends it
-_lib_lock = threading.Lock()
+_lib_lock = lockrank.RankedLock("native_codec.lib")
 
 
 def build() -> bool:

@@ -16,6 +16,7 @@ from __future__ import annotations
 import queue
 import re
 import threading
+from . import lockrank
 from dataclasses import dataclass, field
 
 
@@ -209,7 +210,7 @@ class Server:
     (the reference's non-buffered semantics with client timeout)."""
 
     def __init__(self):
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("pubsub")
         # subscriber -> {query -> Subscription}
         self._subs: dict[str, dict[Query, Subscription]] = {}
 

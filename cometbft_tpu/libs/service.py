@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import threading
 
+from . import lockrank
+
 
 class AlreadyStartedError(RuntimeError):
     pass
@@ -27,7 +29,7 @@ class BaseService:
         self._started = False
         self._stopped = False
         self._quit = threading.Event()
-        self._lifecycle_mtx = threading.Lock()
+        self._lifecycle_mtx = lockrank.RankedLock("service.lifecycle")
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:

@@ -21,8 +21,9 @@ pay one global read and an `is None` test.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from . import lockrank
 
 # canonical stage names for the blocksync ingest pipeline; other
 # subsystems (light) reuse the subset that applies to them
@@ -47,7 +48,7 @@ class StageTracer:
     claim — is provable from the record, not asserted."""
 
     def __init__(self, metrics=None):
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("trace.stage")
         self._totals: dict[tuple[str, str], list] = {}
         self._intervals: list = []      # (sub, stage, t0, t1, fields)
         self.dropped_intervals = 0      # ring overflow, no longer silent

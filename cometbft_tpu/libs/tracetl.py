@@ -43,8 +43,9 @@ multi-node merge meaningful at all.
 from __future__ import annotations
 
 import json
-import threading
 import time
+
+from . import lockrank
 
 DEFAULT_CAPACITY = 65536
 
@@ -104,7 +105,7 @@ class Timeline:
         self.node = node
         self.capacity = capacity
         self._clock = clock
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("tracetl.ring")
         self._ring: list = [None] * capacity
         self._recorded = 0
         self._ctx_seq = 0

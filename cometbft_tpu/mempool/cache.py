@@ -6,7 +6,7 @@ resubmitted transactions.
 
 from __future__ import annotations
 
-import threading
+from ..libs import lockrank
 from collections import OrderedDict
 
 from ..types.block import tx_hash
@@ -18,7 +18,7 @@ class LRUTxCache:
     def __init__(self, size: int):
         self._size = size
         self._map: OrderedDict[bytes, None] = OrderedDict()
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("mempool.cache")
 
     def reset(self) -> None:
         with self._mtx:

@@ -14,6 +14,9 @@ re-checks the rest against the post-commit app state.
 from __future__ import annotations
 
 import threading
+import time
+
+from ..libs import lockrank
 from dataclasses import dataclass, field
 
 from ..abci import types as at
@@ -97,13 +100,13 @@ class CListMempool:
         self._txs_bytes = 0
         self._next_seq = 1
         # updateMtx: exclusive during update/recheck, shared for CheckTx
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("mempool.clist")
         self._txs_available = threading.Event()
         self._notified_txs_available = False
         self._notify_enabled = False
         # shares _mtx so notify (under _mtx) and wait (which reads the
         # tx map) cannot deadlock on two locks taken in opposite order
-        self._change_cond = threading.Condition(self._mtx)
+        self._change_cond = lockrank.RankedCondition(self._mtx)
         # optional MempoolMetrics (libs/metrics.py), assigned by the node
         self.metrics = None
 
@@ -328,11 +331,21 @@ class CListMempool:
     def wait_for_txs(self, after_seq: int, timeout: float | None = None
                      ) -> bool:
         """Block until an entry with seq > after_seq exists (the clist
-        front-wait used by gossip routines)."""
+        front-wait used by gossip routines).
+
+        The wait sits in a predicate loop: a notify for an unrelated
+        change (or a spurious wakeup) must re-check and keep waiting
+        with the REMAINING timeout, not report the raw wait() verdict
+        (check_concurrency rule C2)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._change_cond:
-            if self.entries_after(after_seq):
-                return True
-            return self._change_cond.wait(timeout)
+            while not self.entries_after(after_seq):
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._change_cond.wait(remaining)
+            return True
 
 
 def _proto_tx_overhead(n: int) -> int:

@@ -23,10 +23,12 @@ from __future__ import annotations
 
 import threading
 
+from ..libs import lockrank
+
 _COMPILE_PREFIX = "/jax/core/compile/"
 _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
 
-_mtx = threading.Lock()
+_mtx = lockrank.RankedLock("compile_hook")
 _listener_registered = False
 _ledger = None                      # DevprofRecorder | None
 _tls = threading.local()

@@ -63,7 +63,7 @@ false *reject*, never a false accept.
 from __future__ import annotations
 
 import os
-import threading
+from ..libs import lockrank
 from dataclasses import dataclass
 from typing import Callable
 
@@ -423,7 +423,7 @@ def multiprod_shared_tables(acc, sides):
 # product path does not).  calibrate() lets a bench measure the two
 # coefficients; absent measurements the static model applies.
 
-_COEFF_LOCK = threading.Lock()
+_COEFF_LOCK = lockrank.RankedLock("msm.coeff")
 _COEFFS: dict[str, float] = {}     # "straus"/"bucket" -> ns per lane-op
 
 

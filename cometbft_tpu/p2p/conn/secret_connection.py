@@ -16,7 +16,8 @@ bytes — is what matters; cited for parity, not wire-compat).
 from __future__ import annotations
 
 import struct
-import threading
+
+from ...libs import lockrank
 
 try:
     from cryptography.hazmat.primitives import hashes
@@ -115,8 +116,8 @@ class SecretConnection:
         self._send_nonce = _NonceCounter()
         self._recv_buf = b""
         self._recv_frame_buf = b""
-        self._send_mtx = threading.Lock()
-        self._recv_mtx = threading.Lock()
+        self._send_mtx = lockrank.RankedLock("p2p.conn.send")
+        self._recv_mtx = lockrank.RankedLock("p2p.conn.recv")
         self.remote_pubkey = remote_pubkey
 
     # -- handshake ---------------------------------------------------------

@@ -23,6 +23,8 @@ import random
 import threading
 import time
 
+from ..libs import lockrank
+
 
 class FuzzConfig:
     MODE_DELAY = "delay"
@@ -44,7 +46,7 @@ class FuzzedConnection:
         self.config = config or FuzzConfig()
         self._rand = random.Random(self.config.seed)
         self._start = time.monotonic() + self.config.start_after
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("p2p.fuzz")
 
     def _active(self) -> bool:
         return time.monotonic() >= self._start
@@ -53,15 +55,19 @@ class FuzzedConnection:
         """Returns True if the write should be swallowed."""
         if not self._active():
             return False
+        # draw the fault under the lock, sleep outside it: a delay
+        # held under _mtx would serialize every other writer behind
+        # this connection's fuzz draw (check_concurrency rule C3)
+        delay = 0.0
+        swallow = False
         with self._mtx:
             if self.config.mode == FuzzConfig.MODE_DELAY:
                 delay = self._rand.random() * self.config.max_delay
-                if delay > 0:
-                    time.sleep(delay)
-                return False
-            if self.config.mode == FuzzConfig.MODE_DROP:
-                return self._rand.random() < self.config.prob_drop
-        return False
+            elif self.config.mode == FuzzConfig.MODE_DROP:
+                swallow = self._rand.random() < self.config.prob_drop
+        if delay > 0:
+            time.sleep(delay)
+        return swallow
 
     # -- conn interface ----------------------------------------------------
 

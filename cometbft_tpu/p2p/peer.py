@@ -3,7 +3,8 @@
 
 from __future__ import annotations
 
-import threading
+
+from ..libs import lockrank
 
 from ..libs.service import BaseService
 from .node_info import NodeInfo
@@ -19,7 +20,7 @@ class Peer(BaseService):
         self.persistent = persistent
         self.socket_addr = socket_addr
         self._data: dict = {}
-        self._data_mtx = threading.Lock()
+        self._data_mtx = lockrank.RankedLock("p2p.peer_data")
 
     @property
     def id(self) -> str:
@@ -60,7 +61,7 @@ class PeerSet:
     """Thread-safe peer registry (p2p/peer_set.go)."""
 
     def __init__(self):
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("p2p.peer")
         self._by_id: dict[str, Peer] = {}
 
     def add(self, peer: Peer) -> None:

@@ -13,8 +13,8 @@ import hashlib
 import json
 import os
 import random
-import threading
 import time
+from ...libs import lockrank
 from dataclasses import dataclass, field
 
 NEW_BUCKET_COUNT = 256
@@ -97,7 +97,7 @@ class AddrBook:
     def __init__(self, file_path: str = "", key: bytes | None = None):
         self._path = file_path
         self._key = key or os.urandom(16)    # keyed bucket hashing
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("p2p.addrbook")
         self._rand = random.Random()
         self._by_id: dict[str, KnownAddress] = {}
         self._new: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]

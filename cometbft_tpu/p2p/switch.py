@@ -13,6 +13,8 @@ import random
 import threading
 import time
 
+from ..libs import lockrank
+
 from ..libs.service import BaseService
 from .base_reactor import Envelope, Reactor
 from .conn.connection import ChannelDescriptor, MConnection
@@ -48,7 +50,7 @@ class Switch(BaseService):
         self.conn_wrap = None
         self.reconnecting: set[str] = set()
         self.persistent_peers: set[str] = set()  # addresses 'id@host:port'
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("p2p.switch")
         from concurrent.futures import ThreadPoolExecutor
         self._broadcast_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="sw-bcast")

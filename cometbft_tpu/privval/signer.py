@@ -17,6 +17,8 @@ import socket
 import threading
 import time
 
+
+from ..libs import lockrank
 from ..libs import protowire as pw
 from ..types.vote import Proposal, Vote
 
@@ -137,7 +139,7 @@ class SignerListenerEndpoint:
         self.bound_addr = "%s:%d" % self._listener.getsockname()[:2]
         self._timeout = timeout_read_write
         self._conn: socket.socket | None = None
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("privval.signer")
         self._connected = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="privval-accept", daemon=True)

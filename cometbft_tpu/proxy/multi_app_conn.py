@@ -15,7 +15,7 @@ socket app they are four pipelined connections to the app process.
 
 from __future__ import annotations
 
-import threading
+from ..libs import lockrank
 from typing import Callable
 
 from ..abci.application import Application
@@ -26,7 +26,7 @@ ClientCreator = Callable[[], ABCIClient]
 
 def local_client_creator(app: Application) -> ClientCreator:
     """All connections share one mutex (proxy/client.go NewLocalClientCreator)."""
-    lock = threading.Lock()
+    lock = lockrank.RankedLock("abci.client")
     return lambda: LocalClient(app, shared_lock=lock)
 
 

@@ -20,6 +20,8 @@ import json
 import struct
 import threading
 
+
+from ..libs import lockrank
 from ..libs import pubsub
 from ..types import events as ev
 from . import serialize as ser
@@ -146,7 +148,7 @@ class WSSession:
         self.wfile = wfile
         self.subscriber = f"ws-{remote}"
         self._call = call_fn        # (method, params, id) -> response dict
-        self._lock = threading.Lock()
+        self._lock = lockrank.RankedLock("rpc.websocket")
         self._subs: dict[str, tuple[pubsub.Query, object]] = {}
         self._closed = threading.Event()
 

@@ -36,6 +36,8 @@ import random
 import threading
 import time
 
+from ..libs import lockrank
+
 from ..p2p.transport import ErrRejected, TransportError, parse_addr
 
 _CLOSED = object()          # inbox sentinel: EOF
@@ -85,7 +87,7 @@ class SimNetwork:
 
     def __init__(self, seed: int = 0):
         self.seed = seed
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("simnet.network")
         self._transports: dict[str, "SimTransport"] = {}
         self._default = LinkSpec()
         self._links: dict[frozenset, LinkSpec] = {}
@@ -192,7 +194,7 @@ class _Link:
         self.key_a = key_a
         self.key_b = key_b
         self._rng = network.link_rng(key_a, key_b)
-        self._rng_mtx = threading.Lock()
+        self._rng_mtx = lockrank.RankedLock("simnet.rng")
         self._closed = threading.Event()
         self.end_a = _SimConn(self, key_a, key_b)
         self.end_b = _SimConn(self, key_b, key_a)
@@ -266,7 +268,7 @@ class _SimConn:
         self._inbox: queue.Queue = queue.Queue()
         self._sched: queue.Queue = queue.Queue()
         self._pump_started = False
-        self._pump_mtx = threading.Lock()
+        self._pump_mtx = lockrank.RankedLock("simnet.pump")
         # one-slot (frame, delay) buffer for the link's pairwise
         # reorder fault; written only from this endpoint's sender thread
         self._reorder_hold: tuple | None = None

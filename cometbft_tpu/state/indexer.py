@@ -24,6 +24,8 @@ import json
 import struct
 import threading
 
+
+from ..libs import lockrank
 from ..libs import pubsub
 from ..libs.service import BaseService
 from ..store.kv import KVStore, be64
@@ -39,7 +41,7 @@ class TxIndexer:
 
     def __init__(self, db: KVStore):
         self._db = db
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("state.indexer")
 
     # -- writes ------------------------------------------------------------
 

@@ -11,8 +11,9 @@ attributes tables and the event_attributes view.
 from __future__ import annotations
 
 import sqlite3
-import threading
 import time
+
+from ..libs import lockrank
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS blocks (
@@ -63,7 +64,7 @@ class SQLEventSink:
 
     def __init__(self, path: str, chain_id: str):
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("state.sink")
         self.chain_id = chain_id
         with self._mtx:
             self._conn.executescript(_SCHEMA)

@@ -15,8 +15,9 @@ Key layout (fixed-width big-endian heights, ordered for range prunes):
 
 from __future__ import annotations
 
-import threading
 
+
+from ..libs import lockrank
 from ..libs import protowire as pw
 from ..store.kv import KVStore, be64
 from ..types.params import ConsensusParams
@@ -64,7 +65,7 @@ def _info_parse(raw: bytes) -> tuple[int, bytes | None]:
 class StateStore:
     def __init__(self, db: KVStore):
         self._db = db
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("state.store")
 
     # -- State -------------------------------------------------------------
 

@@ -10,7 +10,7 @@ an implementation detail of Go's GC pressure, not of the protocol.
 
 from __future__ import annotations
 
-import threading
+from ..libs import lockrank
 from dataclasses import dataclass
 
 
@@ -32,8 +32,8 @@ class ChunkQueue:
         self.height = height
         self.format = format
         self.n = n_chunks
-        self._mtx = threading.Lock()
-        self._cv = threading.Condition(self._mtx)
+        self._mtx = lockrank.RankedLock("statesync.chunks")
+        self._cv = lockrank.RankedCondition(self._mtx)
         self._allocated: set[int] = set()
         self._received: dict[int, Chunk] = {}
         self._returned: set[int] = set()   # handed to the applier

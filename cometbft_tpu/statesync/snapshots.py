@@ -9,7 +9,7 @@ can be blacklisted.
 from __future__ import annotations
 
 import random
-import threading
+from ..libs import lockrank
 from dataclasses import dataclass, field
 
 
@@ -28,7 +28,7 @@ class Snapshot:
 
 class SnapshotPool:
     def __init__(self):
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("statesync.snapshots")
         self._snapshots: dict[tuple, Snapshot] = {}
         self._peers: dict[tuple, set[str]] = {}
         self._blacklist_hash: set[bytes] = set()

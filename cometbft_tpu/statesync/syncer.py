@@ -13,6 +13,8 @@ import logging
 import threading
 import time
 
+from ..libs import lockrank
+
 from ..abci import types as at
 from . import messages as msgs
 from .chunks import Chunk, ChunkQueue, ErrDone
@@ -76,7 +78,7 @@ class Syncer:
         self._fetchers = chunk_fetchers
         self._retry_timeout = retry_timeout
         self._chunk_timeout = chunk_timeout
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("statesync.syncer")
         self._chunks: ChunkQueue | None = None
 
     # -- reactor-facing ----------------------------------------------------

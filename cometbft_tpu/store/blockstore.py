@@ -20,9 +20,9 @@ Key layout uses fixed-width big-endian heights so lexicographic KV order
 from __future__ import annotations
 
 import struct
-import threading
 from dataclasses import dataclass, field
 
+from ..libs import lockrank
 from ..libs import protowire as pw
 from ..types.block import Block, BlockID, Commit, Header, PartSetHeader
 from ..types.part_set import Part, PartSet, SerializedBlockCache
@@ -95,7 +95,7 @@ class BlockStore:
 
     def __init__(self, db: KVStore):
         self._db = db
-        self._mtx = threading.RLock()
+        self._mtx = lockrank.RankedRLock("store.blockstore")
         self._base = 0
         self._height = 0
         # encode-once serve-many (types/part_set.SerializedBlockCache):

@@ -18,7 +18,7 @@ from __future__ import annotations
 import bisect
 import sqlite3
 import struct
-import threading
+from ..libs import lockrank
 from typing import Iterator
 
 
@@ -61,7 +61,7 @@ class MemDB(KVStore):
     def __init__(self):
         self._data: dict[bytes, bytes] = {}
         self._keys: list[bytes] = []
-        self._lock = threading.RLock()
+        self._lock = lockrank.RankedRLock("store.kv")
 
     def get(self, key: bytes) -> bytes | None:
         with self._lock:
@@ -112,7 +112,7 @@ class SQLiteDB(KVStore):
 
     def __init__(self, path: str):
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
+        self._lock = lockrank.RankedRLock("store.kv")
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             # FULL: every COMMIT fsyncs the sqlite WAL — the durability the

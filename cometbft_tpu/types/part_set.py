@@ -10,11 +10,11 @@ verified independently.
 from __future__ import annotations
 
 import os
-import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ..crypto import merkle
+from ..libs import lockrank
 from ..libs import protowire as pw
 from .block import PartSetHeader
 
@@ -152,7 +152,7 @@ class SerializedBlockCache:
             capacity = int(os.environ.get(
                 "COMETBFT_TPU_BLOCK_CACHE", str(self.DEFAULT_CAPACITY)))
         self.capacity = max(0, int(capacity))
-        self._mtx = threading.Lock()
+        self._mtx = lockrank.RankedLock("part_set.block_cache")
         # height -> (block_bytes, tuple[part proto bytes, ...])
         self._entries: OrderedDict[int, tuple] = OrderedDict()
         self.hits = 0

@@ -26,6 +26,75 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
+# -- concurrency sanitizer plane (libs/lockrank.py) --------------------------
+# The whole tier-1 suite runs with the lock-rank checker in raise mode
+# and the thread/future-leak fixtures armed.  Opt out (bisecting a
+# sanitizer report from the code under test) with
+# COMETBFT_TPU_LOCKRANK=0 / COMETBFT_TPU_SANITIZERS=0.
+os.environ.setdefault("COMETBFT_TPU_LOCKRANK", "1")
+os.environ.setdefault("COMETBFT_TPU_SANITIZERS", "1")
+
+from cometbft_tpu.libs import lockrank  # noqa: E402
+
+lockrank.enable_from_env()
+_SANITIZERS_ON = os.environ.get("COMETBFT_TPU_SANITIZERS", "0") == "1"
+lockrank.set_sanitizer(_SANITIZERS_ON)
+
+if _SANITIZERS_ON:
+    import sys as _sys
+
+    _prev_unraisable = _sys.unraisablehook
+
+    def _lockrank_unraisable(unraisable, _prev=_prev_unraisable):
+        # a TrackedFuture finalizer must never die silently — surface
+        # it through the same leak list the fixture checks
+        if isinstance(unraisable.object, lockrank.TrackedFuture):
+            lockrank._leaked_futures.append(
+                f"unraisable in TrackedFuture finalizer: "
+                f"{unraisable.exc_value!r}")
+        _prev(unraisable)
+
+    _sys.unraisablehook = _lockrank_unraisable
+
+
+@pytest.fixture(autouse=True)
+def _concurrency_sanitizer():
+    """Fail the test that leaked a non-daemon thread or dropped a
+    failed future (libs/lockrank.py registries).  Also fail on lock-
+    rank violations accumulated in warn mode (raise mode surfaces
+    them at the acquire site instead)."""
+    if not _SANITIZERS_ON:
+        yield
+        return
+    import gc
+    import threading
+
+    baseline = set(threading.enumerate())
+    lockrank.clear_leaked_futures()
+    yield
+    gc.collect()
+    leaked_futs = lockrank.leaked_futures()
+    lockrank.clear_leaked_futures()
+    leaked = lockrank.leaked_threads(baseline, grace_s=1.0)
+    c = lockrank.checker()
+    viols = list(c.violations) if c is not None and c.mode == "warn" \
+        else []
+    if c is not None and c.mode == "warn":
+        c.violations.clear()
+        c._seen.clear()
+    msgs = []
+    if leaked:
+        msgs.append("leaked non-daemon threads: "
+                    + ", ".join(t.name for t in leaked))
+    if leaked_futs:
+        msgs.append("futures dropped with unretrieved exceptions:\n"
+                    + "\n".join(leaked_futs))
+    if viols:
+        msgs.append("lock-rank violations (warn mode):\n"
+                    + "\n".join(viols))
+    if msgs:
+        pytest.fail("concurrency sanitizer: " + "\n".join(msgs))
+
 
 def _rss_kb() -> int:
     with open("/proc/self/status") as f:

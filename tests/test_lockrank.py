@@ -1,0 +1,329 @@
+"""Self-tests for the concurrency sanitizer plane
+(cometbft_tpu/libs/lockrank.py): the seeded MUST-TRIP cases — a
+deliberate rank inversion, a bare-if cv.wait, a leaked non-daemon
+thread, a dropped failed future — plus the disabled-configuration
+no-op-overhead pin.  A sanitizer that cannot catch its own seeded bugs
+is a dashboard lie, same reasoning as the check_metrics rule-3 lint.
+"""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.libs import lockrank
+
+
+@pytest.fixture
+def own_checker():
+    """Run a test under its own checker instance, restoring whatever
+    the session conftest installed afterwards."""
+    prev = lockrank.checker()
+    yield
+    lockrank._checker = prev
+
+
+def _lock(name):
+    return lockrank.RankedLock(name)
+
+
+class TestRankTable:
+    def test_unknown_name_refused_at_construction(self):
+        with pytest.raises(ValueError, match="LOCK_RANKS"):
+            lockrank.RankedLock("made.up.lock")
+
+    def test_table_ranks_unique(self):
+        ranks = list(lockrank.LOCK_RANKS.values())
+        assert len(ranks) == len(set(ranks))
+
+    def test_multi_names_are_all_tabled(self):
+        assert lockrank.MULTI_OK <= set(lockrank.LOCK_RANKS)
+
+
+class TestRankInversion:
+    """Seeded must-trip #1: acquiring against the declared order."""
+
+    def test_inversion_raises_before_blocking(self, own_checker):
+        lockrank.enable("raise")
+        outer = _lock("consensus.ticker")        # rank 40
+        inner = _lock("flightrec.ring")          # rank 500
+        with inner:
+            with pytest.raises(lockrank.LockRankError,
+                               match="rank inversion"):
+                outer.acquire()
+        # nothing stuck: both reacquirable
+        with outer:
+            with inner:
+                pass
+
+    def test_cross_thread_cycle_reports_both_stacks(self, own_checker):
+        lockrank.enable("raise")
+        a = _lock("mempool.cache")               # rank 70
+        b = _lock("sigcache.global")             # rank 450
+        forward_done = threading.Event()
+
+        def forward():
+            with a:
+                with b:                          # records edge a->b
+                    forward_done.set()
+
+        t = threading.Thread(target=forward, daemon=True)
+        t.start()
+        t.join(5)
+        assert forward_done.is_set()
+        with b:
+            with pytest.raises(lockrank.LockRankError) as ei:
+                a.acquire()
+        msg = str(ei.value)
+        assert "opposite order" in msg            # the OTHER stack
+        assert "acquiring stack" in msg           # this one's stack
+        assert "forward" in msg                   # frames, not labels
+
+    def test_warn_mode_records_and_continues(self, own_checker):
+        c = lockrank.enable("warn")
+        a = _lock("mempool.cache")
+        b = _lock("sigcache.global")
+        with b:
+            with a:                               # inverted, no raise
+                pass
+        assert len(c.violations) == 1
+        assert "rank inversion" in c.violations[0]
+        # same site dedupes
+        with b:
+            with a:
+                pass
+        assert len(c.violations) == 1
+
+    def test_reentrant_and_peer_instances_allowed(self, own_checker):
+        lockrank.enable("raise")
+        r = lockrank.RankedRLock("consensus.state")
+        with r:
+            with r:                               # same-instance reentry
+                pass
+        s1 = _lock("sigcache.stripe")
+        s2 = _lock("sigcache.stripe")
+        with s1:
+            with s2:                              # multi peers, equal rank
+                pass
+
+    def test_nonreentrant_self_deadlock_trips(self, own_checker):
+        lockrank.enable("raise")
+        lk = _lock("mempool.cache")
+        with lk:
+            with pytest.raises(lockrank.LockRankError,
+                               match="self-deadlock"):
+                lk.acquire()
+
+    def test_wait_holding_other_lock_trips(self, own_checker):
+        c = lockrank.enable("warn")
+        cv = lockrank.RankedCondition(name="dispatch.cv")
+        other = _lock("devhealth.registry")
+        with other:
+            with cv:
+                cv.wait(timeout=0.01)
+        assert any("cv wait" in v for v in c.violations)
+
+
+class TestStaticRules:
+    """Seeded must-trip #2 (and friends): the AST rules on synthetic
+    sources, via the same loader style test_tools.py uses for
+    check_metrics."""
+
+    @staticmethod
+    def _load():
+        import importlib.util
+        import pathlib
+        path = pathlib.Path(__file__).resolve().parent.parent / \
+            "scripts" / "check_concurrency.py"
+        spec = importlib.util.spec_from_file_location(
+            "check_concurrency", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_bare_if_wait_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "w.py"
+        bad.write_text(
+            "from cometbft_tpu.libs import lockrank\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._cv = lockrank.RankedCondition(name='x')\n"
+            "    def bad(self):\n"
+            "        with self._cv:\n"
+            "            if True:\n"
+            "                self._cv.wait(1.0)\n"
+            "    def good(self):\n"
+            "        with self._cv:\n"
+            "            while True:\n"
+            "                self._cv.wait(1.0)\n")
+        findings = mod.run_checks(root=bad)
+        c2 = [f for f in findings if "[C2]" in f]
+        assert len(c2) == 1 and ":8:" in c2[0]
+
+    def test_raw_primitive_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "r.py"
+        bad.write_text(
+            "import threading\n"
+            "lk = threading.Lock()\n"
+            "rl = threading.RLock()  # conc: raw-ok\n")
+        findings = [f for f in mod.run_checks(root=bad) if "[C1]" in f]
+        assert len(findings) == 1 and ":2:" in findings[0]
+
+    def test_blocking_under_lock_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "b.py"
+        bad.write_text(
+            "import time\n"
+            "from cometbft_tpu.libs import lockrank\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._mtx = lockrank.RankedLock('x')\n"
+            "    def bad(self, fut, q):\n"
+            "        with self._mtx:\n"
+            "            fut.result()\n"
+            "            q.queue.get()\n"
+            "            time.sleep(1)\n"
+            "    def ok(self, fut, parts):\n"
+            "        with self._mtx:\n"
+            "            ','.join(parts)\n"
+            "            fut.result()  # conc: blocking-ok\n")
+        c3 = [f for f in mod.run_checks(root=bad) if "[C3]" in f]
+        assert len(c3) == 3
+        assert all(any(f":{n}:" in finding for finding in c3)
+                   for n in (8, 9, 10))
+
+    def test_nondaemon_thread_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "t.py"
+        bad.write_text(
+            "import threading\n"
+            "class S:\n"
+            "    def a(self):\n"
+            "        self._t = threading.Thread(target=print)\n"
+            "    def b(self):\n"
+            "        self._u = threading.Thread(target=print,\n"
+            "                                   daemon=True)\n"
+            "    def c(self):\n"
+            "        self._v = threading.Timer(1.0, print)\n"
+            "        self._v.daemon = True\n")
+        c4 = [f for f in mod.run_checks(root=bad) if "[C4]" in f]
+        assert len(c4) == 1 and "self._t" in c4[0]
+
+    def test_unregistered_knob_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "k.py"
+        bad.write_text(
+            "import os\n"
+            "a = os.environ.get('COMETBFT_TPU_BOGUS_KNOB', '0')\n"
+            "b = os.environ['SIMNET_CONSENSUS_VALS']\n"
+            "c = os.getenv('COMETBFT_TPU_SIGCACHE')\n")
+        c5 = [f for f in mod.run_checks(root=bad) if "[C5]" in f
+              and "BOGUS" in f]
+        assert len(c5) == 1
+
+    def test_unknown_lock_name_trips(self, tmp_path):
+        mod = self._load()
+        bad = tmp_path / "n.py"
+        bad.write_text(
+            "from cometbft_tpu.libs import lockrank\n"
+            "lk = lockrank.RankedLock('not.in.table')\n"
+            "cv = lockrank.RankedCondition(name='dispatch.cv')\n")
+        c6 = [f for f in mod.run_checks(root=bad) if "[C6]" in f]
+        assert len(c6) == 1 and "not.in.table" in c6[0]
+
+
+class TestLeakDetection:
+    """Seeded must-trip #3 and #4: the runtime leak registries the
+    conftest fixtures check after every test."""
+
+    def test_leaked_nondaemon_thread_detected(self):
+        baseline = set(threading.enumerate())
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, name="seeded-leak")
+        t.start()
+        try:
+            leaked = lockrank.leaked_threads(baseline, grace_s=0.05)
+            assert t in leaked
+        finally:
+            release.set()          # clean up before teardown so the
+            t.join(5)              # autouse fixture stays green
+
+    def test_finished_thread_not_reported(self):
+        baseline = set(threading.enumerate())
+        t = threading.Thread(target=lambda: None, name="quick")
+        t.start()
+        assert lockrank.leaked_threads(baseline, grace_s=1.0) == []
+        t.join()
+
+    def test_dropped_failed_future_detected(self):
+        assert lockrank.sanitizer_enabled()    # conftest armed it
+        lockrank.clear_leaked_futures()
+        fut = lockrank.TrackedFuture()
+        fut.set_running_or_notify_cancel()
+        fut.set_exception(RuntimeError("seeded drop"))
+        del fut
+        gc.collect()
+        leaks = lockrank.leaked_futures()
+        assert len(leaks) == 1
+        assert "seeded drop" in leaks[0]
+        assert "set_exception stack" in leaks[0]
+        lockrank.clear_leaked_futures()        # stay green at teardown
+
+    def test_retrieved_exception_not_reported(self):
+        lockrank.clear_leaked_futures()
+        fut = lockrank.TrackedFuture()
+        fut.set_running_or_notify_cancel()
+        fut.set_exception(RuntimeError("seen"))
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=0)
+        del fut
+        gc.collect()
+        assert lockrank.leaked_futures() == []
+
+    def test_dropped_result_future_not_reported(self):
+        lockrank.clear_leaked_futures()
+        fut = lockrank.TrackedFuture()
+        fut.set_running_or_notify_cancel()
+        fut.set_result(42)                     # never retrieved: fine
+        del fut
+        gc.collect()
+        assert lockrank.leaked_futures() == []
+
+
+class TestDisabledOverhead:
+    """The flightrec cost contract: checker off = one global read and
+    one branch per op in front of the raw C lock."""
+
+    N = 20_000
+
+    def _pairs(self, lk):
+        t0 = time.perf_counter()
+        for _ in range(self.N):
+            lk.acquire()
+            lk.release()
+        return time.perf_counter() - t0
+
+    def test_disabled_overhead_is_noop_class(self, own_checker):
+        lockrank.disable()
+        raw = threading.Lock()                  # conc: raw-ok
+        ranked = lockrank.RankedLock("mempool.cache")
+        # warm up, then best-of-3 to shrug scheduler noise
+        self._pairs(ranked), self._pairs(raw)
+        raw_t = min(self._pairs(raw) for _ in range(3))
+        ranked_t = min(self._pairs(ranked) for _ in range(3))
+        # one global read + branch + method indirection: well under
+        # an order of magnitude, and microseconds absolute
+        assert ranked_t < max(10 * raw_t, 0.15), (
+            f"disabled ranked lock pair {ranked_t / self.N * 1e9:.0f}ns"
+            f" vs raw {raw_t / self.N * 1e9:.0f}ns")
+
+    def test_disabled_checker_keeps_no_state(self, own_checker):
+        lockrank.disable()
+        lk = lockrank.RankedLock("mempool.cache")
+        with lk:
+            pass
+        assert lockrank.checker() is None
+        assert lockrank.violations() == []

@@ -1,6 +1,8 @@
 """Mempool: CheckTx gating, cache, reap, update/recheck
 (reference mempool/clist_mempool_test.go)."""
 
+import time
+
 import pytest
 
 from cometbft_tpu.abci import types as at
@@ -150,3 +152,55 @@ class TestCListMempool:
         mp.flush()
         assert mp.size() == 0 and mp.size_bytes() == 0
         mp.check_tx(b"a=1")  # cache reset too
+
+
+class TestWaitForTxs:
+    """wait_for_txs predicate-loop regression (check_concurrency C2
+    finding: the wait used to sit under a bare check, so a notify for
+    an unrelated change — or a spurious wakeup — could surface as a
+    wrong verdict or restart the full timeout window)."""
+
+    def test_spurious_notify_keeps_waiting_then_delivers(self):
+        import threading
+
+        mp, _ = make_mempool()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(mp.wait_for_txs(0, timeout=5.0)),
+            daemon=True)
+        t.start()
+        # unrelated notifies with no matching entry: the waiter must
+        # re-check its predicate and keep waiting, not return False
+        for _ in range(3):
+            time.sleep(0.05)
+            with mp._change_cond:
+                mp._change_cond.notify_all()
+        mp.check_tx(b"k=v")
+        t.join(5)
+        assert got == [True]
+
+    def test_timeout_is_a_total_deadline(self):
+        import threading
+
+        mp, _ = make_mempool()
+        stop = threading.Event()
+
+        def pester():
+            # notify faster than the timeout: with the old semantics
+            # (full timeout re-armed per wakeup) the waiter would
+            # never expire
+            while not stop.is_set():
+                with mp._change_cond:
+                    mp._change_cond.notify_all()
+                time.sleep(0.1)
+
+        t = threading.Thread(target=pester, daemon=True)
+        t.start()
+        try:
+            t0 = time.monotonic()
+            assert mp.wait_for_txs(0, timeout=0.5) is False
+            elapsed = time.monotonic() - t0
+            assert 0.45 <= elapsed < 2.0, elapsed
+        finally:
+            stop.set()
+            t.join(5)

@@ -424,3 +424,67 @@ class TestLatencyConnection:
             time.sleep(0.01)
         with pytest.raises(OSError):
             conn.write(b"after")
+
+
+class TestFuzzedConnection:
+    """FuzzedConnection regression (check_concurrency C3 finding: the
+    delay used to be slept while holding the fuzz config mutex, so one
+    connection's fault draw serialized every other writer behind it)."""
+
+    class _Sink:
+        def __init__(self):
+            self.writes = []
+
+        def write(self, data):
+            self.writes.append(bytes(data))
+            return len(data)
+
+        def read(self):
+            return b""
+
+        def close(self):
+            pass
+
+    def test_delay_sleeps_outside_the_config_mutex(self):
+        from cometbft_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+
+        cfg = FuzzConfig(mode=FuzzConfig.MODE_DELAY, max_delay=0.6,
+                         seed=1)
+        fc = FuzzedConnection(self._Sink(), cfg)
+        in_write = threading.Event()
+
+        def writer():
+            in_write.set()
+            fc.write(b"payload")
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        in_write.wait(2)
+        time.sleep(0.05)          # let the writer reach its sleep
+        # the mutex must be free while the writer sleeps out its delay
+        t0 = time.monotonic()
+        acquired = fc._mtx.acquire(timeout=0.2)
+        waited = time.monotonic() - t0
+        assert acquired, "config mutex held across the fuzz delay"
+        fc._mtx.release()
+        assert waited < 0.2
+        t.join(5)
+
+    def test_drop_mode_swallows_deterministically(self):
+        from cometbft_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+
+        sink = self._Sink()
+        cfg = FuzzConfig(mode=FuzzConfig.MODE_DROP, prob_drop=0.5,
+                         seed=7)
+        fc = FuzzedConnection(sink, cfg)
+        for i in range(20):
+            assert fc.write(b"%d" % i) == len(b"%d" % i)
+        delivered = len(sink.writes)
+        assert 0 < delivered < 20    # some dropped, some through
+        # same seed, same draw sequence
+        sink2 = self._Sink()
+        fc2 = FuzzedConnection(sink2, FuzzConfig(
+            mode=FuzzConfig.MODE_DROP, prob_drop=0.5, seed=7))
+        for i in range(20):
+            fc2.write(b"%d" % i)
+        assert sink2.writes == sink.writes

@@ -707,3 +707,47 @@ class TestBenchSteering:
         assert pick is not None
         g, b, _, _ = pick
         assert (g, b) == (1, 131071)
+
+
+class TestCheckConcurrency:
+    """scripts/check_concurrency.py — the static half of the
+    concurrency sanitizer plane — as a tier-1 gate: the package must
+    be clean, and the lint's own view of the rank table must agree
+    with the runtime module it guards.  (The per-rule must-trip tests
+    on synthetic sources live in tests/test_lockrank.py next to the
+    runtime half's.)"""
+
+    @staticmethod
+    def _load():
+        import importlib.util
+        import pathlib
+        path = pathlib.Path(__file__).resolve().parent.parent / \
+            "scripts" / "check_concurrency.py"
+        spec = importlib.util.spec_from_file_location(
+            "check_concurrency", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_repo_is_clean(self):
+        mod = self._load()
+        findings = mod.run_checks()
+        assert findings == [], "\n".join(findings)
+
+    def test_rank_table_parses_and_matches_runtime(self):
+        from cometbft_tpu.libs import lockrank
+        mod = self._load()
+        ranks = mod.lock_ranks()
+        assert ranks == lockrank.LOCK_RANKS
+
+    def test_scripts_and_tests_only_c1_exempt_dirs(self):
+        """The lint walks cometbft_tpu/ by default; tests/ and
+        scripts/ may use raw primitives (harness code), but the
+        package itself must not — pin the default root."""
+        mod = self._load()
+        import pathlib
+        pkg = pathlib.Path(__file__).resolve().parent.parent / \
+            "cometbft_tpu"
+        walked = list(mod._iter_files())
+        assert walked and all(pkg in p.parents or p == pkg
+                              for p in walked)
