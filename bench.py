@@ -457,6 +457,19 @@ def bench_light_e2e() -> dict:
     return simbench.bench_light_e2e()
 
 
+def bench_lightserve() -> dict:
+    """Coalescing serving-plane fleet A/B (lightserve/): one node's
+    LightServeSession serving a seeded synthetic fleet of light
+    clients, coalescing OFF then ON on the same seed.  Asserts
+    bit-identical served payload digests across arms and a strict
+    verify-dispatch reduction in the ON arm; reports the ON arm's
+    clients/s and p99 serve latency plus the coalesce ratio.  Sizes
+    via SIMNET_LIGHT_FLEET_CLIENTS / _BLOCKS / _VALS / _WORKERS
+    (defaults 10000 x 48 x 4 x 32)."""
+    from cometbft_tpu.simnet import bench as simbench
+    return simbench.bench_lightserve_fleet()
+
+
 def bench_consensus_e2e() -> dict:
     """Live rounds through the real consensus reactor over simnet:
     blocks committed per wall second, with the per-stage consensus
@@ -959,6 +972,8 @@ def main() -> None:
          "blocksync_pipelined_config"),
         ("pipeline_overlap_efficiency", None),
         ("light_e2e_headers_per_sec", "light_e2e_config"),
+        ("light_clients_served_per_sec", "light_serve_config"),
+        ("light_serve_p99_ms", None),
         ("chaos_recovery_seconds", "chaos_config"),
         ("chaos_faulted_blocks_per_sec", None),
         ("chaos_flap_recovery_seconds", None),
@@ -1260,6 +1275,35 @@ def main() -> None:
               " overrides)")
     _attach_e2e_detail("light_e2e_headers_per_sec",
                        "light_e2e_detail", _simbench.last_light)
+    # lightserve fleet A/B: clients/s, p99, and the detail all come
+    # from ONE bench_lightserve() run (CPU host-path verify — no
+    # device time); the p99 companion rides the throughput extra's run
+    run_extra("light_clients_served_per_sec",
+              lambda: bench_lightserve()["light_clients_served_per_sec"],
+              "light_serve_config",
+              "lightserve coalescing fleet A/B (docs/LIGHTSERVE.md):"
+              " seeded synthetic light-client fleet against one"
+              " LightServeSession, coalescing off/on on the same seed;"
+              " served-bytes digest parity and verify-dispatch"
+              " reduction asserted (SIMNET_LIGHT_FLEET_* overrides,"
+              " defaults 10000 clients x 48 blocks x 4 vals)")
+    if ("light_clients_served_per_sec" not in carried_keys
+            and isinstance(extra.get("light_clients_served_per_sec"),
+                           (int, float))
+            and isinstance(_simbench.last_lightserve, dict)):
+        p99 = _simbench.last_lightserve.get("light_serve_p99_ms")
+        if isinstance(p99, (int, float)):
+            extra["light_serve_p99_ms"] = p99
+            carried_keys.discard("light_serve_p99_ms")
+        extra["light_serve_detail"] = {
+            k: _simbench.last_lightserve.get(k)
+            for k in ("coalesce_ratio", "clients_per_sec_off",
+                      "clients_per_sec_on", "p99_ms_off", "p99_ms_on",
+                      "verify_windows_off", "verify_windows_on",
+                      "verify_sigs_off", "verify_sigs_on",
+                      "clients", "blocks", "validators")}
+        _sync_carried()
+        persist()
     run_extra("consensus_e2e_blocks_per_sec",
               lambda: bench_consensus_e2e(
                   attach_timeline=True)["blocks_per_sec"],

@@ -287,6 +287,90 @@ def device_kill_brownout(seed, blocks=24, artifact_dir=None,
     return _run(c, plan, checkers, artifact_dir, metrics)
 
 
+@scenario(deterministic=True)
+def lightserve_partition(seed, blocks=24, n_clients=96, artifact_dir=None,
+                         workdir=None, metrics=None, timeout=120.0):
+    """The serving node is partitioned from its block source mid
+    fleet-sync: a light-client fleet keeps requesting the goal height
+    from the node's LightServeSession while the node itself is still
+    blocksyncing, stalls behind the cut, and catches up after heal.
+    Clients retry on LightServeError until the deadline; the bound is
+    that EVERY client is eventually served, and (sample_verify=1.0)
+    no client ever receives a header that fails a full client-side
+    verify_commit over the wire bytes — a partition may delay serving,
+    never corrupt it."""
+    import threading as _threading
+
+    from ..lightserve import LightServeSession
+    from ..simnet.lightfleet import run_fleet
+
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("server")
+    c.dial("server", "src0")
+    server = c.nodes["server"]
+    session = LightServeSession(server.block_store, server.state_store,
+                                c.genesis.chain_id)
+    fleet: dict = {}
+
+    def drive_fleet():
+        # target blocks-1: a syncer never holds block blocks+1, and a
+        # height is servable only once the NEXT block's LastCommit
+        # lands (blocksync stores no seen commit at its tip)
+        try:
+            fleet["rec"] = run_fleet(
+                session, n_clients, seed, target=blocks - 1, workers=8,
+                sample_verify=1.0, chain_id=c.genesis.chain_id,
+                deadline_s=timeout)
+        except Exception as e:          # surfaced after the goal below
+            fleet["error"] = f"{type(e).__name__}: {e}"
+
+    plan = (Plan("lightserve_partition")
+            .when("server", max(3, blocks // 3), "partition",
+                  groups=[["src0"], ["server"]])
+            .at(0.5, "heal")
+            .now("redial")
+            .goal(["server"], blocks, timeout=timeout))
+    fleet_thread = _threading.Thread(target=drive_fleet,
+                                     name="lightserve-fleet",
+                                     daemon=True)
+    fleet_thread.start()
+    try:
+        res = _run(c, plan, default_checkers(liveness_budget_s=60),
+                   artifact_dir, metrics)
+    finally:
+        fleet_thread.join(timeout=timeout)
+        session.close()
+    rec = fleet.get("rec")
+    if fleet_thread.is_alive() or rec is None:
+        res.violations.append({
+            "checker": "lightserve_fleet",
+            "detail": fleet.get("error", "fleet did not finish")})
+    else:
+        if rec["failures"] or rec["clients"] != n_clients:
+            res.violations.append({
+                "checker": "lightserve_fleet",
+                "detail": f"{len(rec['failures'])} clients failed, "
+                          f"{rec['clients']}/{n_clients} served: "
+                          f"{rec['failures'][:3]}"})
+        if rec["verified_clients"] != n_clients:
+            res.violations.append({
+                "checker": "lightserve_fleet",
+                "detail": "client-side verify_commit coverage hole: "
+                          f"{rec['verified_clients']}/{n_clients}"})
+        res.timing["lightserve_clients_per_sec"] = \
+            rec["clients_per_sec"]
+        res.timing["lightserve_p99_ms"] = rec["p99_ms"]
+        res.timing["lightserve_wall_s"] = rec["wall_s"]
+        res.context["lightserve_fleet"] = {
+            "clients": rec["clients"], "digest": rec["digest"],
+            "verify_windows": session.verify_windows,
+            "verify_sigs": session.verify_sigs}
+    return res
+
+
 # -- live-consensus scenarios ------------------------------------------------
 
 @scenario(deterministic=False)

@@ -74,6 +74,11 @@ EV_BROWNOUT = "brownout"             # every device quarantined
 #                                       fallback with bounded depth and
 #                                       shrunken windows; entered=False
 #                                       when a probe returns a chip
+EV_LIGHTSERVE_REJECT = "lightserve_reject"  # the serving plane caught
+#                                       an invalid commit signature in
+#                                       a merged flush: that height's
+#                                       requests fail, nothing is
+#                                       served past it
 
 
 class FlightRecorder:

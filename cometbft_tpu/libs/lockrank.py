@@ -70,6 +70,19 @@ from concurrent.futures import Future
 LOCK_RANKS: dict[str, int] = {
     # orchestration above the node engines
     "chaos.cluster": 10,
+    # synthetic light-client fleet driver (simnet/lightfleet.py):
+    # guards the fleet's cursor/latency/failure tallies only — never
+    # held across a session.serve call, so it sits at the very top
+    "simnet.lightfleet": 11,
+    # light-client serving plane (lightserve/): outermost product locks
+    # — the coalescer cv and planner are held only around queue/counter
+    # mutation, never across store reads or pipeline submits, but the
+    # request path REACHES stores (140+), the payload cache (470) and
+    # the verify plane (370+) after release, so the serving tier ranks
+    # above (i.e. outside) all of them
+    "lightserve.session": 12,
+    "lightserve.cv": 14,
+    "lightserve.planner": 16,
     # consensus core: the state mutex is the outermost product lock —
     # nearly every subsystem below is reachable while it is held
     "consensus.state": 20,
@@ -150,6 +163,7 @@ LOCK_RANKS: dict[str, int] = {
 # per-metric, ...): equal-rank nesting among peers is allowed and
 # same-name pairs are excluded from the cycle-edge table
 MULTI_OK = frozenset({
+    "lightserve.session", "lightserve.cv", "lightserve.planner",
     "consensus.state", "consensus.peerstate", "consensus.ticker",
     "evidence.pool", "mempool.clist", "mempool.cache",
     "blocksync.pool", "state.sink", "state.indexer",

@@ -14,6 +14,7 @@ bisection hop = one or two device launches regardless of valset size.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 from ..crypto import sigcache
@@ -62,6 +63,82 @@ class ErrLightClientAttack(LightClientError):
     def __init__(self, evidence):
         super().__init__("light client attack detected")
         self.evidence = evidence
+
+
+class _WindowPrefetcher:
+    """Single-worker window prefetch for the sequential sync paths.
+
+    Replaces the ThreadPoolExecutor(max_workers=1) both sequential
+    strategies used: the executor's worker was invisible to the
+    concurrency lints (check_concurrency.py C4 only sees
+    threading.Thread constructions) and, being non-daemon, hung
+    interpreter shutdown whenever a verify failure unwound the context
+    manager while a fetch was still blocked on a dead provider —
+    executor __exit__ is shutdown(wait=True).  The worker here is a
+    daemon (a wedged provider can never wedge shutdown), close() still
+    joins it on the orderly path, and the construction is registered
+    in scripts/check_concurrency.JOINED_THREADS."""
+
+    def __init__(self):
+        import queue
+
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._empty = queue.Empty
+        self._inflight = None
+        self._thread = threading.Thread(
+            target=self._run, name="light-prefetch", daemon=True)
+        self._thread.start()
+
+    def submit(self, fn, *args):
+        import concurrent.futures as cf
+
+        fut = cf.Future()
+        self._jobs.put((fut, fn, args))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            self._inflight = fut
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:
+                fut.set_exception(e)
+            finally:
+                self._inflight = None
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Cancel queued fetches, stop the worker, join with a bound.
+        A fetch already blocked inside a provider cannot be
+        interrupted; its daemon thread is abandoned and its future's
+        eventual exception consumed here so nothing leaks."""
+        try:
+            while True:
+                item = self._jobs.get_nowait()
+                if item is not None:
+                    item[0].cancel()
+        except self._empty:
+            pass
+        self._jobs.put(None)
+        self._thread.join(timeout=timeout)
+        fut = self._inflight
+        if fut is not None and fut.done():
+            try:
+                fut.exception(timeout=0)
+            except BaseException:
+                pass
+
+    def __enter__(self) -> "_WindowPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 class Client:
@@ -215,8 +292,6 @@ class Client:
         if self.pipeline_depth >= 2:
             return self._verify_sequential_pipelined(trusted, target,
                                                      now)
-        import concurrent.futures as cf
-
         from ..types import validation
 
         def fetch_window(start: int, end: int) -> list[LightBlock]:
@@ -231,9 +306,7 @@ class Client:
         # overlap: while window w's signatures run on the device, a
         # single worker thread fetches window w+1 from the provider —
         # a syncing client's wall-clock is max(fetch, verify), not sum
-        with cf.ThreadPoolExecutor(
-                max_workers=1,
-                thread_name_prefix="light-prefetch") as ex:
+        with _WindowPrefetcher() as ex:
             wend = min(h + self.sequential_batch_size - 1, target.height)
             pending = ex.submit(fetch_window, h, wend)
             while h <= target.height:
@@ -270,7 +343,6 @@ class Client:
         window's headers extend the trace only after its verdict
         future resolved true; any failure raises before the target —
         or anything past the failed window — is stored."""
-        import concurrent.futures as cf
         from collections import deque
 
         from ..crypto.dispatch import VerifyPipeline
@@ -292,9 +364,7 @@ class Client:
         devices = sharding.mesh_device_list(self.mesh_devices or None)
         depth = self.pipeline_depth if devices is None else \
             max(self.pipeline_depth, 2 * len(devices))
-        with cf.ThreadPoolExecutor(
-                max_workers=1,
-                thread_name_prefix="light-prefetch") as ex, \
+        with _WindowPrefetcher() as ex, \
                 VerifyPipeline(depth=depth,
                                name="light-pipeline",
                                devices=devices if devices is not None

@@ -18,6 +18,8 @@ class LightProxy:
 
     def __init__(self, client, addr: str):
         self._client = client
+        self._light_requests = 0
+        self._light_headers = 0
         host, _, port = addr.replace("tcp://", "").rpartition(":")
 
         def dispatch(method, params, req_id):
@@ -98,6 +100,64 @@ class LightProxy:
             },
         }
 
+    # -- lightserve routes (same wire shape as rpc/core.py) ----------------
+
+    def light_sync(self, trusted_height=None, target_height=None) -> dict:
+        """Proxy-side light_sync: verify the target through the
+        wrapped light client (the bisection trace lands in its trusted
+        store), then serve the pivot-path blocks the store now holds —
+        every block handed out went through verify_header."""
+        import json
+
+        from ..lightserve import skip_path
+        from ..lightserve.codec import encode_payload
+
+        target_lb = self._verified_block(target_height)
+        target = target_lb.height
+        trusted = int(trusted_height) if trusted_height else 0
+        if trusted <= 0:
+            first = self._client.store.first_light_block()
+            trusted = first.height if first is not None else 1
+        path = []
+        blocks = []
+        for h in skip_path(trusted, target):
+            lb = target_lb if h == target \
+                else self._client.trusted_light_block(h)
+            if lb is None:
+                continue
+            path.append(h)
+            blocks.append(json.loads(encode_payload(
+                h, lb.signed_header.header, lb.signed_header.commit,
+                lb.validator_set)))
+        self._light_requests += 1
+        self._light_headers += len(path)
+        return {
+            "trusted_height": str(trusted),
+            "target_height": str(target),
+            "path": [str(h) for h in path],
+            "light_blocks": blocks,
+            "coalesced": False,
+        }
+
+    def light_status(self) -> dict:
+        latest = self._client.latest_trusted()
+        first = self._client.store.first_light_block()
+        return {
+            "coalescing": False,
+            "chain_id": self._client.chain_id,
+            "latest_height": str(latest.height) if latest else "0",
+            "base_height": str(first.height) if first else "0",
+            "requests": str(self._light_requests),
+            "headers_served": str(self._light_headers),
+            "verify_windows": "0",
+            "verify_sigs": "0",
+            "failed_heights": "0",
+            "coalesced_heights": "0",
+            "inflight_heights": "0",
+            "planner": {},
+        }
+
 
 _ROUTES = {"header": "header", "commit": "commit",
-           "validators": "validators", "status": "status"}
+           "validators": "validators", "status": "status",
+           "light_sync": "light_sync", "light_status": "light_status"}

@@ -1,0 +1,41 @@
+"""Coalescing light-client serving plane: one TPU-owning node
+amortizing shared verify windows across thousands of concurrent
+light-client sync requests (docs/LIGHTSERVE.md).
+
+The package splits along the serve path:
+
+- ``planner``: the trust-path planner — the deterministic
+  skipping-bisection plan (the 9/16 pivot chain light/client.py
+  walks), a hot-trust-height profile, and the serialized payload
+  cache (types/part_set.SerializedBlockCache) hot paths serve from
+  without re-joining header + commit + valset;
+- ``coalesce``: the request coalescer — per-height shared verify
+  futures deduping identical header-verify work across concurrent
+  requests (the StreamingVerifier in-flight dedupe, generalized
+  across RPC requests), drained round-robin for fairness and flushed
+  as merged windows;
+- ``session``: LightServeSession — the facade rpc/core.py's
+  ``light_sync``/``light_status`` routes and the simnet fleet driver
+  call; owns the verify flush (one DeferredSigBatch window per flush
+  through the VerifyPipeline under ``sigcache.consumer("lightserve")``);
+- ``codec``: payload decode + client-side ``verify_commit`` over the
+  served wire bytes — what the chaos checker and the fleet driver's
+  sampled verification use to prove no client was handed a header
+  that does not verify.
+"""
+
+from .coalesce import RequestCoalescer, RequestTicket
+from .codec import decode_payload, verify_payload
+from .planner import TrustPathPlanner, skip_path
+from .session import LightServeError, LightServeSession
+
+__all__ = [
+    "LightServeError",
+    "LightServeSession",
+    "RequestCoalescer",
+    "RequestTicket",
+    "TrustPathPlanner",
+    "decode_payload",
+    "skip_path",
+    "verify_payload",
+]

@@ -1,0 +1,109 @@
+"""Serve-payload wire format + the client-side decode/verify pair.
+
+A lightserve payload is one height's LightBlock as canonical JSON
+(sorted keys, no whitespace): the signed header and the validator set
+in exactly the shapes rpc/serialize.py emits, so the bytes double as
+the ``light_sync`` RPC result.  Canonical encoding is what makes the
+coalescing A/B meaningful: two arms serving the same chain MUST
+produce bit-identical blobs.
+
+``verify_payload`` is the fleet/chaos checker's client: it
+reconstructs the Commit and ValidatorSet from the received wire bytes
+(not from the server's objects) and runs the full ``verify_commit`` —
+the strongest "no client received an unverifiable header" assertion
+available without a second chain.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ..libs import tmjson
+from ..rpc import serialize as ser
+from ..types import validation
+from ..types.block import BlockID, Commit, CommitSig, PartSetHeader
+from ..types.timestamp import Timestamp
+from ..types.validator_set import Validator, ValidatorSet
+
+_FLAGS = {"BLOCK_ID_FLAG_ABSENT": 1, "BLOCK_ID_FLAG_COMMIT": 2,
+          "BLOCK_ID_FLAG_NIL": 3}
+
+
+def encode_payload(height: int, header, commit, vals) -> bytes:
+    doc = {
+        "height": str(height),
+        "signed_header": {
+            "header": ser.header_json(header),
+            "commit": ser.commit_json(commit),
+        },
+        "validator_set": {
+            "validators": [ser.validator_json(v)
+                           for v in vals.validators],
+            "total_voting_power": str(vals.total_voting_power()),
+        },
+    }
+    return json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def decode_payload(blob: bytes) -> dict:
+    return json.loads(blob)
+
+
+def _block_id_from_json(d: dict) -> BlockID:
+    return BlockID(
+        bytes.fromhex(d["hash"]),
+        PartSetHeader(int(d["parts"]["total"]),
+                      bytes.fromhex(d["parts"]["hash"])))
+
+
+def commit_from_json(d: dict) -> Commit:
+    sigs = []
+    for s in d["signatures"]:
+        flag = _FLAGS.get(s["block_id_flag"])
+        if flag is None:
+            flag = int(s["block_id_flag"])
+        sig = base64.b64decode(s["signature"]) if s["signature"] else b""
+        sigs.append(CommitSig(
+            block_id_flag=flag,
+            validator_address=bytes.fromhex(s["validator_address"]),
+            timestamp=Timestamp.from_rfc3339(s["timestamp"]),
+            signature=sig))
+    return Commit(int(d["height"]), int(d["round"]),
+                  _block_id_from_json(d["block_id"]), sigs)
+
+
+def validator_set_from_json(d: dict) -> ValidatorSet:
+    vals = []
+    for v in d["validators"]:
+        pub = tmjson.from_obj(v["pub_key"])
+        vals.append(Validator(
+            pub_key=pub,
+            voting_power=int(v["voting_power"]),
+            proposer_priority=int(v["proposer_priority"]),
+            address=bytes.fromhex(v["address"])))
+    return ValidatorSet.from_validated(vals)
+
+
+def verify_payload(chain_id: str, blob: bytes) -> dict:
+    """Decode one served payload and verify it the way a receiving
+    light client would: structural consistency, then the full
+    ``verify_commit`` (+2/3 power, every signature checked) over the
+    RECONSTRUCTED commit and validator set.  Raises on any failure;
+    returns the decoded document."""
+    doc = decode_payload(blob)
+    height = int(doc["height"])
+    header = doc["signed_header"]["header"]
+    commit = commit_from_json(doc["signed_header"]["commit"])
+    vals = validator_set_from_json(doc["validator_set"])
+    if int(header["height"]) != height or commit.height != height:
+        raise validation.CommitVerificationError(
+            f"payload height mismatch: payload {height}, header "
+            f"{header['height']}, commit {commit.height}")
+    if header["chain_id"] != chain_id:
+        raise validation.CommitVerificationError(
+            f"payload chain {header['chain_id']!r} != {chain_id!r}")
+    validation.verify_commit(chain_id, vals, commit.block_id, height,
+                             commit)
+    return doc

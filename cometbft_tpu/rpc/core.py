@@ -10,6 +10,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..abci import types as at
+from ..libs import lockrank
 from ..types import events as ev
 from ..types.block import tx_hash
 from . import serialize as ser
@@ -40,6 +41,14 @@ class Environment:
     tx_indexer: object = None
     block_indexer: object = None
     pruner: object = None
+    # the light-client serving plane (cometbft_tpu/lightserve/):
+    # created lazily on first light_sync/light_status call so every
+    # Environment assembly (node, simnet, cmd inspect) serves the
+    # routes without wiring changes; owners may also install one
+    # eagerly.  RPCServer.stop() closes it.
+    lightserve: object = None
+    _lightserve_mtx: object = field(
+        default_factory=lambda: lockrank.RankedLock("lightserve.session"))
     _subscribers: dict = field(default_factory=dict)
 
     # -- height helpers ----------------------------------------------------
@@ -179,6 +188,42 @@ class Environment:
             },
             "canonical": canonical,
         }
+
+    # -- light-client serving plane (cometbft_tpu/lightserve/) -------------
+    def _lightserve(self):
+        with self._lightserve_mtx:
+            if self.lightserve is None:
+                from ..lightserve import LightServeSession
+
+                if self.genesis is not None:
+                    chain_id = self.genesis.chain_id
+                else:
+                    st = self.state_store.load()
+                    if st is None:
+                        raise RPCError(-32603,
+                                       "no state to serve light sync from")
+                    chain_id = st.chain_id
+                self.lightserve = LightServeSession(
+                    self.block_store, self.state_store, chain_id)
+            return self.lightserve
+
+    def light_sync(self, trusted_height=None, target_height=None) -> dict:
+        """Serve one skipping-sync request: the verified pivot path
+        from trusted_height (exclusive) to target_height (inclusive,
+        default latest) with each height's light block.  Concurrent
+        requests coalesce onto shared verify futures server-side
+        (docs/LIGHTSERVE.md)."""
+        from ..lightserve import LightServeError
+
+        try:
+            return self._lightserve().sync(trusted_height, target_height)
+        except LightServeError as e:
+            raise RPCError(-32603, str(e))
+
+    def light_status(self) -> dict:
+        """Serving-plane counters: coalescing state, verify windows
+        and signatures dispatched, planner/payload-cache stats."""
+        return self._lightserve().status()
 
     def blockchain(self, minHeight=None, maxHeight=None) -> dict:
         """rpc/core/blocks.go BlockchainInfo: metas in [min, max]."""
@@ -696,6 +741,8 @@ ROUTES = {
     "check_tx": "check_tx",
     "genesis_chunked": "genesis_chunked",
     "header_by_hash": "header_by_hash",
+    "light_sync": "light_sync",
+    "light_status": "light_status",
 }
 
 # privileged routes: served only on the separate privileged listener

@@ -48,6 +48,16 @@ class RPCServer:
         if self._thread is not None:
             self._httpd.shutdown()
         self._httpd.server_close()
+        # the lazily-created serving plane (rpc/core.py _lightserve)
+        # owns a flusher thread; close is idempotent, so the public
+        # and privileged servers sharing one Environment both calling
+        # it is fine
+        ls = getattr(self._env, "lightserve", None)
+        if ls is not None:
+            try:
+                ls.close()
+            except Exception:
+                pass
 
 
 def _err(req_id, code: int, message: str, data: str = "") -> dict:

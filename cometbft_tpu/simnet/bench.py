@@ -38,6 +38,7 @@ last_blocksync: dict | None = None
 last_light: dict | None = None
 last_consensus: dict | None = None
 last_cache_ab: dict | None = None
+last_lightserve: dict | None = None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -428,3 +429,120 @@ def bench_light_e2e(n_headers: int | None = None,
         "stages": stages,
     }
     return last_light
+
+
+def bench_lightserve_fleet(n_clients: int | None = None,
+                           n_blocks: int | None = None,
+                           n_vals: int | None = None,
+                           seed: int = 23,
+                           workers: int | None = None,
+                           sample_verify: float = 0.0) -> dict:
+    """A/B the lightserve coalescer over the SAME seeded client fleet:
+    arm OFF serves every request through its own verify window, arm ON
+    merges overlapping in-flight paths into shared flushes.
+
+    The contract coalescing must hold: the fleet payload digest is
+    bit-identical across arms (merging windows may not change a single
+    served byte), every client is served, and the ON arm dispatches
+    strictly fewer verify windows AND fewer signature verifies for the
+    same traffic — that dispatch reduction is WHERE the throughput
+    comes from.  The signature-verdict cache is forced off in both
+    arms so the reduction is attributable to the coalescer alone.
+    Stores the combined record in `last_lightserve`."""
+    global last_lightserve
+    n_clients = n_clients if n_clients is not None else _env_int(
+        "SIMNET_LIGHT_FLEET_CLIENTS", 10_000)
+    n_blocks = n_blocks if n_blocks is not None else _env_int(
+        "SIMNET_LIGHT_FLEET_BLOCKS", 48)
+    n_vals = n_vals if n_vals is not None else _env_int(
+        "SIMNET_LIGHT_FLEET_VALS", 4)
+    workers = workers if workers is not None else _env_int(
+        "SIMNET_LIGHT_FLEET_WORKERS", 32)
+
+    from ..crypto import dispatch
+    from ..lightserve import LightServeSession
+    from .lightfleet import run_fleet
+
+    net = SimNetwork(seed=seed)
+    genesis, privs = make_sim_genesis(n_vals=n_vals, seed=seed)
+    src = SimNode("lfsrc", genesis, net, seed=seed)
+    # +1: the block above the tip carries the commit that seals the
+    # tip, so heights 1..n_blocks are all servable with a commit
+    grow_chain(src, privs, n_blocks + 1, txs_per_block=1)
+
+    pipe = dispatch.default_pipeline()
+    prev_cache_enabled = sigcache._enabled_override
+    arms: dict[str, dict] = {}
+    try:
+        for arm, coalesce in (("off", False), ("on", True)):
+            # cache off + reset per arm: the dispatch reduction must
+            # come from the coalescer, not verdict-cache hits
+            sigcache.set_enabled(False)
+            sigcache.reset()
+            session = LightServeSession(
+                src.block_store, src.state_store, genesis.chain_id,
+                coalesce=coalesce)
+            submitted0 = pipe.submitted
+            try:
+                rec = run_fleet(session, n_clients, seed,
+                                workers=workers,
+                                sample_verify=sample_verify,
+                                chain_id=genesis.chain_id)
+            finally:
+                session.close()
+            rec["verify_windows"] = session.verify_windows
+            rec["verify_sigs"] = session.verify_sigs
+            rec["pipeline_windows"] = pipe.submitted - submitted0
+            arms[arm] = rec
+    finally:
+        sigcache.set_enabled(prev_cache_enabled)
+        sigcache.reset()
+        src.stop()
+
+    off, on = arms["off"], arms["on"]
+    if off["failures"] or on["failures"]:
+        raise RuntimeError(
+            "lightserve fleet arm had failures: "
+            f"off={off['failures'][:3]} on={on['failures'][:3]}")
+    if off["clients"] != n_clients or on["clients"] != n_clients:
+        raise RuntimeError(
+            f"lightserve fleet under-served: off={off['clients']} "
+            f"on={on['clients']} of {n_clients}")
+    if off["digest"] != on["digest"]:
+        raise RuntimeError(
+            "coalescing changed served bytes: "
+            f"off={off['digest']} on={on['digest']}")
+    if not (on["verify_windows"] < off["verify_windows"]
+            and on["verify_sigs"] < off["verify_sigs"]):
+        raise RuntimeError(
+            "coalescing did not reduce verify dispatch: windows "
+            f"{off['verify_windows']}->{on['verify_windows']}, sigs "
+            f"{off['verify_sigs']}->{on['verify_sigs']}")
+
+    ratio = (round(on["clients_per_sec"] / off["clients_per_sec"], 2)
+             if off["clients_per_sec"] else 0.0)
+    last_lightserve = {
+        "light_clients_served_per_sec": on["clients_per_sec"],
+        "light_serve_p99_ms": on["p99_ms"],
+        "coalesce_ratio": ratio,
+        "digest_parity": True,
+        "clients": n_clients,
+        "blocks": n_blocks,
+        "validators": n_vals,
+        "workers": workers,
+        "seed": seed,
+        "clients_per_sec_off": off["clients_per_sec"],
+        "clients_per_sec_on": on["clients_per_sec"],
+        "p99_ms_off": off["p99_ms"],
+        "p99_ms_on": on["p99_ms"],
+        "p50_ms_on": on["p50_ms"],
+        "verify_windows_off": off["verify_windows"],
+        "verify_windows_on": on["verify_windows"],
+        "verify_sigs_off": off["verify_sigs"],
+        "verify_sigs_on": on["verify_sigs"],
+        "pipeline_windows_off": off["pipeline_windows"],
+        "pipeline_windows_on": on["pipeline_windows"],
+        "wall_s_off": off["wall_s"],
+        "wall_s_on": on["wall_s"],
+    }
+    return last_lightserve

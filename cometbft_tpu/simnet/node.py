@@ -213,6 +213,7 @@ class SimNode:
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
 
         self.rpc_server = None
+        self.lightserve = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -223,6 +224,9 @@ class SimNode:
         if self.rpc_server is not None:
             self.rpc_server.stop()
             self.rpc_server = None
+        if self.lightserve is not None:
+            self.lightserve.close()
+            self.lightserve = None
         self.switch.stop()
         self.event_bus.stop()
 
@@ -245,6 +249,13 @@ class SimNode:
             app_conns=None,
             node_info=self.node_info,
             config=None)
+        # serving plane wired eagerly (the lazy rpc/core.py seam would
+        # also work) so fleet benches can reach node.lightserve
+        # counters directly; RPCServer.stop() closes it
+        from ..lightserve import LightServeSession
+        self.lightserve = LightServeSession(
+            self.block_store, self.state_store, self.genesis.chain_id)
+        env.lightserve = self.lightserve
         self.rpc_server = RPCServer(env, "127.0.0.1:0",
                                     with_websocket=False)
         self.rpc_server.start()

@@ -187,6 +187,21 @@ class _DeferredVerdict:
         raise CommitVerificationError(
             "BUG: deferred window failed with no invalid signatures")
 
+    def failed_contexts(self, timeout: float | None = None) -> set:
+        """Per-context verdicts instead of first-failure raise: the
+        set of ctx values (heights, for commit collection) that had at
+        least one invalid signature.  Empty set = the whole window
+        verified.  The lightserve coalescer merges MANY clients'
+        heights into one window and must fail only the requests whose
+        heights are actually bad, not the whole flush."""
+        if self.handle is None:
+            return set()
+        ok, verdicts = self.handle.result(timeout)
+        if ok:
+            return set()
+        return {ctx for (_, ctx, _, _, _), valid
+                in zip(self._entries, verdicts) if not valid}
+
 
 def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
                   height: int, commit: Commit) -> None:

@@ -77,7 +77,13 @@ QUEUE_RECEIVER = re.compile(r"(queue|inbox|sched|_q)\b|_q$", re.I)
 
 # C4: threads deliberately non-daemon AND joined on their owner's
 # on_stop path ("file::attr" of the construction's assignment target)
-JOINED_THREADS: set[str] = set()
+JOINED_THREADS: set[str] = {
+    # light/client.py _WindowPrefetcher: the sequential-sync prefetch
+    # worker — daemonized (a wedged provider must never wedge
+    # interpreter shutdown) AND joined by close() on the orderly path;
+    # tests/test_light.py pins the leak regression
+    "client.py::self._thread",
+}
 
 # C5: the closed env-knob registry.  One entry per knob the package
 # reads; docs/ANALYSIS.md carries the authoritative table and every
@@ -139,7 +145,13 @@ KNOBS = {
     "COMETBFT_TPU_NATIVE_CODEC_MIN",
     "COMETBFT_TPU_KVSTORE_SNAPSHOT_INTERVAL",
     "COMETBFT_TPU_RSS_LOG",
-    # sanitizer plane (this PR)
+    # lightserve/ — the coalescing light-client serving plane
+    "COMETBFT_TPU_LIGHTSERVE_COALESCE",
+    "COMETBFT_TPU_LIGHTSERVE_WINDOW_MS",
+    "COMETBFT_TPU_LIGHTSERVE_MAX_BATCH",
+    "COMETBFT_TPU_LIGHTSERVE_PLAN_DEPTH",
+    "COMETBFT_TPU_LIGHTSERVE_PAYLOAD_CACHE",
+    # sanitizer plane (lockrank PR)
     "COMETBFT_TPU_LOCKRANK",
     "COMETBFT_TPU_SANITIZERS",
     # simnet

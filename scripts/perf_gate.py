@@ -35,7 +35,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # verdict_cache_hit_rate stays in the default higher-is-better set: a
 # hit-rate drop means commits started re-verifying signatures.
 LOWER_IS_BETTER = {"chaos_recovery_seconds",
-                   "chaos_flap_recovery_seconds", "commit_splice_ms"}
+                   "chaos_flap_recovery_seconds", "commit_splice_ms",
+                   # lightserve fleet serve latency: the coalescer's
+                   # whole point is cutting the tail — p99 rising
+                   # means merged flushes stopped paying for the wait
+                   "light_serve_p99_ms"}
 # non-metric extras (configs, notes, lists) are skipped by the numeric
 # filter; these numerics are ratios/counters, not rates to gate on.
 # critical_path_device_share moved here when the signature-verdict

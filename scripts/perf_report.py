@@ -169,7 +169,54 @@ def critical_path_report(paths: list[str],
                   f"p50={s['p50']:.4f}s p99={s['p99']:.4f}s")
 
 
+def lightserve_report() -> None:
+    """--lightserve mode: print the serving-plane trajectory across
+    committed rounds — fleet clients/s beside the p99 serve latency
+    and the coalesce ratio from the same A/B run, so throughput gains
+    bought by fatter tails are visible in one line per round."""
+    import glob
+    import re
+
+    rows = []
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json"))) \
+            + [BENCH]:
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or rec
+            extra = parsed.get("extra") or {}
+            cps = extra.get("light_clients_served_per_sec")
+            p99 = extra.get("light_serve_p99_ms")
+            detail = extra.get("light_serve_detail") or {}
+        except (json.JSONDecodeError, OSError):
+            continue
+        n = re.search(r"r(\d+)", os.path.basename(p))
+        label = f"r{n.group(1)}" if n else "live"
+        if isinstance(cps, (int, float)):
+            rows.append((label, cps, p99, detail.get("coalesce_ratio"),
+                         detail.get("clients")))
+    if not rows:
+        print("no lightserve fleet captures yet "
+              "(light_clients_served_per_sec absent from every "
+              "BENCH_r*.json / BENCH_live.json)")
+        return
+    print("lightserve fleet trajectory (BENCH_r*.json + live):")
+    for label, cps, p99, ratio, clients in rows:
+        p99_s = f"  p99={p99:,.1f}ms" \
+            if isinstance(p99, (int, float)) else ""
+        ratio_s = f"  coalesce_ratio={ratio:.2f}x" \
+            if isinstance(ratio, (int, float)) else ""
+        n_s = f"  clients={clients:,}" \
+            if isinstance(clients, (int, float)) else ""
+        print(f"  {label}: {fmt(cps)} clients/s{p99_s}{ratio_s}{n_s}")
+
+
 def main() -> None:
+    if "--lightserve" in sys.argv[1:]:
+        lightserve_report()
+        return
     if "--critical-path" in sys.argv[1:]:
         occupancy = "--occupancy" in sys.argv[1:]
         args = [a for a in sys.argv[1:]

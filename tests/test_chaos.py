@@ -165,6 +165,23 @@ class TestChaosSmoke:
         assert r.timing["faulted_blocks_per_sec"] > 0
         assert r.fingerprint["heights"]["syncer"] == 16
 
+    def test_lightserve_partition_serves_through_cut(self):
+        """The serving node loses its block source mid-fleet-sync:
+        every client must still be served within the deadline (retries
+        bridge the partition) and every payload passes a full
+        client-side verify_commit (sample_verify=1.0 inside the
+        scenario) — the cut delays serving, never corrupts it."""
+        r = run_scenario("lightserve_partition", seed=73, blocks=16,
+                         n_clients=48)
+        assert r.ok, r.violations
+        assert r.fingerprint["heights"]["server"] == 16
+        fleet = r.context["lightserve_fleet"]
+        assert fleet["clients"] == 48
+        # signatures really flowed through the serving verify plane
+        assert fleet["verify_windows"] >= 1
+        assert fleet["verify_sigs"] > 0
+        assert r.timing["lightserve_clients_per_sec"] > 0
+
 
 class TestDeviceHealthScenarios:
     """Tentpole acceptance: hung dispatch, flapping chip, and
@@ -308,6 +325,8 @@ def test_catalog_registered():
     assert meta["deterministic"] and not meta["broken"]
     assert SCENARIOS["selftest_forge_drain_skip"]["broken"]
     assert SCENARIOS["byzantine_double_sign_evidence"]["tier"] == "slow"
+    ls = SCENARIOS["lightserve_partition"]
+    assert ls["deterministic"] and not ls["broken"]
     # every cataloged scenario carries a docstring for the soak report
     assert all(m["doc"] for m in SCENARIOS.values())
 

@@ -1,0 +1,380 @@
+"""lightserve: the coalescing light-client serving plane
+(cometbft_tpu/lightserve/, docs/LIGHTSERVE.md).
+
+Fast tier: trust-path planner units, coalescer dedupe / round-robin
+fairness / cancelled-request cleanup on a manual flusher, payload
+codec round-trip + client-side verify, session serve with per-height
+forged-commit blame, the RPC routes over a live simnet node, and the
+small same-seed coalescing A/B parity pin.  Slow tier: the 10k-client
+fleet soak with the >= 3x throughput acceptance bound.
+"""
+
+import copy
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.lightserve import (
+    LightServeError, LightServeSession, RequestCoalescer, skip_path,
+    decode_payload, verify_payload,
+)
+from cometbft_tpu.simnet import (
+    SimNetwork, SimNode, grow_chain, make_sim_genesis,
+)
+
+BLOCKS = 12
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One simnet chain serving the whole module: heights 1..BLOCKS
+    all have their sealing commit in store (grow to BLOCKS+1)."""
+    net = SimNetwork(seed=31)
+    genesis, privs = make_sim_genesis(n_vals=4, seed=31)
+    src = SimNode("lssrc", genesis, net, seed=31)
+    grow_chain(src, privs, BLOCKS + 1, txs_per_block=1)
+    yield src, genesis
+    src.stop()
+
+
+def _session(served, **kw):
+    src, genesis = served
+    return LightServeSession(src.block_store, src.state_store,
+                             genesis.chain_id, **kw)
+
+
+# ---------------------------------------------------------------------------
+# trust-path planner
+# ---------------------------------------------------------------------------
+
+def test_skip_path_shape():
+    for trusted, target in ((1, 2), (1, 12), (3, 100), (97, 100)):
+        path = skip_path(trusted, target)
+        assert path[-1] == target
+        assert all(trusted < h <= target for h in path)
+        assert path == sorted(set(path))        # strictly increasing
+
+
+def test_skip_path_matches_light_client_pivot():
+    """The planner must precompute the EXACT pivot chain the light
+    client's skipping bisection walks (light/client.py 9/16 rule) —
+    a different path would verify fine but never share futures with
+    the client-driven traffic."""
+    from cometbft_tpu.light import client as lc
+    trusted, target = 4, 64
+    first = skip_path(trusted, target)[0]
+    want = max(trusted + 1,
+               trusted + (target - trusted) * lc._SKIP_NUM // lc._SKIP_DEN)
+    assert first == want
+
+
+def test_skip_path_adjacent_is_single_step():
+    assert skip_path(9, 10) == [10]
+
+
+# ---------------------------------------------------------------------------
+# request coalescer (manual flusher: start=False)
+# ---------------------------------------------------------------------------
+
+def _manual_coalescer(results=None):
+    calls = []
+
+    def verify(heights):
+        calls.append(list(heights))
+        return {h: (results or {}).get(h) for h in heights}
+
+    return RequestCoalescer(verify, start=False), calls
+
+
+def test_coalescer_dedupes_overlapping_requests():
+    co, calls = _manual_coalescer()
+    t1 = co.acquire([5, 6])
+    t2 = co.acquire([6, 7])
+    # the overlapping height shares ONE future across requests
+    assert t2.futures[6] is t1.futures[6]
+    assert co.stats()["coalesced"] == 1
+    co.flush_now()
+    seen = [h for batch in calls for h in batch]
+    assert sorted(seen) == [5, 6, 7]            # each height verified once
+    t1.wait(timeout=5)
+    t2.wait(timeout=5)
+    assert co.stats()["inflight_heights"] == 0
+
+
+def test_coalescer_round_robin_fairness():
+    """A one-height request must ride the next flush beside a long
+    request's head, not queue behind its tail."""
+    co, calls = _manual_coalescer()
+    co.max_batch = 4
+    co.acquire(list(range(1, 9)))               # A: 8 heights
+    co.acquire([9])                             # B: 1 height
+    n = co._flush_once()
+    assert n == 4
+    assert 9 in calls[0]
+    co.flush_now()
+
+
+def test_coalescer_cancel_releases_exclusive_heights():
+    co, calls = _manual_coalescer()
+    t = co.acquire([1, 2, 3])
+    t.cancel()
+    st = co.stats()
+    assert st["inflight_heights"] == 0
+    assert st["cancelled_heights"] == 3
+    assert co.flush_now() == 0                  # nothing left to verify
+    assert not calls
+    # a SHARED height survives one claimant's cancellation
+    t1 = co.acquire([7])
+    t2 = co.acquire([7])
+    t2.cancel()
+    assert co.flush_now() == 1
+    t1.wait(timeout=5)
+
+
+def test_coalescer_failure_blames_all_claimants_then_clears():
+    boom = LightServeError("height 6 forged")
+    co, _ = _manual_coalescer(results={6: boom})
+    t1 = co.acquire([5, 6])
+    t2 = co.acquire([6])
+    co.flush_now()
+    with pytest.raises(LightServeError):
+        t1.wait(timeout=5)
+    with pytest.raises(LightServeError):
+        t2.wait(timeout=5)
+    # failures are not sticky: the entry is gone, a retry re-enqueues
+    assert co.stats()["inflight_heights"] == 0
+    t3 = co.acquire([6])
+    assert t3.futures[6] is not t2.futures[6]
+    t3.cancel()
+
+
+def test_coalescer_background_flusher_and_close():
+    """With the real flusher thread: concurrent waiters resolve, and
+    close() joins the thread (thread-leak sanitizer) then drains any
+    stragglers so no future hangs."""
+    co, _ = _manual_coalescer()
+    co.window_s = 0.001
+    co._thread = threading.Thread(target=co._run,
+                                  name="lightserve-flush", daemon=True)
+    co._thread.start()
+    tickets = [co.acquire([h, h + 1]) for h in range(1, 6)]
+    for t in tickets:
+        t.wait(timeout=10)
+    thread = co._thread
+    co.close()
+    assert not thread.is_alive()
+    with pytest.raises(RuntimeError):
+        co.acquire([99])
+
+
+# ---------------------------------------------------------------------------
+# payload codec + serving session
+# ---------------------------------------------------------------------------
+
+def test_payload_roundtrip_and_client_side_verify(served):
+    _, genesis = served
+    sess = _session(served, coalesce=False)
+    try:
+        blob = sess.payload_bytes(8)
+        obj = decode_payload(blob)
+        assert obj["height"] == "8"
+        assert obj["signed_header"]["header"]["height"] == "8"
+        assert obj["validator_set"]["validators"]
+        # full client-side verify_commit over the wire bytes
+        verify_payload(genesis.chain_id, blob)
+        # any tampering breaks it: flip one byte anywhere
+        bad = bytearray(blob)
+        i = bad.index(b'"signature"') + 20
+        bad[i] ^= 1
+        with pytest.raises(Exception):
+            verify_payload(genesis.chain_id, bytes(bad))
+    finally:
+        sess.close()
+
+
+def test_session_serves_verified_path(served):
+    sess = _session(served, coalesce=False)
+    try:
+        path, blobs = sess.serve(1, BLOCKS)
+        assert path == skip_path(1, BLOCKS)
+        assert len(blobs) == len(path)
+        assert sess.verify_windows >= 1 and sess.verify_sigs > 0
+        st = sess.status()
+        assert st["requests"] == "1"
+        assert st["coalescing"] is False
+    finally:
+        sess.close()
+
+
+def test_session_rejects_bad_ranges(served):
+    sess = _session(served, coalesce=False)
+    try:
+        with pytest.raises(LightServeError):
+            sess.serve(BLOCKS, 3)               # trusted >= target
+        with pytest.raises(LightServeError):
+            sess.serve(1, BLOCKS + 500)         # beyond the tip
+        with pytest.raises(LightServeError):
+            sess.serve(0, BLOCKS)               # non-positive trust
+    finally:
+        sess.close()
+
+
+def _tamper_commit_for(sess, bad_h):
+    import dataclasses
+
+    orig = sess._commit_for
+
+    def tampered(h):
+        commit = orig(h)
+        if h == bad_h and commit is not None:
+            commit = copy.deepcopy(commit)
+            cs = commit.signatures[0]
+            commit.signatures[0] = dataclasses.replace(
+                cs, signature=cs.signature[:-1]
+                + bytes([cs.signature[-1] ^ 1]))
+        return commit
+
+    sess._commit_for = tampered
+
+
+def test_forged_commit_blames_only_requests_needing_it(served):
+    """One forged commit in a merged flush must fail exactly the
+    requests whose paths cross that height — per-height blame, not
+    whole-flush blame — and the failure is ErrInvalidSignature from
+    the real device/host verify verdict."""
+    from cometbft_tpu.types import validation
+
+    sess = _session(served, coalesce=True, window_ms=20)
+    bad_h = skip_path(1, BLOCKS)[0]
+    _tamper_commit_for(sess, bad_h)
+    try:
+        results = {}
+
+        def ask(name, trusted, target):
+            try:
+                results[name] = sess.serve(trusted, target)
+            except Exception as e:
+                results[name] = e
+
+        # both requests land in the same accumulation window
+        t1 = threading.Thread(target=ask, args=("crosses", 1, BLOCKS))
+        t2 = threading.Thread(
+            target=ask, args=("clean", BLOCKS - 1, BLOCKS))
+        t1.start(); t2.start()
+        t1.join(30); t2.join(30)
+        assert isinstance(results["crosses"],
+                          validation.ErrInvalidSignature)
+        path, blobs = results["clean"]
+        assert path == [BLOCKS] and len(blobs) == 1
+        assert sess.failed_heights >= 1
+    finally:
+        sess.close()
+
+
+def test_coalesced_and_direct_serving_bit_identical(served):
+    """The A/B parity pin at unit scale: the same requests served with
+    coalescing on and off return byte-identical payloads, and the
+    coalesced session spends fewer verify windows."""
+    reqs = [(1, BLOCKS), (2, BLOCKS), (1, BLOCKS - 1), (5, BLOCKS),
+            (BLOCKS - 2, BLOCKS)]
+    sess_off = _session(served, coalesce=False)
+    try:
+        served_off = [sess_off.serve(t, g) for t, g in reqs]
+        windows_off = sess_off.verify_windows
+    finally:
+        sess_off.close()
+
+    sess_on = _session(served, coalesce=True, window_ms=10)
+    try:
+        out = [None] * len(reqs)
+
+        def one(i, t, g):
+            out[i] = sess_on.serve(t, g)
+
+        threads = [threading.Thread(target=one, args=(i, t, g))
+                   for i, (t, g) in enumerate(reqs)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(30)
+        assert out == served_off                # bit-identical blobs
+        assert sess_on.verify_windows < windows_off
+        assert sess_on.coalescer.stats()["coalesced"] > 0
+    finally:
+        sess_on.close()
+
+
+# ---------------------------------------------------------------------------
+# RPC routes over a live node
+# ---------------------------------------------------------------------------
+
+def test_rpc_light_sync_and_status_routes(served):
+    src, genesis = served
+    addr = src.start_rpc()
+    try:
+        url = (f"http://{addr}/light_sync?trusted_height=1"
+               f"&target_height={BLOCKS}")
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            out = json.loads(resp.read().decode())["result"]
+        assert out["target_height"] == str(BLOCKS)
+        assert [int(h) for h in out["path"]] == skip_path(1, BLOCKS)
+        assert len(out["light_blocks"]) == len(out["path"])
+        # the wire objects re-encode canonically to verifiable payloads
+        for lb in out["light_blocks"]:
+            blob = json.dumps(lb, sort_keys=True,
+                              separators=(",", ":")).encode()
+            verify_payload(genesis.chain_id, blob)
+
+        with urllib.request.urlopen(f"http://{addr}/light_status",
+                                    timeout=30) as resp:
+            st = json.loads(resp.read().decode())["result"]
+        assert st["chain_id"] == genesis.chain_id
+        assert int(st["requests"]) >= 1
+        assert isinstance(st["coalescing"], bool)
+    finally:
+        src.stop()
+
+
+def test_openapi_declares_lightserve_routes():
+    import pathlib
+    spec = pathlib.Path(__file__).resolve().parent.parent / \
+        "cometbft_tpu" / "rpc" / "openapi.yaml"
+    text = spec.read_text()
+    assert "/light_sync:" in text and "/light_status:" in text
+    assert "LightSyncResult" in text and "LightStatusResult" in text
+
+
+# ---------------------------------------------------------------------------
+# fleet A/B
+# ---------------------------------------------------------------------------
+
+def test_fleet_ab_small_parity():
+    """Tier-1 scale of the acceptance A/B: same-seed fleet served with
+    coalescing off then on — bit-identical digests, every client
+    served, strictly fewer verify dispatches (all asserted inside
+    bench_lightserve_fleet, which raises on any violation)."""
+    from cometbft_tpu.simnet.bench import bench_lightserve_fleet
+    rec = bench_lightserve_fleet(n_clients=48, n_blocks=12, n_vals=4,
+                                 seed=23, workers=8)
+    assert rec["digest_parity"] is True
+    assert rec["verify_windows_on"] < rec["verify_windows_off"]
+    assert rec["verify_sigs_on"] < rec["verify_sigs_off"]
+    assert rec["light_clients_served_per_sec"] > 0
+    assert rec["light_serve_p99_ms"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_soak_10k_clients_3x():
+    """The acceptance soak: a 10k+ client fleet against one serving
+    node, coalescing ON vs OFF on the same seed — bit-identical
+    headers, >= 3x clients/s, reduced verify dispatch."""
+    from cometbft_tpu.simnet.bench import bench_lightserve_fleet
+    rec = bench_lightserve_fleet(n_clients=10_000, n_blocks=48,
+                                 n_vals=4, seed=23)
+    assert rec["clients"] == 10_000
+    assert rec["digest_parity"] is True
+    assert rec["coalesce_ratio"] >= 3.0, rec
+    assert rec["verify_windows_on"] < rec["verify_windows_off"]
+    assert rec["verify_sigs_on"] < rec["verify_sigs_off"]

@@ -571,6 +571,42 @@ class TestPerfGate:
                       history, tolerance=0.15, last_n=3, min_points=2)
         assert all(r["status"] == "ok" for r in ok)
 
+    def test_lightserve_p99_gates_lower_is_better(self):
+        """light_serve_p99_ms (lightserve fleet A/B: ON-arm p99 serve
+        latency) gates lower-is-better — the coalescer exists to cut
+        the tail, so the tail growing is the regression; the
+        clients/s companion gates in the default higher-is-better
+        direction."""
+        mod = self._load()
+        assert "light_serve_p99_ms" in mod.LOWER_IS_BETTER
+        assert "light_clients_served_per_sec" not in mod.LOWER_IS_BETTER
+        assert "light_clients_served_per_sec" not in mod.SKIP
+        history = [{"headline": 100.0,
+                    "light_serve_p99_ms": 60.0,
+                    "light_clients_served_per_sec": 400.0}
+                   for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "light_serve_p99_ms": 95.0,
+                         "light_clients_served_per_sec": 400.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["light_serve_p99_ms"]["status"] == "regressed"
+        assert by["light_clients_served_per_sec"]["status"] == "ok"
+        ok = mod.gate({"headline": 100.0,
+                       "light_serve_p99_ms": 40.0,
+                       "light_clients_served_per_sec": 420.0},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
+        rows = mod.gate({"headline": 100.0,
+                         "light_serve_p99_ms": 60.0,
+                         "light_clients_served_per_sec": 100.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["light_clients_served_per_sec"]["status"] == \
+            "regressed"
+
     def test_usage_errors_exit_2(self, tmp_path):
         import json
         mod = self._load()
