@@ -604,6 +604,28 @@ def _live_stamp() -> str:
     return when
 
 
+def _capture_rev() -> str:
+    """Git rev of the tree THIS capture runs from.  Stamped into every
+    fresh capture's extras so scripts/perf_report.py and perf_gate.py
+    can warn when BENCH_live.json predates the newest checked-in round
+    (a stale live capture silently underselling a newer tree)."""
+    try:
+        import subprocess
+        repo = os.path.dirname(LIVE_PATH)
+        r = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=30,
+                           cwd=repo)
+        if r.returncode != 0 or not r.stdout.strip():
+            return "unknown"
+        rev = r.stdout.strip()
+        dirty = subprocess.run(
+            ["git", "diff", "--quiet"], cwd=repo,
+            timeout=30).returncode != 0
+        return rev + ("-dirty" if dirty else "")
+    except Exception:
+        return "unknown"
+
+
 def _carry_fallback(diag: str) -> None:
     """Last resort when the relay stays unreachable for the WHOLE probe
     envelope: emit the most recent committed on-hardware capture,
@@ -923,6 +945,7 @@ def main() -> None:
     extra = {
         "rlc_batch": batch,
         "rlc_keys": "distinct (one per signature)",
+        "capture_git_rev": _capture_rev(),
         "headline_passes": passes,
         # the whole spread, not just the max (r4 advisor): readers can
         # tell a stable number from a lucky pass
@@ -974,6 +997,8 @@ def main() -> None:
         ("light_e2e_headers_per_sec", "light_e2e_config"),
         ("light_clients_served_per_sec", "light_serve_config"),
         ("light_serve_p99_ms", None),
+        ("vote_verify_p99_ms", "verify_contention_config"),
+        ("bulk_verify_p99_ms", None),
         ("chaos_recovery_seconds", "chaos_config"),
         ("chaos_faulted_blocks_per_sec", None),
         ("chaos_flap_recovery_seconds", None),
@@ -1302,6 +1327,39 @@ def main() -> None:
                       "verify_windows_off", "verify_windows_on",
                       "verify_sigs_off", "verify_sigs_on",
                       "clients", "blocks", "validators")}
+        _sync_carried()
+        persist()
+    # verify-latency contention A/B (libs/latledger.py): three tenants
+    # share ONE VerifyPipeline; the vote-path p99 under contention is
+    # the gated number (LOWER is better, scripts/perf_gate.py) with the
+    # bulk p99 beside it, and the full per-consumer submit->resolve
+    # decomposition rides in verify_latency_detail.  Every sampled
+    # request's segments sum EXACTLY to its wall (asserted inside).
+    run_extra("vote_verify_p99_ms",
+              lambda: round(_simbench.bench_verify_contention()
+                            ["vote_verify_p99_ms"], 3),
+              "verify_contention_config",
+              "contention A/B on one shared pipeline: consensus"
+              " single-vote stream solo vs beside blocksync bulk"
+              " windows + lightserve bursts from their own threads;"
+              " verdict cache forced off; per-request decomposition"
+              " sums exactly to wall (SIMNET_CONTENTION_* overrides,"
+              " defaults 192 votes, 12x64 bulk, 32 light)")
+    _last_cont = getattr(_simbench, "last_contention", None)
+    if ("vote_verify_p99_ms" not in carried_keys
+            and isinstance(extra.get("vote_verify_p99_ms"), (int, float))
+            and isinstance(_last_cont, dict)):
+        bulk = _last_cont.get("bulk_verify_p99_ms")
+        if isinstance(bulk, (int, float)):
+            extra["bulk_verify_p99_ms"] = round(bulk, 3)
+            carried_keys.discard("bulk_verify_p99_ms")
+        extra["verify_latency_detail"] = {
+            k: _last_cont.get(k)
+            for k in ("vote_verify_p99_ms_solo", "vote_verify_p50_ms",
+                      "vote_p99_contention_ratio", "votes",
+                      "bulk_windows", "bulk_window_size",
+                      "light_requests", "seed", "depth",
+                      "solo", "contended")}
         _sync_carried()
         persist()
     run_extra("consensus_e2e_blocks_per_sec",

@@ -132,13 +132,22 @@ def _verify_one(pk, msg: bytes, sig: bytes) -> bool:
     return _host_verify(_pk_bytes(pk), msg, sig)
 
 
+def _lat_stamp(handle: "WindowHandle", name: str) -> None:
+    """Stamp a lifecycle cut on every latency-ledger request riding
+    this window (libs/latledger.py); free when none are attached."""
+    lat = handle.lat
+    if lat:
+        for req in lat:
+            req.stamp(name)
+
+
 class WindowHandle:
     """Future for one submitted window; resolves to (ok, verdicts)
     in submission order.  `path` records how the verdicts were
     produced once resolved: device / host / drain."""
 
     __slots__ = ("_future", "ctx", "subsystem", "path", "n",
-                 "submitted_at", "resolved_at")
+                 "submitted_at", "resolved_at", "lat")
 
     def __init__(self, n: int, subsystem: str, ctx):
         # TrackedFuture is the sanitizer seam: a window future that
@@ -152,6 +161,11 @@ class WindowHandle:
         self.n = n
         self.submitted_at = time.monotonic()
         self.resolved_at: float | None = None
+        # latency-ledger requests riding this window (None when the
+        # ledger is off); committed — per request, with the window's
+        # resolution path — the moment the future resolves, on
+        # whichever thread resolved it
+        self.lat: list | None = None
 
     def result(self, timeout: float | None = None):
         return self._future.result(timeout)
@@ -175,6 +189,9 @@ class WindowHandle:
                 self._future.set_result((ok, list(verdicts)))
         except Exception:      # lost the watchdog race mid-set
             pass
+        if self.lat:
+            for req in self.lat:
+                req.resolve(path)
 
     def _fail(self, exc: BaseException) -> None:
         if self._future.done():
@@ -185,6 +202,9 @@ class WindowHandle:
                 self._future.set_exception(exc)
         except Exception:      # lost the watchdog race mid-set
             pass
+        if self.lat:
+            for req in self.lat:
+                req.resolve("error")
 
 
 class _Window:
@@ -353,9 +373,11 @@ class VerifyPipeline(BaseService):
         with self._cv:
             leftovers, self._windows = list(self._windows), []
         for w in leftovers:
+            t0 = time.monotonic()
             ok, verdicts = self._host_fallback(w)
             ok, verdicts = self._merge_cache(w, ok, verdicts)
             w.handle._resolve(ok, verdicts, "host")
+            self._record_flush(w, "host", t0)
             try:
                 self._slots.release()
             except ValueError:  # pragma: no cover
@@ -501,17 +523,36 @@ class VerifyPipeline(BaseService):
     # -- API ---------------------------------------------------------------
 
     def submit(self, items, *, subsystem: str = "pipeline", ctx=None,
-               device_threshold: int | None = None) -> WindowHandle:
+               device_threshold: int | None = None,
+               lat=None) -> WindowHandle:
         """Queue one window of (pubkey, msg, sig) items; blocks when
         `depth` windows are already unresolved (backpressure).  The
         returned handle resolves — in submission order — to
-        (ok, verdicts) with one bool per item."""
+        (ok, verdicts) with one bool per item.
+
+        `lat` threads caller-created latency-ledger requests
+        (libs/latledger.py) onto the window so a seam that already
+        stamped its own queue wait (votestream, the light coalescer)
+        is not double-counted; None (the default) opens one ledger
+        request covering the whole window when a recorder is
+        installed."""
         if device_threshold is None:
             from . import batch as cb
 
             device_threshold = cb.DEVICE_THRESHOLD
+        from . import sigcache
+
         items = list(items)
         handle = WindowHandle(len(items), subsystem, ctx)
+        if lat is None and items:
+            from ..libs import latledger
+
+            req = latledger.submit(
+                len(items),
+                consumer=subsystem if subsystem in sigcache.CONSUMERS
+                else None)
+            lat = [req] if req is not None else None
+        handle.lat = lat
         if not items:
             handle._resolve(False, [], "host")
             return handle
@@ -519,8 +560,6 @@ class VerifyPipeline(BaseService):
         # stage and dispatch; cached verdicts merge back at window
         # publication.  A fully-cached window resolves RIGHT HERE —
         # no slot, no staging, no device.
-        from . import sigcache
-
         cached = None
         misses = items
         if sigcache.enabled():
@@ -603,6 +642,7 @@ class VerifyPipeline(BaseService):
                 ed.device_hash_enabled()
                 and os.environ.get("COMETBFT_TPU_PROVIDER",
                                    "auto") != "cpu") else "host_pack"
+            _lat_stamp(win.handle, "stage_start")
             try:
                 with libtrace.span(win.handle.subsystem, stage_span,
                                    inflight=len(self._windows)), \
@@ -614,6 +654,7 @@ class VerifyPipeline(BaseService):
                 # a staging failure must not wedge the queue: route the
                 # window to the host path for verdicts
                 win.mode = "host"
+            _lat_stamp(win.handle, "stage_end")
             with self._cv:
                 win.staged = True
                 self._cv.notify_all()
@@ -723,6 +764,7 @@ class VerifyPipeline(BaseService):
                         win = self._windows[0]
                         win.dispatching = True
                         win.dispatch_started = time.monotonic()
+                        _lat_stamp(win.handle, "dispatch")
                         break
                     if self._stopping and not self._windows:
                         return
@@ -851,6 +893,9 @@ class VerifyPipeline(BaseService):
         if dm is not None:
             dm.flushes.labels("cache").inc()
             dm.batch_size.labels("cache").observe(n)
+            if handle.resolved_at is not None:
+                dm.flush_latency_seconds.labels("cache").observe(
+                    handle.resolved_at - handle.submitted_at)
         flightrec.record(
             flightrec.EV_VERIFY_FLUSH, path="cache", batch=n,
             cache_hits=n, subsystem=handle.subsystem,
@@ -866,7 +911,8 @@ class VerifyPipeline(BaseService):
         if dm is not None:
             dm.flushes.labels(path).inc()
             dm.batch_size.labels(path).observe(len(win.items))
-            dm.flush_latency_seconds.observe(time.monotonic() - t0)
+            dm.flush_latency_seconds.labels(path).observe(
+                time.monotonic() - t0)
             if self.devices is not None and path == "device":
                 dm.mesh_dispatches.labels(
                     str(win.device_index)).inc()
@@ -899,6 +945,7 @@ class VerifyPipeline(BaseService):
                 # the watchdog already host-resolved this window
                 return
             win.device_s = time.monotonic() - t0
+            _lat_stamp(win.handle, "compute_end")
             ok, verdicts = self._merge_cache(win, ok, verdicts)
             win.handle._resolve(ok, verdicts, path)
         except BaseException as e:  # pragma: no cover - defensive
@@ -945,6 +992,7 @@ class VerifyPipeline(BaseService):
                     if win is not None:
                         win.dispatching = True
                         win.dispatch_started = time.monotonic()
+                        _lat_stamp(win.handle, "dispatch")
                         break
                     if self._stopping and not any(
                             w.device_index == idx and w.result is None
@@ -978,6 +1026,7 @@ class VerifyPipeline(BaseService):
                         win, faulted, device=self.devices[idx],
                         device_index=idx, quarantined=quarantined)
                 win.device_s = time.monotonic() - t0
+                _lat_stamp(win.handle, "compute_end")
                 ok, verdicts = self._merge_cache(win, ok, verdicts)
                 with self._cv:
                     if gen != self._gens.get(dev, 0) or win.abandoned:

@@ -51,6 +51,18 @@ STRIPES = 16
 # a lookup below any labeled seam.
 _tls = threading.local()
 
+# the CLOSED consumer registry: every literal label handed to
+# consumer(...) across the package, every key in
+# latledger.DEFAULT_SLO_TARGETS, and every per-consumer metrics/ledger
+# series key must come from this set (scripts/check_metrics.py rule 8
+# lints both directions).  "crypto" is the unlabeled default; "bench"
+# is the bench drivers' label; "probe" marks devhealth known-answer
+# batches.
+CONSUMERS = frozenset({
+    "consensus", "blocksync", "light", "lightserve", "evidence",
+    "crypto", "bench", "probe",
+})
+
 
 class consumer:
     """Context manager labeling cache traffic with the product path

@@ -126,8 +126,10 @@ class StreamingVerifier(BaseService):
                 items.append((pub, msg, ref.sign(seed, msg)))
             pipe = self._pipeline if self._pipeline is not None \
                 else default_pipeline()
+            # lat=() opts the warmup window out of the latency ledger:
+            # a 300s compile row would poison the consensus p99
             handle = pipe.submit(items, subsystem="consensus",
-                                 device_threshold=2)
+                                 device_threshold=2, lat=())
             handle.result(timeout=300)
         except Exception:  # pragma: no cover - warmup must never wedge
             pass
@@ -159,18 +161,27 @@ class StreamingVerifier(BaseService):
           second peer flooding the same vote) — the existing future is
           returned, one device verification serves both."""
         from . import sigcache
+        from ..libs import latledger
 
         fut: Future = lockrank.TrackedFuture()
+        # one latency-ledger request per submitted vote: resolved at
+        # whichever seam answers (cache here, host/device at flush, or
+        # coalesced onto the original's resolution)
+        req = latledger.submit(1, consumer="consensus")
         if sigcache.enabled():
             v = sigcache.get(pubkey, msg, sig, key_type="ed25519",
                              label="consensus")
             if v is not None:
                 self.cache_hits += 1
                 fut.set_result(v)
+                if req is not None:
+                    req.resolve("cache")
                 return fut
         with self._cv:
             if self._stopping or self._thread is None:
                 fut.set_result(_host_verify(pubkey, msg, sig))
+                if req is not None:
+                    req.resolve("host")
                 return fut
             triple = (pubkey, msg, sig)
             existing = self._inflight.get(triple)
@@ -181,13 +192,19 @@ class StreamingVerifier(BaseService):
                 cm = libmetrics.cache_metrics()
                 if cm is not None:
                     cm.votestream_coalesced.inc()
+                if req is not None:
+                    # the duplicate's whole wait is the original's
+                    # resolution: its row lands as coalesce_wait, and
+                    # the original keeps its own decomposition
+                    existing.add_done_callback(
+                        lambda f, r=req: r.resolve_coalesced())
                 return existing
             self._inflight[triple] = fut
             # the done-callback fires on resolve AND on cancel, so a
             # canceled slot stops absorbing new duplicates
             fut.add_done_callback(
                 lambda f, t=triple: self._forget(t, f))
-            self._pending.append((pubkey, msg, sig, fut, ctx))
+            self._pending.append((pubkey, msg, sig, fut, ctx, req))
             self._cv.notify()
         return fut
 
@@ -257,6 +274,8 @@ class StreamingVerifier(BaseService):
             for b, v in zip(batch, verdicts):
                 if v is not None and b[3].set_running_or_notify_cancel():
                     b[3].set_result(v)
+                    if b[5] is not None:
+                        b[5].resolve("cache")
             cache_hits = len(batch) - len(miss_idx)
             batch = [batch[i] for i in miss_idx]
             if not batch:
@@ -299,23 +318,32 @@ class StreamingVerifier(BaseService):
         with libtrace.span("consensus", "verify_dispatch"), \
                 tracetl.span_for(self, "consensus", "verify_dispatch",
                                  cache=cache_hits):
-            for pk, msg, sig, fut, _ in batch:
+            for pk, msg, sig, fut, _, req in batch:
                 # verdict first, future second: a consumer that
                 # cancel-raced this flush (Preverified.verdict_for)
                 # still gets the verdict CACHED, so its inline
                 # re-verify is the last time the triple costs anything
+                # (earlier votes' verify time IS this vote's queue
+                # wait — the dispatch stamp cuts per vote)
+                if req is not None:
+                    req.stamp("dispatch")
                 v = _host_verify(pk, msg, sig)
                 sigcache.insert(pk, msg, sig, v, key_type="ed25519",
                                 label="consensus")
+                if req is not None:
+                    req.stamp("compute_end")
                 if fut.set_running_or_notify_cancel():
                     fut.set_result(v)
+                if req is not None:
+                    req.resolve(path)
         if dp is not None:
             dp.advance("0", libdevprof.BUSY, path=path)
         dm = libmetrics.device_metrics()
         if dm is not None:
             dm.flushes.labels(path).inc()
             dm.batch_size.labels(path).observe(len(batch))
-            dm.flush_latency_seconds.observe(time.monotonic() - t0)
+            dm.flush_latency_seconds.labels(path).observe(
+                time.monotonic() - t0)
         flightrec.record(flightrec.EV_VERIFY_FLUSH, path=path,
                          batch=len(batch), inflight=0, staged=0,
                          cache_hits=cache_hits,
@@ -333,10 +361,15 @@ class StreamingVerifier(BaseService):
         self.device_flushes += 1
         pipe = self._pipeline if self._pipeline is not None \
             else default_pipeline()
+        # the per-vote ledger requests ride the window: the pipeline
+        # stamps staging/dispatch/compute and resolves each with the
+        # window's path, so queue_wait covers the pending-queue wait
+        # from the ORIGINAL submit, not the flush
+        lat = [b[5] for b in batch if b[5] is not None] or None
         handle = pipe.submit(
-            [(pk, msg, sig) for pk, msg, sig, _, _ in batch],
+            [(pk, msg, sig) for pk, msg, sig, *_ in batch],
             subsystem="consensus", device_threshold=2,
-            ctx=_batch_ctx(batch))
+            ctx=_batch_ctx(batch), lat=lat)
 
         def _resolve(h):
             from . import sigcache
@@ -346,7 +379,7 @@ class StreamingVerifier(BaseService):
             except Exception:           # pragma: no cover - defensive
                 verdicts = None
             if verdicts is None:
-                for pk, msg, sig, fut, _ in batch:
+                for pk, msg, sig, fut, _, _ in batch:
                     v = _host_verify(pk, msg, sig)
                     sigcache.insert(pk, msg, sig, v,
                                     key_type="ed25519",
@@ -357,7 +390,7 @@ class StreamingVerifier(BaseService):
             # verdicts for cancel-raced futures were inserted into the
             # verdict cache by the pipeline at window publication —
             # nothing re-verifies them even though set_running fails
-            for (_, _, _, fut, _), ok in zip(batch, verdicts):
+            for (_, _, _, fut, _, _), ok in zip(batch, verdicts):
                 if fut.set_running_or_notify_cancel():
                     fut.set_result(bool(ok))
 

@@ -79,6 +79,13 @@ EV_LIGHTSERVE_REJECT = "lightserve_reject"  # the serving plane caught
 #                                       a merged flush: that height's
 #                                       requests fail, nothing is
 #                                       served past it
+EV_SLO_BURN = "slo_burn"             # latency-ledger SLO burn
+#                                       (libs/latledger.py): a
+#                                       consumer's short-window burn
+#                                       rate tripped its declared p99
+#                                       target budget; sustained=True
+#                                       after consecutive trips (auto
+#                                       dump-to-log)
 
 
 class FlightRecorder:

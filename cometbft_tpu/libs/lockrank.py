@@ -146,6 +146,10 @@ LOCK_RANKS: dict[str, int] = {
     # observability rings (leaf-most product locks: recordable from
     # under any of the above)
     "devprof.ring": 490,
+    # latledger sits OUTSIDE flightrec: committing a row under the
+    # ring lock may record an EV_SLO_BURN event (latledger.py _commit
+    # -> SLOTracker.on_burn -> flightrec.record)
+    "latledger.ring": 495,
     "flightrec.ring": 500,
     "tracetl.ring": 510,
     "trace.stage": 520,

@@ -13,6 +13,8 @@ Endpoints (GET):
                             idle causes, compile ledger)
   /debug/pprof/devhealth  - device health states (quarantines, probe
                             history, circuit-breaker backoffs)
+  /debug/pprof/latency    - per-consumer verify-latency ledger (request
+                            decomposition rows, histograms, SLO burn)
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
 _ENDPOINTS = ("goroutine", "heap", "profile", "cmdline", "flightrec",
-              "tracetl", "devprof", "devhealth")
+              "tracetl", "devprof", "devhealth", "latency")
 
 
 def _dump_threads() -> str:
@@ -144,6 +146,13 @@ class PprofServer:
                     rec = _dp.recorder()
                     if rec is None:
                         self._text("no devprof recorder installed", 404)
+                    else:
+                        self._text(rec.dump_text())
+                elif name == "latency":
+                    from . import latledger as _ll
+                    rec = _ll.recorder()
+                    if rec is None:
+                        self._text("no latency ledger installed", 404)
                     else:
                         self._text(rec.dump_text())
                 elif name == "devhealth":

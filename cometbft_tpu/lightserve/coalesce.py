@@ -117,6 +117,8 @@ class RequestCoalescer:
     # -- request side ------------------------------------------------------
 
     def acquire(self, heights) -> RequestTicket:
+        from ..libs import latledger
+
         tid = next(self._ids)
         futures: OrderedDict = OrderedDict()
         owned: set[int] = set()
@@ -132,6 +134,15 @@ class RequestCoalescer:
                 if e is not None:
                     e.refs += 1
                     attached += 1
+                    # every claimant on a shared height gets its OWN
+                    # latency-ledger row: the attached request's wait
+                    # is real even though the verify is shared (the
+                    # owner's decomposition rides the merged pipeline
+                    # window; this row lands as coalesce_wait)
+                    req = latledger.submit(1, consumer="lightserve")
+                    if req is not None:
+                        e.future.add_done_callback(
+                            lambda f, r=req: r.resolve_coalesced())
                 else:
                     e = _Entry(lockrank.TrackedFuture())
                     self._entries[h] = e

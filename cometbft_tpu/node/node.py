@@ -352,6 +352,13 @@ class Node(BaseService):
         self.devprof_recorder = libdevprof.DevprofRecorder()
         self.consensus_state.devprof = self.devprof_recorder
 
+        # per-consumer verify-latency ledger (libs/latledger.py):
+        # always-on like devprof, dumpable via the latency RPC route
+        # and /debug/pprof/latency
+        from ..libs import latledger as liblatledger
+        self.latledger_recorder = liblatledger.LatLedgerRecorder()
+        self.consensus_state.latledger = self.latledger_recorder
+
         # device health circuit breaker (crypto/devhealth.py): always-on
         # and process-wide — every VerifyPipeline constructed after this
         # point (and mesh.maybe_split_verify) adopts it, so quarantines
@@ -423,6 +430,9 @@ class Node(BaseService):
             libmetrics.set_devprof_metrics(DevprofMetrics(registry))
             libdevprof.set_recorder(self.devprof_recorder)
             compile_hook.install(self.devprof_recorder)
+            # ... and the crypto layers' request stamps through the
+            # latency ledger's seam
+            liblatledger.set_recorder(self.latledger_recorder)
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -514,6 +524,7 @@ class Node(BaseService):
             # stage-tracer, and flight-recorder seams
             from ..libs import devprof as libdevprof
             from ..libs import flightrec as libflightrec
+            from ..libs import latledger as liblatledger
             from ..libs import metrics as libmetrics
             from ..libs import trace as libtrace
             from ..ops import compile_hook
@@ -523,6 +534,7 @@ class Node(BaseService):
             libtrace.set_tracer(None)
             libflightrec.set_recorder(None)
             libdevprof.set_recorder(None)
+            liblatledger.set_recorder(None)
             compile_hook.uninstall()
         if self.rpc_server is not None:
             self.rpc_server.stop()

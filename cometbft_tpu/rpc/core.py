@@ -369,6 +369,24 @@ class Environment:
             raise RPCError(-32603, "devprof recorder unavailable")
         return rec.dump()
 
+    def latency_handler(self, limit=None) -> dict:
+        """Dump the per-consumer verify-latency ledger
+        (libs/latledger.py): request rows with their exact
+        submit->resolve decomposition, per-consumer histograms, and
+        the SLO burn state.  `limit` keeps only the newest N rows."""
+        rec = getattr(self.consensus_state, "latledger", None)
+        if rec is None:
+            from ..libs import latledger as _ll
+            rec = _ll.recorder()
+        if rec is None:
+            raise RPCError(-32603, "latency ledger unavailable")
+        out = rec.dump()
+        if limit:
+            n = int(limit)
+            if n >= 0:
+                out["rows"] = out["rows"][-n:] if n else []
+        return out
+
     # -- abci --------------------------------------------------------------
     def abci_info(self) -> dict:
         res = self.app_conns.query.info(at.InfoRequest())
@@ -727,6 +745,7 @@ ROUTES = {
     "flightrec": "flightrec_handler",
     "tracetl": "tracetl_handler",
     "devprof": "devprof_handler",
+    "latency": "latency_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

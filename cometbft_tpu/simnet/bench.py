@@ -39,6 +39,7 @@ last_light: dict | None = None
 last_consensus: dict | None = None
 last_cache_ab: dict | None = None
 last_lightserve: dict | None = None
+last_contention: dict | None = None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -546,3 +547,168 @@ def bench_lightserve_fleet(n_clients: int | None = None,
         "wall_s_on": on["wall_s"],
     }
     return last_lightserve
+
+
+def _contention_feed(tag: str, seed: int, windows: int,
+                     window_size: int) -> list:
+    """Seeded signed windows for one contention-bench consumer: same
+    (tag, seed) -> byte-identical feed, so both arms verify exactly
+    the same triples."""
+    import hashlib as _hashlib
+
+    from ..crypto.ed25519 import PrivKey
+
+    feed = []
+    for w in range(windows):
+        items = []
+        for i in range(window_size):
+            sd = _hashlib.sha256(
+                b"contend-%s-%d-%d-%d"
+                % (tag.encode(), seed, w, i)).digest()
+            priv = PrivKey.generate(sd)
+            msg = b"contention-%s-%d-%d" % (tag.encode(), w, i)
+            items.append((priv.pub_key(), msg, priv.sign(msg)))
+        feed.append(items)
+    return feed
+
+
+def bench_verify_contention(n_votes: int | None = None,
+                            bulk_windows: int | None = None,
+                            bulk_window_size: int | None = None,
+                            light_requests: int | None = None,
+                            light_window_size: int = 8,
+                            seed: int = 29,
+                            depth: int = 4,
+                            timeout: float = 240.0,
+                            device_threshold: int | None = None)\
+        -> dict:
+    """A/B the per-request verify latency under multi-tenant
+    contention, over the SAME seeded request feeds: arm SOLO runs the
+    vote stream alone through a fresh VerifyPipeline; arm CONTENDED
+    runs the vote stream while a blocksync-shaped bulk feed and a
+    lightserve-shaped burst share the SAME pipeline from their own
+    threads (>= 3 concurrent consumers, one dispatch queue).
+
+    What the latency ledger (libs/latledger.py) must show: every
+    sampled request's segment decomposition sums EXACTLY to its wall
+    (enforced here — a violation raises), per-consumer p50/p99 for
+    each tenant, and the vote-p99 contention cost as the single
+    number `vote_verify_p99_ms` (gated lower-is-better next to
+    `bulk_verify_p99_ms`).  The signature-verdict cache is forced off
+    so the queueing is real verify work, not cache hits.  Stores the
+    combined record in `last_contention`."""
+    global last_contention
+    n_votes = n_votes if n_votes is not None else _env_int(
+        "SIMNET_CONTENTION_VOTES", 192)
+    bulk_windows = bulk_windows if bulk_windows is not None \
+        else _env_int("SIMNET_CONTENTION_BULK_WINDOWS", 12)
+    bulk_window_size = bulk_window_size if bulk_window_size is not None \
+        else _env_int("SIMNET_CONTENTION_BULK_WINDOW", 64)
+    light_requests = light_requests if light_requests is not None \
+        else _env_int("SIMNET_CONTENTION_LIGHT", 32)
+
+    import threading
+
+    from ..crypto import dispatch
+    from ..libs import latledger
+
+    # one vote per window: the ledger row IS the per-vote latency
+    vote_feed = _contention_feed("votes", seed, n_votes, 1)
+    bulk_feed = _contention_feed("bulk", seed, bulk_windows,
+                                 bulk_window_size)
+    light_feed = _contention_feed("light", seed, light_requests,
+                                  light_window_size)
+
+    def run_arm(contended: bool) -> dict:
+        rec = latledger.LatLedgerRecorder()
+        prev_rec = latledger.recorder()
+        latledger.set_recorder(rec)
+        pipe = dispatch.VerifyPipeline(depth=depth,
+                                       name="ContentionPipe")
+        errors: list = []
+
+        def feed(label: str, windows: list) -> None:
+            # device_threshold pass-through: tier-1 runs pin the host
+            # verify path (no cold device compile inside the timing)
+            try:
+                handles = [pipe.submit(
+                    w, subsystem=label,
+                    device_threshold=device_threshold)
+                    for w in windows]
+                for h in handles:
+                    ok, _ = h.result(timeout=timeout)
+                    if not ok:
+                        raise RuntimeError(
+                            f"{label} window failed verification")
+            except Exception as e:     # surfaced after the join
+                errors.append((label, e))
+
+        pipe.start()
+        try:
+            others = []
+            if contended:
+                others = [
+                    threading.Thread(target=feed,
+                                     args=("blocksync", bulk_feed),
+                                     name="contend-bulk", daemon=True),
+                    threading.Thread(target=feed,
+                                     args=("lightserve", light_feed),
+                                     name="contend-light", daemon=True),
+                ]
+            for t in others:
+                t.start()
+            feed("consensus", vote_feed)
+            for t in others:
+                t.join(timeout=timeout)
+            if any(t.is_alive() for t in others):
+                raise RuntimeError("contention feed thread stalled")
+        finally:
+            pipe.stop()
+            latledger.set_recorder(prev_rec)
+        if errors:
+            raise RuntimeError(f"contention arm failed: {errors}")
+        # the ledger's core contract, enforced on every sampled row:
+        # the decomposition is an EXACT partition of the wall
+        for row in rec.rows():
+            if row["wall"] != sum(row["segs"].values()):
+                raise RuntimeError(
+                    "latency decomposition does not sum to wall: "
+                    f"{row}")
+        return {"consumers": rec.consumers(),
+                "slo": rec.slo.snapshot(),
+                "requests": rec.recorded}
+
+    prev_cache_enabled = sigcache._enabled_override
+    sigcache.set_enabled(False)
+    try:
+        solo = run_arm(contended=False)
+        contended = run_arm(contended=True)
+    finally:
+        sigcache.set_enabled(prev_cache_enabled)
+
+    vote_solo = solo["consumers"].get("consensus", {})
+    vote_load = contended["consumers"].get("consensus", {})
+    bulk_load = contended["consumers"].get("blocksync", {})
+    if len(contended["consumers"]) < 3:
+        raise RuntimeError(
+            "contended arm saw fewer than 3 consumers: "
+            f"{sorted(contended['consumers'])}")
+    last_contention = {
+        "vote_verify_p99_ms": vote_load.get("p99_ms", 0.0),
+        "bulk_verify_p99_ms": bulk_load.get("p99_ms", 0.0),
+        "vote_verify_p99_ms_solo": vote_solo.get("p99_ms", 0.0),
+        "vote_verify_p50_ms": vote_load.get("p50_ms", 0.0),
+        "vote_p99_contention_ratio": round(
+            vote_load.get("p99_ms", 0.0)
+            / vote_solo.get("p99_ms", 1.0), 2)
+        if vote_solo.get("p99_ms") else 0.0,
+        "votes": n_votes,
+        "bulk_windows": bulk_windows,
+        "bulk_window_size": bulk_window_size,
+        "light_requests": light_requests,
+        "seed": seed,
+        "depth": depth,
+        "solo": solo,
+        "contended": contended,
+    }
+    return last_contention

@@ -136,6 +136,16 @@ class TraceSession:
             merged["crypto"] = self.crypto_timeline
         rec = self.devprof_recorder
         counters = rec.counter_samples() if rec is not None else None
+        # per-consumer verify-p99 counter tracks (libs/latledger.py)
+        # render beside the devprof occupancy counters; concatenation
+        # is enough — perfetto_trace normalizes over the union
+        from ..libs import latledger as _ll
+
+        ll = _ll.recorder()
+        if ll is not None:
+            lat = ll.counter_samples()
+            if lat:
+                counters = list(counters or ()) + list(lat)
         return tracetl.perfetto_trace(merged, counters=counters)
 
     def critical_path(self, include_flightrec: bool = True) -> dict:

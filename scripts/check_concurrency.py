@@ -154,6 +154,10 @@ KNOBS = {
     # sanitizer plane (lockrank PR)
     "COMETBFT_TPU_LOCKRANK",
     "COMETBFT_TPU_SANITIZERS",
+    # libs/latledger.py — per-consumer verify-latency ledger
+    "COMETBFT_TPU_LATLEDGER",
+    "COMETBFT_TPU_LATLEDGER_CAPACITY",
+    "COMETBFT_TPU_LATLEDGER_SLO_BURN",
     # simnet
     "SIMNET_CONSENSUS_VALS",
     "SIMNET_CONSENSUS_BLOCKS",
@@ -167,6 +171,8 @@ PREFIX_KNOBS = {
     "SIMNET_BENCH_",
     "SIMNET_LIGHT_",
     "SIMNET_TRACE_",
+    # simnet/bench.py bench_verify_contention scale overrides
+    "SIMNET_CONTENTION_",
 }
 KNOB_RE = re.compile(r"\A(COMETBFT_TPU_|SIMNET_)[A-Z0-9_]*\Z")
 

@@ -32,7 +32,20 @@ Checks:
      `rec.advance(dev, "<state>")` occupancy states against
      devprof.STATES (BUSY + IDLE_CAUSES, which now include the
      `quarantine` idle cause) — a misspelled state would silently
-     split a gauge series or pool idle time under the wrong cause.
+     split a gauge series or pool idle time under the wrong cause;
+  8. histogram bucket layouts and verify-consumer labels are CLOSED
+     registries.  Every `*_seconds` / `*_ms` histogram must take its
+     buckets from metrics.BUCKET_SCHEMES (literal
+     `buckets=BUCKET_SCHEMES["<key>"]`, or omit buckets for the
+     implicit default scheme) — ad-hoc bucket tuples fracture
+     cross-metric latency comparisons and break histogram merging in
+     dashboards.  And every literal verify-plane consumer label —
+     `sigcache.consumer("<label>")` scopes, `latledger.submit(...,
+     consumer="<label>")` rows — must be registered in
+     sigcache.CONSUMERS, and every latledger.DEFAULT_SLO_TARGETS key
+     must too (both directions of the shared registry): an
+     unregistered label would silently fork a per-consumer latency
+     series the SLO tracker never watches.
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -49,6 +62,8 @@ REPO = Path(__file__).resolve().parent.parent
 METRICS_PY = REPO / "cometbft_tpu" / "libs" / "metrics.py"
 DEVPROF_PY = REPO / "cometbft_tpu" / "libs" / "devprof.py"
 DEVHEALTH_PY = REPO / "cometbft_tpu" / "crypto" / "devhealth.py"
+SIGCACHE_PY = REPO / "cometbft_tpu" / "crypto" / "sigcache.py"
+LATLEDGER_PY = REPO / "cometbft_tpu" / "libs" / "latledger.py"
 SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
 REG_METHODS = ("counter", "gauge", "histogram")
 # the reference's own p2p metrics label a camelCase chID; renaming it
@@ -84,6 +99,11 @@ def registered_metrics(path: Path | None = None) -> list[dict]:
                     for a in args[:2]):
                 continue
             labels = None
+            # buckets kwarg classification for rule 8: None = absent
+            # (implicit default scheme), "<key>" = a literal
+            # BUCKET_SCHEMES["<key>"] subscript, False = anything else
+            # (an ad-hoc layout the closed registry does not know)
+            buckets_scheme = None
             for kw in call.keywords:
                 if kw.arg == "labels" and isinstance(
                         kw.value, (ast.Tuple, ast.List)):
@@ -91,9 +111,22 @@ def registered_metrics(path: Path | None = None) -> list[dict]:
                     if all(isinstance(e, ast.Constant)
                            and isinstance(e.value, str) for e in elts):
                         labels = [e.value for e in elts]
+                if kw.arg == "buckets":
+                    buckets_scheme = False
+                    v = kw.value
+                    if isinstance(v, ast.Subscript) and \
+                            isinstance(v.value, ast.Name) and \
+                            v.value.id == "BUCKET_SCHEMES":
+                        sl = v.slice
+                        if isinstance(sl, ast.Index):  # pre-3.9 trees
+                            sl = sl.value
+                        if isinstance(sl, ast.Constant) and \
+                                isinstance(sl.value, str):
+                            buckets_scheme = sl.value
             out.append({"cls": cls.name, "attr": target.attr,
                         "kind": fn.attr, "subsystem": args[0].value,
                         "name": args[1].value, "labels": labels,
+                        "buckets_scheme": buckets_scheme,
                         "lineno": node.lineno})
     return out
 
@@ -274,6 +307,154 @@ def run_label_checks(root: Path | None = None,
     return findings
 
 
+def registered_bucket_schemes(path: Path | None = None) -> set:
+    """Literal keys of metrics.BUCKET_SCHEMES — the closed registry of
+    histogram bucket layouts behind rule 8.  AST only, same no-import
+    discipline as every parser here."""
+    tree = ast.parse((path or METRICS_PY).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign):
+            target, value = node.targets[0], node.value
+        else:
+            continue
+        if isinstance(target, ast.Name) and \
+                target.id == "BUCKET_SCHEMES" and \
+                isinstance(value, ast.Dict):
+            return {k.value for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return set()
+
+
+def registered_consumers(path: Path | None = None) -> set:
+    """sigcache.CONSUMERS — the closed verify-consumer vocabulary the
+    per-consumer latency ledger (libs/latledger.py) shares with the
+    signature-verdict cache's attribution scopes."""
+    tree = ast.parse((path or SIGCACHE_PY).read_text())
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "CONSUMERS"):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and v.args:
+            v = v.args[0]                    # frozenset({...})
+        if isinstance(v, (ast.Set, ast.Tuple, ast.List)):
+            return {e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+def slo_target_keys(path: Path | None = None) -> list[tuple[str, int]]:
+    """(key, lineno) for every literal latledger.DEFAULT_SLO_TARGETS
+    key — the registry's other direction: an SLO target for a consumer
+    sigcache never attributes would burn against an empty series."""
+    tree = ast.parse((path or LATLEDGER_PY).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign):
+            target, value = node.targets[0], node.value
+        else:
+            continue
+        if isinstance(target, ast.Name) and \
+                target.id == "DEFAULT_SLO_TARGETS" and \
+                isinstance(value, ast.Dict):
+            return [(k.value, k.lineno) for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+    return []
+
+
+def consumer_call_sites(root: Path | None = None) -> list[dict]:
+    """[{file, lineno, value}] for every literal consumer label:
+    `*.consumer("<label>")` scopes and `*.submit(...,
+    consumer="<label>")` ledger rows under ``root`` (default
+    cometbft_tpu/).  Variables forward already-linted labels."""
+    root = root or (REPO / "cometbft_tpu")
+    sites = []
+    for py in sorted(root.rglob("*.py")):
+        tree = ast.parse(py.read_text())
+        rel = str(py.relative_to(root.parent if root.is_dir() else root))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name == "consumer" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                sites.append({"file": rel, "lineno": node.lineno,
+                              "value": node.args[0].value})
+            if name == "submit":
+                for kw in node.keywords:
+                    if kw.arg == "consumer" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        sites.append({"file": rel,
+                                      "lineno": node.lineno,
+                                      "value": kw.value.value})
+    return sites
+
+
+def run_registry_checks(root: Path | None = None,
+                        metrics_path: Path | None = None,
+                        sigcache_path: Path | None = None,
+                        latledger_path: Path | None = None) -> list[str]:
+    """Rule 8 findings: bucket layouts and consumer labels against
+    their closed registries."""
+    findings = []
+    schemes = registered_bucket_schemes(metrics_path)
+    if not schemes:
+        findings.append("metrics.BUCKET_SCHEMES not found or empty "
+                        "(rule 8 parser broken?)")
+    for m in registered_metrics(metrics_path):
+        if m["kind"] != "histogram":
+            continue
+        if not (m["name"].endswith("_seconds")
+                or m["name"].endswith("_ms")):
+            continue
+        bs = m["buckets_scheme"]
+        full = f"{m['subsystem']}_{m['name']}"
+        if bs is None:
+            continue            # implicit default scheme
+        if bs is False:
+            findings.append(
+                f"{m['cls']}.{m['attr']} ({full}, line {m['lineno']}): "
+                "duration histogram must take buckets from the closed "
+                "registry (buckets=BUCKET_SCHEMES[\"<key>\"]) or omit "
+                "them — ad-hoc layouts fracture cross-metric latency "
+                "comparison")
+        elif bs not in schemes:
+            findings.append(
+                f"{m['cls']}.{m['attr']} ({full}, line {m['lineno']}): "
+                f"bucket scheme {bs!r} is not registered in "
+                "metrics.BUCKET_SCHEMES")
+    consumers = registered_consumers(sigcache_path)
+    if not consumers:
+        findings.append("sigcache.CONSUMERS not found or empty "
+                        "(rule 8 parser broken?)")
+    for s in consumer_call_sites(root):
+        if s["value"] not in consumers:
+            findings.append(
+                f"{s['file']}:{s['lineno']}: consumer label "
+                f"{s['value']!r} is not registered in "
+                "sigcache.CONSUMERS — it would fork a latency series "
+                "the SLO tracker never watches")
+    for key, lineno in slo_target_keys(latledger_path):
+        if key not in consumers:
+            findings.append(
+                f"cometbft_tpu/libs/latledger.py:{lineno}: "
+                f"DEFAULT_SLO_TARGETS key {key!r} is not registered in "
+                "sigcache.CONSUMERS — its error budget would burn "
+                "against a series no caller can produce")
+    return findings
+
+
 def run_checks() -> list[str]:
     """All findings as human-readable strings; empty means clean."""
     metrics = registered_metrics()
@@ -321,6 +502,7 @@ def run_checks() -> list[str]:
                 "is registered but never observed anywhere in "
                 "cometbft_tpu/ or tests/")
     findings.extend(run_label_checks())
+    findings.extend(run_registry_checks())
     return findings
 
 

@@ -1,0 +1,145 @@
+"""Render the per-consumer verify-latency decomposition as tables.
+
+Input is either shape the latency ledger (libs/latledger.py) emits:
+
+  * a live dump — the `latency` RPC route / ``/debug/pprof/latency``
+    JSON (LatLedgerRecorder.dump(): recorded/dropped/consumers/slo/
+    rows), saved to a file;
+  * a bench capture — BENCH_live.json / BENCH_r*.json whose
+    ``extra.verify_latency_detail`` carries the contention A/B's solo
+    and contended arms (bench_verify_contention), or that detail blob
+    extracted on its own.
+
+For every arm and consumer the table shows request/signature counts,
+p50/p99/mean milliseconds, and the segment decomposition as a share of
+that consumer's total ledger seconds — the segments of every sampled
+request sum EXACTLY to its wall, so the shares partition the column.
+
+Usage:
+    python scripts/latency_report.py dump.json
+        per-consumer tables on stdout
+    python scripts/latency_report.py BENCH_live.json --jsonl rows.jsonl
+        additionally writes one JSON line per consumer record
+        (and per sampled request row when the input carries rows)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# segment print order mirrors the request lifecycle: submit -> queue ->
+# pack -> compute -> publish (libs/latledger.SEGMENTS)
+_SEG_ORDER = ("queue_wait", "coalesce_wait", "host_pack", "device",
+              "host_verify", "cache", "publish")
+
+
+def _arms(data: dict) -> dict[str, dict]:
+    """{arm label: {"consumers": ..., "slo": ..., "rows": ...}} from
+    any accepted input shape."""
+    if "parsed" in data:
+        data = data.get("parsed") or {}
+    if "extra" in data:
+        data = (data.get("extra") or {}).get(
+            "verify_latency_detail") or {}
+    if "consumers" in data:                 # live recorder dump
+        return {"live": data}
+    arms = {}
+    for label in ("solo", "contended"):
+        arm = data.get(label)
+        if isinstance(arm, dict) and "consumers" in arm:
+            arms[label] = arm
+    return arms
+
+
+def _table(label: str, arm: dict) -> list[str]:
+    consumers = arm.get("consumers") or {}
+    lines = [f"{label} arm: {len(consumers)} consumer(s), "
+             f"{arm.get('requests', sum(c.get('requests', 0) for c in consumers.values()))} "
+             f"request(s)"]
+    if not consumers:
+        return lines + ["  (no ledger rows)"]
+    segs = [s for s in _SEG_ORDER
+            if any(c.get("seg_seconds", {}).get(s)
+                   for c in consumers.values())]
+    head = (f"  {'consumer':<12} {'reqs':>6} {'sigs':>7} {'coal':>5} "
+            f"{'p50ms':>9} {'p99ms':>9} {'meanms':>9}"
+            + "".join(f" {s + '%':>13}" for s in segs))
+    lines += [head, "  " + "-" * (len(head) - 2)]
+    for name in sorted(consumers):
+        c = consumers[name]
+        seg_s = c.get("seg_seconds") or {}
+        total = sum(seg_s.values()) or 1.0
+        row = (f"  {name:<12} {c.get('requests', 0):>6} "
+               f"{c.get('sigs', 0):>7} {c.get('coalesced', 0):>5} "
+               f"{c.get('p50_ms', 0.0):>9.3f} "
+               f"{c.get('p99_ms', 0.0):>9.3f} "
+               f"{c.get('mean_ms', 0.0):>9.3f}")
+        row += "".join(f" {seg_s.get(s, 0.0) / total:>13.1%}"
+                       for s in segs)
+        lines.append(row)
+    slo = (arm.get("slo") or {}).get("consumers") or {}
+    for name in sorted(slo):
+        s = slo[name]
+        if not isinstance(s, dict):
+            continue
+        lines.append(
+            f"  slo {name:<12} target_p99={s.get('target_ms', 0.0):.1f}ms"
+            f" burn_short={s.get('burn_short', 0.0):.2f}"
+            f" burn_long={s.get('burn_long', 0.0):.2f}"
+            f"{' TRIPPING' if s.get('tripping') else ''}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-consumer verify-latency decomposition tables "
+                    "from a latency-ledger dump or bench capture")
+    ap.add_argument("path", help="latency RPC/pprof dump JSON, "
+                    "BENCH_*.json, or a verify_latency_detail blob")
+    ap.add_argument("--jsonl", metavar="PATH",
+                    help="write one JSON line per consumer record "
+                         "(+ per sampled request row when present)")
+    args = ap.parse_args(argv)
+
+    with open(args.path) as f:
+        data = json.load(f)
+    arms = _arms(data if isinstance(data, dict) else {})
+    if not arms:
+        print(f"latency_report: no latency-ledger data in {args.path} "
+              "(expected a recorder dump, a BENCH capture with "
+              "extra.verify_latency_detail, or that blob itself)",
+              file=sys.stderr)
+        return 1
+
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for label, arm in arms.items():
+                for name, c in sorted(
+                        (arm.get("consumers") or {}).items()):
+                    f.write(json.dumps(
+                        {"arm": label, "consumer": name, **c}) + "\n")
+                for row in arm.get("rows") or ():
+                    f.write(json.dumps({"arm": label, "row": row})
+                            + "\n")
+
+    out = []
+    for label, arm in arms.items():
+        out += _table(label, arm) + [""]
+    ratio = None
+    if "solo" in arms and "contended" in arms:
+        s = (arms["solo"].get("consumers") or {}).get("consensus", {})
+        c = (arms["contended"].get("consumers") or {}).get(
+            "consensus", {})
+        if s.get("p99_ms") and c.get("p99_ms"):
+            ratio = c["p99_ms"] / s["p99_ms"]
+    if ratio is not None:
+        out.append(f"vote p99 contention cost: {ratio:.2f}x "
+                   "(contended/solo consensus p99)")
+    print("\n".join(out).rstrip())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,7 +39,13 @@ LOWER_IS_BETTER = {"chaos_recovery_seconds",
                    # lightserve fleet serve latency: the coalescer's
                    # whole point is cutting the tail — p99 rising
                    # means merged flushes stopped paying for the wait
-                   "light_serve_p99_ms"}
+                   "light_serve_p99_ms",
+                   # per-consumer verify latency under contention
+                   # (libs/latledger.py): the ledger exists to keep the
+                   # consensus vote tail short while bulk tenants share
+                   # the pipeline — either p99 rising is queueing the
+                   # decomposition must explain, not an improvement
+                   "vote_verify_p99_ms", "bulk_verify_p99_ms"}
 # non-metric extras (configs, notes, lists) are skipped by the numeric
 # filter; these numerics are ratios/counters, not rates to gate on.
 # critical_path_device_share moved here when the signature-verdict
@@ -129,6 +135,37 @@ def gate(current: dict, history: list[dict], tolerance: float,
     return rows
 
 
+def staleness_warning(root: str, live_path: str) -> str | None:
+    """Warn (don't fail) when the live capture predates the newest
+    committed round: its numbers were measured against an older tree,
+    so gating or reporting from it undersells work already banked.
+    Pairs with the capture_git_rev stamp bench.py writes into extras."""
+    try:
+        live_m = os.path.getmtime(live_path)
+    except OSError:
+        return None
+    rounds = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    if not rounds:
+        return None
+    newest = max(rounds, key=os.path.getmtime)
+    if os.path.getmtime(newest) <= live_m:
+        return None
+    rev = ""
+    try:
+        with open(live_path) as f:
+            d = json.load(f)
+        r = ((d.get("parsed") or {}).get("extra") or {}).get(
+            "capture_git_rev") or (d.get("extra") or {}).get(
+            "capture_git_rev")
+        if r:
+            rev = f" (captured at rev {r})"
+    except Exception:
+        pass
+    return (f"warning: {os.path.basename(live_path)}{rev} predates "
+            f"{os.path.basename(newest)} — the live capture is stale;"
+            f" re-run bench.py before trusting it")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="bench trajectory regression gate")
@@ -162,6 +199,9 @@ def main(argv=None) -> int:
             return 2
         history = [m for _, m in traj]
         label = args.current
+        stale = staleness_warning(args.root, args.current)
+        if stale:
+            print(f"perf_gate: {stale}", file=sys.stderr)
     else:
         if not args.check_only:
             print("perf_gate: pass --check-only or --current PATH",

@@ -63,6 +63,19 @@ def build_section() -> str:
              "BENCH_live.json.", ""]
 
     if os.path.exists(BENCH):
+        # staleness check (scripts/perf_gate.py): a live capture older
+        # than the newest committed round renders with a loud banner so
+        # the auto-section never silently undersells the current tree
+        stale = None
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from perf_gate import staleness_warning
+            stale = staleness_warning(ROOT, BENCH)
+        except Exception:
+            pass
+        if stale:
+            print(f"perf_report: {stale}", file=sys.stderr)
+            lines += [f"> **{stale}**", ""]
         try:
             with open(BENCH) as f:
                 b = json.load(f)

@@ -402,6 +402,80 @@ class TestLabelRegistryLint:
         assert any("bogus_idle" in f for f in findings)
 
 
+class TestBucketConsumerRegistryLint:
+    """check_metrics rule 8: histogram bucket layouts and verify-
+    consumer labels are CLOSED registries (metrics.BUCKET_SCHEMES /
+    sigcache.CONSUMERS shared with libs/latledger.py), linted in both
+    directions — call sites against the registry and the ledger's SLO
+    targets back against it."""
+
+    def test_registries_parse_nonempty(self):
+        mod = TestCheckMetrics._load()
+        schemes = mod.registered_bucket_schemes()
+        assert {"default", "flush", "serve",
+                "verify_latency"} <= schemes
+        consumers = mod.registered_consumers()
+        assert {"consensus", "blocksync", "light", "lightserve",
+                "evidence"} <= consumers
+        keys = dict(mod.slo_target_keys())
+        assert keys and set(keys) <= consumers
+        assert "consensus" in keys
+
+    def test_repo_is_clean_and_sites_seen(self):
+        mod = TestCheckMetrics._load()
+        sites = mod.consumer_call_sites()
+        assert len(sites) >= 5           # the lint actually sees code
+        assert {"consensus", "lightserve"} <= {s["value"]
+                                               for s in sites}
+        assert mod.run_registry_checks() == []
+
+    def test_lint_flags_adhoc_buckets_and_unknown_scheme(self,
+                                                         tmp_path):
+        mod = TestCheckMetrics._load()
+        bad = tmp_path / "m.py"
+        bad.write_text(
+            "BUCKET_SCHEMES = {'default': (1, 2)}\n"
+            "class A:\n"
+            "    def __init__(self, reg):\n"
+            "        self.a = reg.histogram('x', 'a_seconds', 'H.',\n"
+            "                               buckets=(1, 2, 3))\n"
+            "        self.b = reg.histogram('x', 'b_ms', 'H.',\n"
+            "            buckets=BUCKET_SCHEMES['nope'])\n"
+            "        self.c = reg.histogram('x', 'c_seconds', 'H.',\n"
+            "            buckets=BUCKET_SCHEMES['default'])\n"
+            "        self.d = reg.histogram('x', 'd_bytes', 'H.',\n"
+            "                               buckets=(1, 2))\n")
+        findings = mod.run_registry_checks(root=tmp_path,
+                                           metrics_path=bad)
+        assert any("a_seconds" in f and "closed registry" in f
+                   for f in findings)
+        assert any("'nope'" in f for f in findings)
+        # a registered scheme and a non-duration histogram both pass
+        assert not any("c_seconds" in f or "d_bytes" in f
+                       for f in findings)
+
+    def test_lint_flags_unregistered_consumer(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        site = tmp_path / "x.py"
+        site.write_text(
+            "def f(sigcache, latledger):\n"
+            "    with sigcache.consumer('mystery'):\n"
+            "        latledger.submit(1, consumer='consensus')\n")
+        findings = mod.run_registry_checks(root=tmp_path)
+        assert any("'mystery'" in f for f in findings)
+        assert not any("'consensus'" in f for f in findings)
+
+    def test_lint_flags_slo_target_outside_registry(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        lat = tmp_path / "lat.py"
+        lat.write_text("DEFAULT_SLO_TARGETS = {'consensus': 0.05,\n"
+                       "                       'ghost': 0.1}\n")
+        findings = mod.run_registry_checks(root=tmp_path,
+                                           latledger_path=lat)
+        assert any("'ghost'" in f for f in findings)
+        assert not any("'consensus'" in f for f in findings)
+
+
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
     as a tier-1 test so a perf cliff fails CI before a round lands."""
@@ -606,6 +680,56 @@ class TestPerfGate:
         by = {r["metric"]: r for r in rows}
         assert by["light_clients_served_per_sec"]["status"] == \
             "regressed"
+
+    def test_verify_latency_p99_gates_lower_is_better(self):
+        """vote_verify_p99_ms / bulk_verify_p99_ms (latledger
+        contention A/B) gate lower-is-better: the ledger exists to
+        keep the consensus tail short while bulk tenants share the
+        pipeline, so either p99 rising is the regression."""
+        mod = self._load()
+        assert "vote_verify_p99_ms" in mod.LOWER_IS_BETTER
+        assert "bulk_verify_p99_ms" in mod.LOWER_IS_BETTER
+        assert "vote_verify_p99_ms" not in mod.SKIP
+        assert "bulk_verify_p99_ms" not in mod.SKIP
+        history = [{"headline": 100.0, "vote_verify_p99_ms": 50.0,
+                    "bulk_verify_p99_ms": 400.0} for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "vote_verify_p99_ms": 80.0,
+                         "bulk_verify_p99_ms": 300.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["vote_verify_p99_ms"]["status"] == "regressed"
+        assert by["bulk_verify_p99_ms"]["status"] == "ok"  # fell = ok
+        ok = mod.gate({"headline": 100.0,
+                       "vote_verify_p99_ms": 45.0,
+                       "bulk_verify_p99_ms": 380.0},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
+
+    def test_staleness_warning(self, tmp_path):
+        """A BENCH_live.json older than the newest committed round
+        warns (with the capture's git rev when stamped) but never
+        fails the gate; a fresher live capture stays silent."""
+        import json as _json
+        import os as _os
+        mod = self._load()
+        self._write(tmp_path, "BENCH_r1.json", 100.0)
+        live = tmp_path / "BENCH_live.json"
+        live.write_text(_json.dumps(
+            {"metric": "x", "value": 100.0, "unit": "s",
+             "extra": {"capture_git_rev": "abc1234"}}))
+        now = time.time()
+        _os.utime(live, (now - 60, now - 60))
+        _os.utime(tmp_path / "BENCH_r1.json", (now - 120, now - 120))
+        assert mod.staleness_warning(str(tmp_path), str(live)) is None
+        _os.utime(tmp_path / "BENCH_r1.json", (now, now))
+        warn = mod.staleness_warning(str(tmp_path), str(live))
+        assert warn is not None and "stale" in warn
+        assert "abc1234" in warn
+        # a missing live file warns nothing rather than crashing
+        assert mod.staleness_warning(
+            str(tmp_path), str(tmp_path / "nope.json")) is None
 
     def test_usage_errors_exit_2(self, tmp_path):
         import json

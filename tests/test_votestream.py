@@ -101,7 +101,8 @@ class _StubPipeline:
     def __init__(self):
         self.windows = []
 
-    def submit(self, items, subsystem=None, device_threshold=None):
+    def submit(self, items, subsystem=None, device_threshold=None,
+               lat=None):
         from concurrent.futures import Future
 
         self.windows.append((list(items), subsystem, device_threshold))
