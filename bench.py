@@ -1343,8 +1343,10 @@ def main() -> None:
               " single-vote stream solo vs beside blocksync bulk"
               " windows + lightserve bursts from their own threads;"
               " verdict cache forced off; per-request decomposition"
-              " sums exactly to wall (SIMNET_CONTENTION_* overrides,"
-              " defaults 192 votes, 12x64 bulk, 32 light)")
+              " sums exactly to wall; the contended arm runs QoS"
+              " scheduler ON and OFF over the same seeds with verdict"
+              " digests asserted identical (SIMNET_CONTENTION_*"
+              " overrides, defaults 192 votes, 12x64 bulk, 32 light)")
     _last_cont = getattr(_simbench, "last_contention", None)
     if ("vote_verify_p99_ms" not in carried_keys
             and isinstance(extra.get("vote_verify_p99_ms"), (int, float))
@@ -1353,13 +1355,27 @@ def main() -> None:
         if isinstance(bulk, (int, float)):
             extra["bulk_verify_p99_ms"] = round(bulk, 3)
             carried_keys.discard("bulk_verify_p99_ms")
+        # QoS A/B companions: the bulk throughput ratio is gated
+        # (higher is better — priority lanes must not tax the bulk
+        # tenant), the scheduler-OFF vote p99 is a diagnostic (SKIP)
+        ratio = _last_cont.get("bulk_verify_throughput_ratio")
+        if isinstance(ratio, (int, float)) and ratio > 0:
+            extra["bulk_verify_throughput_ratio"] = ratio
+            carried_keys.discard("bulk_verify_throughput_ratio")
+        off_p99 = _last_cont.get("vote_verify_p99_ms_sched_off")
+        if isinstance(off_p99, (int, float)):
+            extra["vote_verify_p99_ms_sched_off"] = round(off_p99, 3)
+            carried_keys.discard("vote_verify_p99_ms_sched_off")
         extra["verify_latency_detail"] = {
             k: _last_cont.get(k)
             for k in ("vote_verify_p99_ms_solo", "vote_verify_p50_ms",
-                      "vote_p99_contention_ratio", "votes",
+                      "vote_p99_contention_ratio",
+                      "vote_verify_p99_ms_sched_off",
+                      "bulk_verify_throughput_ratio",
+                      "bulk_verify_sigs_per_s", "votes",
                       "bulk_windows", "bulk_window_size",
                       "light_requests", "seed", "depth",
-                      "solo", "contended")}
+                      "solo", "contended", "contended_sched_off")}
         _sync_carried()
         persist()
     run_extra("consensus_e2e_blocks_per_sec",

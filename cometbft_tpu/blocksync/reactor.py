@@ -44,6 +44,13 @@ PIPELINE_DEPTH = int(os.environ.get(
 # means all local devices)
 MESH_DEVICES = int(os.environ.get(
     "COMETBFT_TPU_BLOCKSYNC_MESH_DEVICES", "0"))
+# QoS lane override for blocksync verify windows (crypto/sched.py):
+# empty = schedule under the blocksync lane itself.  An operator
+# catching a node up BEFORE it may join consensus can re-lane the sync
+# traffic urgent (e.g. "light" or "evidence" class) — attribution
+# (trace/ledger/cache) stays blocksync either way.
+SCHED_LANE = os.environ.get(
+    "COMETBFT_TPU_SCHED_BLOCKSYNC_LANE", "") or None
 
 
 class BlocksyncReactor(Reactor):
@@ -497,7 +504,7 @@ class BlocksyncReactor(Reactor):
                 if rec is None:
                     break
                 rec["verdict"] = rec.pop("batch").verify_async(
-                    pipe, subsystem="blocksync")
+                    pipe, subsystem="blocksync", lane=SCHED_LANE)
                 inflight.append(rec)
                 offset += rec["verified"]
             if not inflight:

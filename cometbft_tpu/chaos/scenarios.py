@@ -371,6 +371,93 @@ def lightserve_partition(seed, blocks=24, n_clients=96, artifact_dir=None,
     return res
 
 
+@scenario(deterministic=True)
+def sched_priority_under_flood(seed, blocks=24, n_votes=48,
+                               artifact_dir=None, workdir=None,
+                               metrics=None, timeout=90.0):
+    """A consensus-lane vote stream floods the syncer's verify
+    pipeline while blocksync pushes bulk windows through the SAME
+    queue: the QoS scheduler (crypto/sched.py) must let votes overtake
+    queued bulk work without losing a single verdict.  Bounds: every
+    vote resolves ok, the consensus lane's dispatch accounting shows
+    all vote windows, and PipelineConservation holds at scenario end
+    — preemption reorders the queue, it never drops from it.  The
+    chain fingerprint stays a pure function of the seed (the flood
+    rides beside the sync, it does not touch consensus state)."""
+    import threading as _threading
+    import time as _time
+
+    from ..simnet.bench import _contention_feed
+
+    c = ChaosCluster(seed, n_vals=4)
+    c.tune_blocksync()
+    c.network.set_default_link(latency=0.001)
+    c.add_server("src0", blocks)
+    c.add_syncer("syncer")
+    c.install_chaos_device("syncer", depth=4)
+    c.dial("syncer", "src0")
+    pipe = c.nodes["syncer"].blocksync_reactor._pipeline
+    feed = _contention_feed("flood-votes", seed, n_votes, 1)
+    flood: dict = {}
+
+    def drive_flood():
+        lat = []
+        try:
+            for win in feed:
+                t0 = _time.monotonic()
+                h = pipe.submit(win, subsystem="consensus")
+                ok, verdicts = h.result(timeout=timeout)
+                if not (ok and all(verdicts)):
+                    raise RuntimeError("vote window failed verify")
+                lat.append(_time.monotonic() - t0)
+                _time.sleep(0.002)  # stretch the stream across the sync
+            flood["lat"] = lat
+        except Exception as e:         # surfaced after the goal below
+            flood["error"] = f"{type(e).__name__}: {e}"
+
+    plan = (Plan("sched_priority_under_flood")
+            .goal(["syncer"], blocks, timeout=timeout))
+    flood_thread = _threading.Thread(target=drive_flood,
+                                     name="sched-flood", daemon=True)
+    # conservation is checked AFTER the flood joins (not inside the
+    # engine's final sweep): the sync goal can land while votes are
+    # still streaming, and a stop-time host drain would answer the
+    # tail without a scheduler dispatch, voiding the lane accounting
+    engine = NemesisEngine(c, plan, default_checkers(
+        liveness_budget_s=45), artifact_dir=artifact_dir,
+        metrics=metrics)
+    sched: dict = {}
+    try:
+        engine.setup()
+        c.start_all()
+        flood_thread.start()
+        res = engine.run()
+        flood_thread.join(timeout=timeout)
+        for v in PipelineConservation("syncer").check(c, final=True):
+            res.violations.append(v.to_dict())
+        sched = pipe.scheduler_snapshot()
+    finally:
+        c.stop_all()
+    if flood_thread.is_alive() or "lat" not in flood:
+        res.violations.append({
+            "checker": "sched_flood",
+            "detail": flood.get("error", "flood did not finish")})
+    else:
+        lat = sorted(flood["lat"])
+        res.timing["flood_vote_p99_ms"] = round(
+            lat[max(0, int(len(lat) * 0.99) - 1)] * 1000, 3)
+        got = sched.get("consensus", {}).get("windows", 0)
+        if got != n_votes:
+            res.violations.append({
+                "checker": "sched_flood",
+                "detail": f"consensus lane dispatched {got} of "
+                          f"{n_votes} vote windows"})
+    res.timing["sched_preemptions"] = sum(
+        s.get("preemptions", 0) for s in sched.values())
+    res.context["scheduler"] = sched
+    return res
+
+
 # -- live-consensus scenarios ------------------------------------------------
 
 @scenario(deterministic=False)

@@ -16,10 +16,14 @@ module is that reformulation for the TPU seam:
   while window N's RLC dispatch is in flight — hashlib and numpy
   release the GIL, so a small worker pool genuinely parallelizes the
   per-window parse+hash across cores (parse_and_hash_parallel);
-- a DEVICE thread dispatches packed windows strictly in submission
-  order, so verdicts resolve in the order callers submitted — the
-  ordering contract blocksync's apply loop and the light client's
-  store loop rely on;
+- a DEVICE thread dispatches packed windows in QoS order (crypto/
+  sched.py): priority lanes keyed by consumer label, deadline
+  promotion, and deficit round-robin between equal-priority lanes.
+  Verdicts still resolve in PER-LANE submission order — the ordering
+  contract blocksync's apply loop and the light client's store loop
+  rely on holds within each consumer's own stream, and with QoS off
+  (COMETBFT_TPU_SCHED=0, or qos=False) everything shares one lane and
+  the queue is exactly the old global FIFO;
 - depth-K backpressure: submit() blocks once K windows are unresolved,
   bounding staging memory to K double-buffered windows.
 
@@ -48,6 +52,7 @@ from ..libs import lockrank
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..libs.service import BaseService
+from . import sched as qos_sched
 
 # depth 2 = classic double buffering (pack N+1 while N is on device);
 # deeper helps only when device time >> host time per window
@@ -84,6 +89,11 @@ BROWNOUT_DEPTH = int(os.environ.get(
     "COMETBFT_TPU_BROWNOUT_DEPTH", "2"))
 BROWNOUT_MAX_WINDOW = int(os.environ.get(
     "COMETBFT_TPU_BROWNOUT_MAX_WINDOW", "256"))
+# deadline-aware QoS dispatch (crypto/sched.py): priority lanes,
+# deficit round-robin, bounded device holds.  On by default; 0 reverts
+# every pipeline in the process to the plain global-FIFO queue (the
+# bench A/B arms toggle the constructor flag instead).
+DEFAULT_QOS = os.environ.get("COMETBFT_TPU_SCHED", "1") != "0"
 
 
 def parse_and_hash_parallel(pubkeys, msgs, sigs, pool=None,
@@ -212,7 +222,8 @@ class _Window:
                  "msgs", "parsed", "packed", "verifier", "staged",
                  "device_s", "device_index", "dispatching", "result",
                  "all_items", "cached", "dispatch_started",
-                 "abandoned")
+                 "abandoned", "lane", "prio", "seq", "enqueued_at",
+                 "held_since", "staging_active")
 
     def __init__(self, items, handle, threshold):
         # items = the MISSES after the verdict-cache partition (what
@@ -243,6 +254,15 @@ class _Window:
         # wedged dispatch thread (the thread discards its result)
         self.dispatch_started = None
         self.abandoned = False
+        # QoS scheduling state (crypto/sched.py), stamped by
+        # QosScheduler.note_enqueue when the window enters the queue;
+        # probe windows keep the defaults (they never enter _windows)
+        self.lane = qos_sched.DEFAULT_LANE
+        self.prio = 0
+        self.seq = 0
+        self.enqueued_at = 0.0
+        self.held_since = None
+        self.staging_active = False
 
 
 class VerifyPipeline(BaseService):
@@ -252,8 +272,13 @@ class VerifyPipeline(BaseService):
                  host_workers: int | None = None,
                  dispatch_fn=None, name: str = "VerifyPipeline",
                  devices=None, health=None,
-                 dispatch_deadline_s: float | None = None):
+                 dispatch_deadline_s: float | None = None,
+                 qos: bool | None = None):
         super().__init__(name)
+        # deadline-aware QoS dispatch order (crypto/sched.py); None
+        # defers to COMETBFT_TPU_SCHED.  Off = one lane = exact FIFO.
+        self.qos = DEFAULT_QOS if qos is None else bool(qos)
+        self._sched = qos_sched.QosScheduler(enabled=self.qos)
         self.depth = max(1, depth)
         self.host_workers = (host_workers if host_workers is not None
                              else DEFAULT_HOST_WORKERS)
@@ -309,6 +334,10 @@ class VerifyPipeline(BaseService):
         self._probe_inflight: dict[str, tuple[float, _Window]] = {}
         self._rr = 0
         self._brownout = False
+        # brownout priority admission: waiting submitters by lane
+        # priority class, so the tightened queue admits the most
+        # urgent lane first and sheds the lowest lanes (under _cv)
+        self._bo_waiters: dict[int, int] = {}
         self._watchdog: threading.Thread | None = None
         self._wd_wake = threading.Event()
         # per-object timeline override (libs/tracetl.py): lets a harness
@@ -498,9 +527,15 @@ class VerifyPipeline(BaseService):
         cache-starved — fully-cached windows resolve at submit and
         never reach a device); backpressure: windows exist but none
         are dispatchable here (slots held by other devices' windows,
-        or computed heads awaiting in-order publication)."""
+        or computed heads awaiting in-order publication);
+        sched_hold: the QoS scheduler is deliberately keeping this
+        chip idle — a strictly-higher-priority window is mid-staging
+        and the bounded hold (COMETBFT_TPU_SCHED_HOLD_MS) beats
+        burning the device on lower-lane work."""
         from ..libs import devprof
 
+        if self._sched.holding(device_index):
+            return devprof.IDLE_SCHED_HOLD
         if device_index is None:
             if self._faulted:
                 return devprof.IDLE_DRAIN
@@ -524,10 +559,10 @@ class VerifyPipeline(BaseService):
 
     def submit(self, items, *, subsystem: str = "pipeline", ctx=None,
                device_threshold: int | None = None,
-               lat=None) -> WindowHandle:
+               lat=None, lane: str | None = None) -> WindowHandle:
         """Queue one window of (pubkey, msg, sig) items; blocks when
         `depth` windows are already unresolved (backpressure).  The
-        returned handle resolves — in submission order — to
+        returned handle resolves — in per-lane submission order — to
         (ok, verdicts) with one bool per item.
 
         `lat` threads caller-created latency-ledger requests
@@ -535,7 +570,14 @@ class VerifyPipeline(BaseService):
         stamped its own queue wait (votestream, the light coalescer)
         is not double-counted; None (the default) opens one ledger
         request covering the whole window when a recorder is
-        installed."""
+        installed.
+
+        `lane` overrides the QoS lane this window schedules under
+        (crypto/sched.py) without changing `subsystem`, which keeps
+        naming the trace/ledger/cache attribution — e.g. a blocksync
+        window re-laned urgent still books its latency as blocksync.
+        Must be a label registered in sigcache.LANES; anything else
+        falls back to the subsystem's own lane."""
         if device_threshold is None:
             from . import batch as cb
 
@@ -580,6 +622,8 @@ class VerifyPipeline(BaseService):
             verdicts = [_verify_one(pk, m, s) for pk, m, s in items]
             handle._resolve(all(verdicts), verdicts, "host")
             return handle
+        label = self._sched.lane_for(subsystem, lane)
+        prio = self._sched.priority(label)
         self._slots.acquire()
         win = _Window(misses, handle, device_threshold)
         win.all_items = items
@@ -587,16 +631,57 @@ class VerifyPipeline(BaseService):
         with self._cv:
             # brownout: beyond the depth-K slot bound, hold submitters
             # to a tighter queue so host-only verify latency stays
-            # bounded instead of piling K windows of backlog
-            while not self._stopping and self._brownout \
-                    and len(self._windows) >= BROWNOUT_DEPTH:
-                self._cv.wait(timeout=0.05)
+            # bounded instead of piling K windows of backlog.  The
+            # admission is priority-aware: while a strictly more
+            # urgent lane is also waiting, this submitter yields its
+            # queue spot — the degraded capacity sheds the lowest
+            # lanes first.
+            self._bo_waiters[prio] = self._bo_waiters.get(prio, 0) + 1
+            try:
+                while not self._stopping and self._brownout \
+                        and (len(self._windows) >= BROWNOUT_DEPTH
+                             or any(c and p < prio for p, c
+                                    in self._bo_waiters.items())):
+                    self._cv.wait(timeout=0.05)
+            finally:
+                self._bo_waiters[prio] -= 1
+                if not self._bo_waiters[prio]:
+                    del self._bo_waiters[prio]
             win.device_index = self._pick_device_locked()
+            self._sched.note_enqueue(win, label)
             self._windows.append(win)
             self.submitted += 1
             self._cv.notify_all()
         self._gauge()
         return handle
+
+    def qos_seal_due(self, consumer: str) -> bool:
+        """Window-formation advisory for accumulators (votestream, the
+        light coalescer): True when sealing the in-formation window
+        NOW beats batching further — the queue holds work from a
+        *different* priority class (a higher lane queued means this
+        bulk window should be cut short so it clears fast; a lower
+        lane queued means this urgent window should seal and jump
+        it).  False with QoS off, on an empty queue (the accumulator's
+        flush interval is the designed latency), and under pure
+        own-class backpressure — there batching up stays the
+        efficient move."""
+        if not self.qos or not self.is_running():
+            return False
+        # lock-free peek: accumulators poll this at millisecond
+        # cadence while a batch forms, and the common case is an
+        # empty queue — a stale read only delays/advances an advisory
+        # by one poll tick, so don't tax the dispatch cv for it
+        if not self._windows:
+            return False
+        with self._cv:
+            return self._sched.seal_due(self._windows, consumer,
+                                        time.monotonic())
+
+    def scheduler_snapshot(self) -> dict:
+        """Per-lane dispatch counters (benches, chaos checkers)."""
+        with self._cv:
+            return self._sched.snapshot()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Block until every submitted window has resolved."""
@@ -614,10 +699,10 @@ class VerifyPipeline(BaseService):
     # -- staging (host pack) -----------------------------------------------
 
     def _next_unstaged(self) -> _Window | None:
-        for w in self._windows:
-            if not w.staged:
-                return w
-        return None
+        # QoS order: most urgent effective class first, FIFO within it
+        # (with QoS off this is exactly the old first-unstaged scan)
+        return self._sched.next_unstaged(self._windows,
+                                         time.monotonic())
 
     def _staging_loop(self) -> None:
         from ..libs import trace as libtrace
@@ -632,6 +717,9 @@ class VerifyPipeline(BaseService):
                 if self._stopping and self._next_unstaged() is None:
                     return
                 win = self._next_unstaged()
+                # visible to pick_dispatch: a free device may briefly
+                # hold for this window if it outranks the staged work
+                win.staging_active = True
             # span name decided UP FRONT from the knob (not win.mode,
             # set inside _stage): in device-hash mode the staging
             # thread's job shrinks to splice+pack, and the split
@@ -656,6 +744,7 @@ class VerifyPipeline(BaseService):
                 win.mode = "host"
             _lat_stamp(win.handle, "stage_end")
             with self._cv:
+                win.staging_active = False
                 win.staged = True
                 self._cv.notify_all()
             self._gauge()
@@ -750,6 +839,7 @@ class VerifyPipeline(BaseService):
             rec = devprof.recorder()
             cause = devprof.IDLE_NO_WORK
             probe = False
+            ev = None
             with self._cv:
                 while True:
                     if gen != self._gens.get(dev, 0):
@@ -759,20 +849,25 @@ class VerifyPipeline(BaseService):
                     if self._probe_due_locked(dev):
                         probe = True
                         break
-                    if self._windows and self._windows[0].staged \
-                            and not self._windows[0].abandoned:
-                        win = self._windows[0]
+                    win, holding = self._sched.pick_dispatch(
+                        self._windows, None, time.monotonic())
+                    if win is not None:
                         win.dispatching = True
                         win.dispatch_started = time.monotonic()
                         _lat_stamp(win.handle, "dispatch")
+                        ev = self._sched.note_dispatch(
+                            win, self._windows, win.dispatch_started)
                         break
                     if self._stopping and not self._windows:
                         return
                     if rec is not None:
                         cause = self._idle_cause()
                     # stopping with an unstaged head: the staging loop
-                    # drains every submitted window before exiting
-                    self._cv.wait(timeout=0.05)
+                    # drains every submitted window before exiting.  A
+                    # QoS hold wakes on its own (short) budget so the
+                    # held device re-evaluates promptly.
+                    self._cv.wait(timeout=max(0.001, self._sched.hold_s)
+                                  if holding else 0.05)
                     if rec is not None:
                         rec.advance(dev, cause)
             if rec is not None:
@@ -782,6 +877,7 @@ class VerifyPipeline(BaseService):
             if probe:
                 self._run_probe(dev, None, gen)
                 continue
+            self._sched.emit(ev)
             self._resolve_window(win)
             with self._cv:
                 stale = gen != self._gens.get(dev, 0) or win.abandoned
@@ -797,8 +893,13 @@ class VerifyPipeline(BaseService):
                 else:                     # drain (or a failed resolve)
                     rec.advance(dev, devprof.IDLE_DRAIN)
             with self._cv:
-                if self._windows and self._windows[0] is win:
-                    self._windows.pop(0)
+                # under QoS the resolved window need not be the head
+                # (it may have overtaken earlier lower-lane windows):
+                # remove by identity
+                try:
+                    self._windows.remove(win)
+                except ValueError:  # watchdog already popped it
+                    pass
                 if not self._windows:
                     # queue empty: a drain ends here, device dispatch
                     # resumes for subsequent submissions
@@ -959,13 +1060,6 @@ class VerifyPipeline(BaseService):
 
     # -- mesh round-robin (one dispatch thread per device) ---------------
 
-    def _next_for_device(self, idx: int) -> _Window | None:
-        for w in self._windows:
-            if w.device_index == idx and w.staged \
-                    and not w.dispatching:
-                return w
-        return None
-
     def _mesh_device_loop(self, idx: int, gen: int = 0) -> None:
         from ..libs import devprof
         from ..libs import trace as libtrace
@@ -979,6 +1073,7 @@ class VerifyPipeline(BaseService):
             rec = devprof.recorder()
             cause = devprof.IDLE_NO_WORK
             probe = False
+            ev = None
             with self._cv:
                 while True:
                     if gen != self._gens.get(dev, 0):
@@ -988,11 +1083,14 @@ class VerifyPipeline(BaseService):
                     if self._probe_due_locked(dev):
                         probe = True
                         break
-                    win = self._next_for_device(idx)
+                    win, holding = self._sched.pick_dispatch(
+                        self._windows, idx, time.monotonic())
                     if win is not None:
                         win.dispatching = True
                         win.dispatch_started = time.monotonic()
                         _lat_stamp(win.handle, "dispatch")
+                        ev = self._sched.note_dispatch(
+                            win, self._windows, win.dispatch_started)
                         break
                     if self._stopping and not any(
                             w.device_index == idx and w.result is None
@@ -1000,7 +1098,8 @@ class VerifyPipeline(BaseService):
                         return
                     if rec is not None:
                         cause = self._idle_cause(device_index=idx)
-                    self._cv.wait(timeout=0.05)
+                    self._cv.wait(timeout=max(0.001, self._sched.hold_s)
+                                  if holding else 0.05)
                     if rec is not None:
                         rec.advance(dev, cause)
                 faulted = idx in self._dev_faulted
@@ -1010,6 +1109,7 @@ class VerifyPipeline(BaseService):
             if probe:
                 self._run_probe(dev, self.devices[idx], gen)
                 continue
+            self._sched.emit(ev)
             t0 = time.monotonic()
             path = "host"
             dev_span = "device_hash" if win.mode == "ed_hash" \
@@ -1049,14 +1149,23 @@ class VerifyPipeline(BaseService):
             self._publish_resolved(idx)
 
     def _publish_resolved(self, idx: int) -> None:
-        """Pop and resolve every computed window at the queue head —
-        verdicts PUBLISH in submission order no matter which device
-        finished first."""
+        """Pop and resolve every computed window that is the head of
+        its LANE — verdicts publish in per-lane submission order no
+        matter which device finished first.  With QoS off every
+        window shares one lane, making this exactly the old
+        global-head publication."""
         done: list[_Window] = []
         with self._cv:
-            while self._windows and self._windows[0].result is not None:
-                done.append(self._windows.pop(0))
-                self.resolved += 1
+            blocked: set = set()
+            i = 0
+            while i < len(self._windows):
+                w = self._windows[i]
+                if w.result is not None and w.lane not in blocked:
+                    done.append(self._windows.pop(i))
+                    self.resolved += 1
+                    continue
+                blocked.add(w.lane)
+                i += 1
             if idx in self._dev_faulted and not any(
                     w.device_index == idx for w in self._windows):
                 # this device's queue drained: device dispatch resumes
@@ -1215,7 +1324,10 @@ class VerifyPipeline(BaseService):
             with self._cv:
                 if self._windows and self._windows[0] is win:
                     self._windows.pop(0)
-                else:  # pragma: no cover - head is always the hang
+                else:
+                    # QoS dispatch order: the hung window need not be
+                    # the queue head (it may have overtaken earlier
+                    # lower-lane windows)
                     try:
                         self._windows.remove(win)
                     except ValueError:

@@ -63,6 +63,39 @@ CONSUMERS = frozenset({
     "crypto", "bench", "probe",
 })
 
+# QoS lane priorities over the closed consumer registry
+# (crypto/sched.py): lower number = more urgent.  Every CONSUMERS
+# label has exactly one entry and every key here is a registered
+# consumer — scripts/check_metrics.py rule 9 lints both directions, so
+# a new consumer cannot ship without declaring where it sits in the
+# verify-plane dispatch order.  Votes outrank everything (consensus
+# round time is bounded by vote-verify latency, not bulk throughput);
+# evidence is next (equivocation proofs are consensus-adjacent);
+# light/lightserve share a class (deficit round-robin keeps them fair
+# to each other); blocksync bulk yields to all of the above; the
+# unlabeled "crypto"/"bench" default class goes last.  "probe" windows
+# never enter the submit queue (devhealth hand-stages them), but the
+# label still declares a lane so the registry stays total.
+LANES = {
+    "consensus": 0,
+    "probe": 0,
+    "evidence": 1,
+    "light": 2,
+    "lightserve": 2,
+    "blocksync": 3,
+    "crypto": 4,
+    "bench": 4,
+}
+# subsystems outside CONSUMERS (e.g. the bare "pipeline" default)
+# schedule at the lowest priority class
+DEFAULT_LANE_PRIORITY = 4
+
+
+def lane_priority(label: str) -> int:
+    """Dispatch priority class for a consumer label (lower = more
+    urgent); unregistered labels fall into the default class."""
+    return LANES.get(label, DEFAULT_LANE_PRIORITY)
+
 
 class consumer:
     """Context manager labeling cache traffic with the product path

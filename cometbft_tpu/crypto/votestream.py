@@ -39,6 +39,13 @@ _FLUSH_INTERVAL = float(os.environ.get("COMETBFT_TPU_VOTE_FLUSH_MS", "2")) \
 _DEVICE_THRESHOLD = int(os.environ.get(
     "COMETBFT_TPU_VOTE_DEVICE_THRESHOLD", "256"))
 _MAX_BATCH = 4096
+# how often the accumulating worker re-checks the pipeline's QoS seal
+# advisory (qos_seal_due) while a batch is forming; only matters when
+# flush_interval is large relative to it.  5ms keeps the worker's
+# wake rate low (the advisory's empty-queue fast path makes each
+# check a couple of attribute reads) while staying well inside the
+# 50ms consensus SLO
+_SEAL_POLL_S = 0.005
 
 
 class StreamingVerifier(BaseService):
@@ -213,6 +220,26 @@ class StreamingVerifier(BaseService):
             if self._inflight.get(triple) is fut:
                 del self._inflight[triple]
 
+    def _seal_due(self) -> bool:
+        """QoS preemption signal (VerifyPipeline.qos_seal_due): should
+        the in-formation vote window seal now instead of waiting out
+        the flush interval?  Peeks the pipeline this verifier would
+        flush through — WITHOUT lazily creating one — and defers to
+        its scheduler.  Rank-legal under self._cv: votestream.cv
+        orders below dispatch.cv (libs/lockrank.py)."""
+        pipe = self._pipeline
+        if pipe is None:
+            from . import dispatch
+
+            pipe = dispatch._default
+        # getattr: injected test pipelines are plain stubs with only
+        # submit(); no advisory means no early seal
+        seal = getattr(pipe, "qos_seal_due", None) \
+            if pipe is not None else None
+        if seal is None:
+            return False
+        return seal("consensus")
+
     # -- worker ------------------------------------------------------------
 
     def _worker(self) -> None:
@@ -224,14 +251,21 @@ class StreamingVerifier(BaseService):
                     batch, self._pending = self._pending, []
                 else:
                     # deadline accumulation: let the batch grow until the
-                    # OLDEST submission has waited flush_interval
+                    # OLDEST submission has waited flush_interval — or
+                    # until the pipeline's QoS scheduler says sealing
+                    # now beats batching further (cross-class work is
+                    # queued behind us), so a single late vote never
+                    # rides out the full interval behind a blocksync
+                    # burst
                     deadline = time.monotonic() + self.flush_interval
                     while (len(self._pending) < self.max_batch
                            and not self._stopping):
                         left = deadline - time.monotonic()
                         if left <= 0:
                             break
-                        self._cv.wait(timeout=left)
+                        if self._seal_due():
+                            break
+                        self._cv.wait(timeout=min(left, _SEAL_POLL_S))
                     batch, self._pending = self._pending, []
             if batch:
                 self._flush(batch)

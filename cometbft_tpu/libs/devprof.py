@@ -60,8 +60,15 @@ IDLE_BACKPRESSURE = "backpressure"
 IDLE_NO_WORK = "no_work"
 IDLE_DRAIN = "drain"
 IDLE_QUARANTINE = "quarantine"
+# the QoS scheduler (crypto/sched.py) is deliberately keeping this
+# chip idle: an urgent lane's window is mid-staging and dispatching
+# the staged bulk candidate now would make the urgent window wait a
+# whole indivisible bulk dispatch — a bounded hold
+# (COMETBFT_TPU_SCHED_HOLD_MS), distinct from backpressure because the
+# operator should read it as policy, not as a starved feed path
+IDLE_SCHED_HOLD = "sched_hold"
 IDLE_CAUSES = (IDLE_STAGING, IDLE_BACKPRESSURE, IDLE_NO_WORK,
-               IDLE_DRAIN, IDLE_QUARANTINE)
+               IDLE_DRAIN, IDLE_QUARANTINE, IDLE_SCHED_HOLD)
 STATES = (BUSY,) + IDLE_CAUSES
 
 COMPILE_FIRST = "first"

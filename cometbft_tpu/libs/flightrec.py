@@ -86,6 +86,15 @@ EV_SLO_BURN = "slo_burn"             # latency-ledger SLO burn
 #                                       target budget; sustained=True
 #                                       after consecutive trips (auto
 #                                       dump-to-log)
+EV_SCHED_PREEMPT = "sched_preempt"   # QoS scheduler (crypto/sched.py)
+#                                       dispatched a higher-lane window
+#                                       ahead of earlier-submitted
+#                                       lower-lane ones; carries the
+#                                       winning lane, its batch size,
+#                                       and how many staged windows it
+#                                       overtook (their wait books as
+#                                       held time in SchedulerMetrics
+#                                       and queue_wait in the ledger)
 
 
 class FlightRecorder:

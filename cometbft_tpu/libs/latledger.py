@@ -89,6 +89,19 @@ DEFAULT_SLO_TARGETS = {
     "evidence": 0.250,
 }
 
+# consumers with no declared target (the "crypto"/"bench" default
+# class) schedule against this bound — the QoS scheduler
+# (crypto/sched.py) uses it as the starvation guard for lanes the SLO
+# table does not name, so even the lowest class gets dispatched within
+# a bounded wait under a sustained higher-priority flood
+DEFAULT_TARGET_S = 1.0
+
+
+def target_for(consumer: str) -> float:
+    """Declared p99 target in seconds for a consumer label; labels
+    outside DEFAULT_SLO_TARGETS get the DEFAULT_TARGET_S bound."""
+    return DEFAULT_SLO_TARGETS.get(consumer, DEFAULT_TARGET_S)
+
 _ENV_ON = os.environ.get("COMETBFT_TPU_LATLEDGER", "1") != "0"
 
 

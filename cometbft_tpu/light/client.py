@@ -14,6 +14,7 @@ bisection hop = one or two device launches regardless of valset size.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 
@@ -40,6 +41,12 @@ _SKIP_NUM = 9
 _SKIP_DEN = 16
 
 DEFAULT_PRUNING_SIZE = 1000
+
+# QoS lane override for light-client verify windows (crypto/sched.py):
+# empty = the light lane itself.  Re-laning changes dispatch priority
+# only; trace/ledger/cache attribution stays "light".
+SCHED_LANE = os.environ.get(
+    "COMETBFT_TPU_SCHED_LIGHT_LANE", "") or None
 
 
 @dataclass
@@ -395,7 +402,8 @@ class Client:
                             verified = interim
                     inflight.append(
                         (window,
-                         batch.verify_async(pipe, subsystem="light")))
+                         batch.verify_async(pipe, subsystem="light",
+                                            lane=SCHED_LANE)))
                     h = wend + 1
                     wend = min(h + bs - 1, target.height)
                 else:

@@ -39,12 +39,17 @@ DEFAULT_MAX_BATCH = int(os.environ.get(
 
 
 class _Entry:
-    __slots__ = ("future", "refs", "queued")
+    __slots__ = ("future", "refs", "queued", "consumer")
 
-    def __init__(self, future):
+    def __init__(self, future, consumer: str = "lightserve"):
         self.future = future
         self.refs = 1
         self.queued = True
+        # the OLDEST claimant's consumer label: a merged flush
+        # schedules under the most urgent claimant lane in the batch
+        # (crypto/sched.py) while ledger/cache attribution stays the
+        # session's own subsystem
+        self.consumer = consumer
 
 
 class RequestTicket:
@@ -91,8 +96,18 @@ class RequestTicket:
 class RequestCoalescer:
     def __init__(self, verify_fn, *, window_ms: float | None = None,
                  max_batch: int | None = None, start: bool = True):
-        # verify_fn(heights) -> dict[height -> Exception | None]
+        # verify_fn(heights) -> dict[height -> Exception | None];
+        # when it accepts a `lane` kwarg the flusher passes the most
+        # urgent claimant lane of each merged batch (QoS scheduling
+        # only — attribution is the session's)
         self._verify = verify_fn
+        try:
+            import inspect
+
+            self._verify_takes_lane = "lane" in \
+                inspect.signature(verify_fn).parameters
+        except (TypeError, ValueError):   # builtins, odd callables
+            self._verify_takes_lane = False
         self.window_s = (DEFAULT_WINDOW_MS if window_ms is None
                          else float(window_ms)) / 1000.0
         self.max_batch = max(1, DEFAULT_MAX_BATCH if max_batch is None
@@ -144,7 +159,15 @@ class RequestCoalescer:
                         e.future.add_done_callback(
                             lambda f, r=req: r.resolve_coalesced())
                 else:
-                    e = _Entry(lockrank.TrackedFuture())
+                    from ..crypto import sigcache
+
+                    # record who FIRST asked for this height; the
+                    # ambient default ("crypto" = nobody declared)
+                    # means a plain serving request -> lightserve
+                    label = sigcache.current_consumer()
+                    e = _Entry(lockrank.TrackedFuture(),
+                               consumer=label if label in sigcache.LANES
+                               and label != "crypto" else "lightserve")
                     self._entries[h] = e
                     if q is None:
                         q = deque()
@@ -220,10 +243,22 @@ class RequestCoalescer:
         shared futures.  Returns the batch size (0 = nothing queued)."""
         with self._cv:
             batch = self._drain_locked()
+            lanes = [self._entries[h].consumer for h in batch
+                     if h in self._entries]
         if not batch:
             return 0
         try:
-            results = self._verify(batch)
+            if self._verify_takes_lane:
+                from ..crypto import sigcache
+
+                # the merged window rides the MOST URGENT claimant's
+                # lane: one consensus-priority claimant lifts the
+                # whole shared flush
+                lane = (min(lanes, key=sigcache.lane_priority)
+                        if lanes else None)
+                results = self._verify(batch, lane=lane)
+            else:
+                results = self._verify(batch)
         except Exception as exc:        # verify_fn itself failed
             results = {h: exc for h in batch}
         with self._cv:

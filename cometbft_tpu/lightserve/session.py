@@ -85,12 +85,14 @@ class LightServeSession:
             commit = self.block_store.load_seen_commit(height)
         return commit
 
-    def _verify_heights(self, heights) -> dict:
+    def _verify_heights(self, heights, lane: str | None = None) -> dict:
         """Verify one merged batch of heights: host-side structure +
         voting-power tallies per commit, then ONE deferred window
         through the pipeline.  Returns {height: Exception | None} —
         per-height blame, so one forged commit in a merged flush fails
-        only the requests that needed that height."""
+        only the requests that needed that height.  `lane` (from the
+        coalescer: the most urgent claimant's consumer) re-lanes the
+        window's QoS priority; attribution stays lightserve."""
         out: dict = {h: None for h in heights}
         db = validation.DeferredSigBatch()
         with trace_span("lightserve", "collect", heights=len(out)):
@@ -111,7 +113,8 @@ class LightServeSession:
         with trace_span("lightserve", "verify_dispatch", sigs=nsigs), \
                 sigcache.consumer("lightserve"):
             verdict = db.verify_async(self._pipeline(),
-                                      subsystem="lightserve")
+                                      subsystem="lightserve",
+                                      lane=lane)
             bad = verdict.failed_contexts()
         if nsigs:
             self.verify_windows += 1

@@ -408,6 +408,10 @@ class Node(BaseService):
             # the crypto layers report through the process-wide seam
             libmetrics.set_device_metrics(DeviceMetrics(registry))
             libmetrics.set_cache_metrics(CacheMetrics(registry))
+            # ... and the verify-plane QoS scheduler's per-lane
+            # counters (crypto/sched.py) through its own seam
+            libmetrics.set_scheduler_metrics(
+                libmetrics.SchedulerMetrics(registry))
             # stage spans (decode/verify-dispatch/device/apply/store):
             # the block-ingest breakdown reports through the same kind
             # of process-wide seam (libs/trace.py)
@@ -530,6 +534,7 @@ class Node(BaseService):
             from ..ops import compile_hook
             libmetrics.set_device_metrics(None)
             libmetrics.set_cache_metrics(None)
+            libmetrics.set_scheduler_metrics(None)
             libmetrics.set_devprof_metrics(None)
             libtrace.set_tracer(None)
             libflightrec.set_recorder(None)

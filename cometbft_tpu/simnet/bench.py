@@ -596,7 +596,17 @@ def bench_verify_contention(n_votes: int | None = None,
     number `vote_verify_p99_ms` (gated lower-is-better next to
     `bulk_verify_p99_ms`).  The signature-verdict cache is forced off
     so the queueing is real verify work, not cache hits.  Stores the
-    combined record in `last_contention`."""
+    combined record in `last_contention`.
+
+    QoS A/B (crypto/sched.py): the contended arm runs twice over the
+    SAME seeded feeds — scheduler ON and scheduler OFF (plain FIFO).
+    Both arms must produce IDENTICAL verdict digests (the scheduler
+    may only reorder, never change answers — enforced here), the OFF
+    arm's vote p99 lands as the diagnostic
+    `vote_verify_p99_ms_sched_off`, and the bulk tenant's sigs/s
+    ON-vs-OFF lands as `bulk_verify_throughput_ratio` (gated
+    higher-is-better: priority lanes must not tax bulk throughput
+    beyond the tolerated margin)."""
     global last_contention
     n_votes = n_votes if n_votes is not None else _env_int(
         "SIMNET_CONTENTION_VOTES", 192)
@@ -607,6 +617,7 @@ def bench_verify_contention(n_votes: int | None = None,
     light_requests = light_requests if light_requests is not None \
         else _env_int("SIMNET_CONTENTION_LIGHT", 32)
 
+    import hashlib as _hashlib
     import threading
 
     from ..crypto import dispatch
@@ -619,27 +630,37 @@ def bench_verify_contention(n_votes: int | None = None,
     light_feed = _contention_feed("light", seed, light_requests,
                                   light_window_size)
 
-    def run_arm(contended: bool) -> dict:
+    def run_arm(contended: bool, qos: bool = True) -> dict:
         rec = latledger.LatLedgerRecorder()
         prev_rec = latledger.recorder()
         latledger.set_recorder(rec)
         pipe = dispatch.VerifyPipeline(depth=depth,
-                                       name="ContentionPipe")
+                                       name="ContentionPipe",
+                                       qos=qos)
         errors: list = []
+        verdict_runs: dict[str, tuple] = {}
+        feed_walls: dict[str, float] = {}
 
         def feed(label: str, windows: list) -> None:
             # device_threshold pass-through: tier-1 runs pin the host
             # verify path (no cold device compile inside the timing)
             try:
+                t0 = time.monotonic()
                 handles = [pipe.submit(
                     w, subsystem=label,
                     device_threshold=device_threshold)
                     for w in windows]
+                out = []
                 for h in handles:
-                    ok, _ = h.result(timeout=timeout)
+                    ok, verdicts = h.result(timeout=timeout)
                     if not ok:
                         raise RuntimeError(
                             f"{label} window failed verification")
+                    out.append(tuple(bool(v) for v in verdicts))
+                # per-tenant wall (first submit -> last resolve) and
+                # the verdict transcript for the A/B digest
+                feed_walls[label] = time.monotonic() - t0
+                verdict_runs[label] = tuple(out)
             except Exception as e:     # surfaced after the join
                 errors.append((label, e))
 
@@ -662,6 +683,7 @@ def bench_verify_contention(n_votes: int | None = None,
                 t.join(timeout=timeout)
             if any(t.is_alive() for t in others):
                 raise RuntimeError("contention feed thread stalled")
+            sched = pipe.scheduler_snapshot()
         finally:
             pipe.stop()
             latledger.set_recorder(prev_rec)
@@ -674,34 +696,62 @@ def bench_verify_contention(n_votes: int | None = None,
                 raise RuntimeError(
                     "latency decomposition does not sum to wall: "
                     f"{row}")
+        digest = _hashlib.sha256(repr(sorted(
+            verdict_runs.items())).encode()).hexdigest()
         return {"consumers": rec.consumers(),
                 "slo": rec.slo.snapshot(),
-                "requests": rec.recorded}
+                "requests": rec.recorded,
+                "qos": qos,
+                "digest": digest,
+                "feed_walls_s": {k: round(v, 6)
+                                 for k, v in feed_walls.items()},
+                "sched": sched}
 
     prev_cache_enabled = sigcache._enabled_override
     sigcache.set_enabled(False)
     try:
         solo = run_arm(contended=False)
-        contended = run_arm(contended=True)
+        contended = run_arm(contended=True, qos=True)
+        contended_off = run_arm(contended=True, qos=False)
     finally:
         sigcache.set_enabled(prev_cache_enabled)
+
+    # the scheduler may only REORDER work, never change answers: the
+    # same seeded feeds must verify to the same transcript both arms
+    if contended["digest"] != contended_off["digest"]:
+        raise RuntimeError(
+            "QoS A/B arms disagree on verdicts: "
+            f"on={contended['digest'][:16]} "
+            f"off={contended_off['digest'][:16]}")
 
     vote_solo = solo["consumers"].get("consensus", {})
     vote_load = contended["consumers"].get("consensus", {})
     bulk_load = contended["consumers"].get("blocksync", {})
+    vote_off = contended_off["consumers"].get("consensus", {})
     if len(contended["consumers"]) < 3:
         raise RuntimeError(
             "contended arm saw fewer than 3 consumers: "
             f"{sorted(contended['consumers'])}")
+    # bulk tenant throughput, sigs/s over its own feed wall: the cost
+    # the priority lanes charge the bulk path
+    bulk_sigs = bulk_windows * bulk_window_size
+    bulk_wall_on = contended["feed_walls_s"].get("blocksync", 0.0)
+    bulk_wall_off = contended_off["feed_walls_s"].get("blocksync", 0.0)
+    thr_on = bulk_sigs / bulk_wall_on if bulk_wall_on else 0.0
+    thr_off = bulk_sigs / bulk_wall_off if bulk_wall_off else 0.0
     last_contention = {
         "vote_verify_p99_ms": vote_load.get("p99_ms", 0.0),
         "bulk_verify_p99_ms": bulk_load.get("p99_ms", 0.0),
         "vote_verify_p99_ms_solo": vote_solo.get("p99_ms", 0.0),
+        "vote_verify_p99_ms_sched_off": vote_off.get("p99_ms", 0.0),
         "vote_verify_p50_ms": vote_load.get("p50_ms", 0.0),
         "vote_p99_contention_ratio": round(
             vote_load.get("p99_ms", 0.0)
             / vote_solo.get("p99_ms", 1.0), 2)
         if vote_solo.get("p99_ms") else 0.0,
+        "bulk_verify_sigs_per_s": round(thr_on, 1),
+        "bulk_verify_throughput_ratio": round(thr_on / thr_off, 3)
+        if thr_off else 0.0,
         "votes": n_votes,
         "bulk_windows": bulk_windows,
         "bulk_window_size": bulk_window_size,
@@ -710,5 +760,6 @@ def bench_verify_contention(n_votes: int | None = None,
         "depth": depth,
         "solo": solo,
         "contended": contended,
+        "contended_sched_off": contended_off,
     }
     return last_contention

@@ -142,14 +142,17 @@ class DeferredSigBatch:
         raise CommitVerificationError(
             "BUG: deferred batch failed with no invalid signatures")
 
-    def verify_async(self, pipeline, subsystem: str = "pipeline"):
+    def verify_async(self, pipeline, subsystem: str = "pipeline",
+                     lane: str | None = None):
         """Submit the collected entries through an overlapped
         VerifyPipeline (crypto/dispatch.py) instead of verifying
         inline; returns a waiter whose .wait() has EXACTLY verify()'s
         semantics (raises ErrInvalidSignature naming the first failing
         commit, with .failed_ctx) once the window's verdict future
         resolves.  The caller keeps collecting the next window while
-        this one is staged/on device."""
+        this one is staged/on device.  `lane` re-lanes the window
+        under a different QoS priority (crypto/sched.py) without
+        touching `subsystem`'s trace/ledger attribution."""
         self._entries, entries = [], self._entries
         if not entries:
             return _DeferredVerdict(entries, None)
@@ -157,7 +160,7 @@ class DeferredSigBatch:
             [(pub, sign_bytes, sig)
              for _, _, pub, sign_bytes, sig in entries],
             subsystem=subsystem, ctx=entries[0][1],
-            device_threshold=self.DEVICE_THRESHOLD)
+            device_threshold=self.DEVICE_THRESHOLD, lane=lane)
         return _DeferredVerdict(entries, handle)
 
 

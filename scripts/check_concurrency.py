@@ -154,6 +154,12 @@ KNOBS = {
     # sanitizer plane (lockrank PR)
     "COMETBFT_TPU_LOCKRANK",
     "COMETBFT_TPU_SANITIZERS",
+    # crypto/sched.py — verify-plane QoS scheduler
+    "COMETBFT_TPU_SCHED",
+    "COMETBFT_TPU_SCHED_QUANTUM",
+    "COMETBFT_TPU_SCHED_HOLD_MS",
+    "COMETBFT_TPU_SCHED_BLOCKSYNC_LANE",
+    "COMETBFT_TPU_SCHED_LIGHT_LANE",
     # libs/latledger.py — per-consumer verify-latency ledger
     "COMETBFT_TPU_LATLEDGER",
     "COMETBFT_TPU_LATLEDGER_CAPACITY",

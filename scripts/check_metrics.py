@@ -45,7 +45,15 @@ Checks:
      sigcache.CONSUMERS, and every latledger.DEFAULT_SLO_TARGETS key
      must too (both directions of the shared registry): an
      unregistered label would silently fork a per-consumer latency
-     series the SLO tracker never watches.
+     series the SLO tracker never watches;
+  9. the QoS lane registry (sigcache.LANES, crypto/sched.py's dispatch
+     order) must cover sigcache.CONSUMERS exactly — both directions: a
+     consumer without a lane would silently schedule at the default
+     (lowest) priority, and a lane for a label no caller can produce
+     is dead configuration.  Every literal `lane="<label>"` kwarg
+     across cometbft_tpu/ (pipeline submit / verify_async re-laning)
+     must name a registered lane — a misspelled lane would demote the
+     caller to the default class with no error.
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -455,6 +463,78 @@ def run_registry_checks(root: Path | None = None,
     return findings
 
 
+def registered_lanes(path: Path | None = None) -> dict[str, int]:
+    """sigcache.LANES — the QoS lane-priority registry crypto/sched.py
+    dispatches by.  AST only; literal str->int entries."""
+    tree = ast.parse((path or SIGCACHE_PY).read_text())
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "LANES"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        out = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                out[k.value] = v.value
+        return out
+    return {}
+
+
+def lane_call_sites(root: Path | None = None) -> list[dict]:
+    """[{file, lineno, value}] for every literal `lane="<label>"`
+    kwarg under ``root`` (default cometbft_tpu/): pipeline submits and
+    verify_async re-lanings.  Variables (e.g. the SCHED_LANE env
+    knobs) forward labels validated at runtime by sched.lane_for."""
+    root = root or (REPO / "cometbft_tpu")
+    sites = []
+    for py in sorted(root.rglob("*.py")):
+        tree = ast.parse(py.read_text())
+        rel = str(py.relative_to(root.parent if root.is_dir() else root))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "lane" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    sites.append({"file": rel, "lineno": node.lineno,
+                                  "value": kw.value.value})
+    return sites
+
+
+def run_lane_checks(root: Path | None = None,
+                    sigcache_path: Path | None = None) -> list[str]:
+    """Rule 9 findings: LANES covers CONSUMERS exactly (both
+    directions) and every literal lane kwarg names a registered
+    lane."""
+    findings = []
+    lanes = registered_lanes(sigcache_path)
+    consumers = registered_consumers(sigcache_path)
+    if not lanes:
+        return ["sigcache.LANES not found or empty "
+                "(rule 9 parser broken?)"]
+    for label in sorted(consumers - set(lanes)):
+        findings.append(
+            f"consumer {label!r} has no entry in sigcache.LANES — it "
+            "would silently schedule at the default (lowest) priority")
+    for label in sorted(set(lanes) - consumers):
+        findings.append(
+            f"sigcache.LANES key {label!r} is not a registered "
+            "consumer — a lane no caller can produce is dead "
+            "configuration")
+    for s in lane_call_sites(root):
+        if s["value"] not in lanes:
+            findings.append(
+                f"{s['file']}:{s['lineno']}: lane label "
+                f"{s['value']!r} is not registered in sigcache.LANES "
+                "— it would demote the caller to the default class "
+                "with no error")
+    return findings
+
+
 def run_checks() -> list[str]:
     """All findings as human-readable strings; empty means clean."""
     metrics = registered_metrics()
@@ -503,6 +583,7 @@ def run_checks() -> list[str]:
                 "cometbft_tpu/ or tests/")
     findings.extend(run_label_checks())
     findings.extend(run_registry_checks())
+    findings.extend(run_lane_checks())
     return findings
 
 

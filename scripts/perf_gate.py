@@ -67,7 +67,16 @@ SKIP = {"rlc_batch", "headline_passes", "vs_baseline",
         # MSM-engine arm; the ladder arm moving says nothing about the
         # shipping path).  secp256k1_msm_sigs_per_sec DOES gate, with
         # the default higher-is-better direction.
-        "mixed_commit_sigs_per_sec_ladder"}
+        "mixed_commit_sigs_per_sec_ladder",
+        # the scheduler-OFF arm of the QoS A/B (crypto/sched.py): a
+        # diagnostic showing what the vote tail costs WITHOUT priority
+        # lanes — it moving says nothing about the shipping path.  The
+        # ON-arm vote_verify_p99_ms gates lower-is-better above, and
+        # bulk_verify_throughput_ratio gates with the default
+        # higher-is-better direction (priority lanes must not tax the
+        # bulk tenant's throughput).  bulk_verify_sigs_per_s is the
+        # raw numerator, machine-speed-dependent, so a reading.
+        "vote_verify_p99_ms_sched_off", "bulk_verify_sigs_per_s"}
 
 
 def load_record(path: str) -> dict | None:

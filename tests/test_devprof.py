@@ -74,7 +74,8 @@ class TestGapAttribution:
             "backpressure": pytest.approx(0.25),
             "no_work": pytest.approx(1.0),
             "drain": pytest.approx(0.75),
-            "quarantine": pytest.approx(0.0)}
+            "quarantine": pytest.approx(0.0),
+            "sched_hold": pytest.approx(0.0)}
         assert d["dispatches"] == 2
         assert d["occupancy"] == pytest.approx(0.7 / 3.0, abs=1e-6)
         assert_exact_partition(d)

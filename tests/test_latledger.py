@@ -598,11 +598,28 @@ class TestContentionBench:
             latledger.set_recorder(prev)
         for key in ("vote_verify_p99_ms", "vote_verify_p99_ms_solo",
                     "bulk_verify_p99_ms", "vote_p99_contention_ratio",
-                    "solo", "contended"):
+                    "vote_verify_p99_ms_sched_off",
+                    "bulk_verify_throughput_ratio",
+                    "bulk_verify_sigs_per_s",
+                    "solo", "contended", "contended_sched_off"):
             assert key in res, key
         assert res["vote_verify_p99_ms"] > 0.0
         assert res["bulk_verify_p99_ms"] > 0.0
         assert res["vote_p99_contention_ratio"] > 0.0
+        # the QoS A/B: both contended arms verified the same seeded
+        # feeds to the same transcript (the bench raises otherwise —
+        # assert the shape so a silent regression cannot pass), the
+        # OFF arm is plain FIFO, and the bulk-throughput ratio is real
+        assert res["contended"]["qos"] is True
+        assert res["contended_sched_off"]["qos"] is False
+        assert res["contended"]["digest"] == \
+            res["contended_sched_off"]["digest"]
+        assert res["vote_verify_p99_ms_sched_off"] > 0.0
+        assert res["bulk_verify_throughput_ratio"] > 0.0
+        assert res["bulk_verify_sigs_per_s"] > 0.0
+        off_sched = res["contended_sched_off"]["sched"]
+        assert all(s["preemptions"] == 0 for s in off_sched.values())
+        assert res["contended"]["sched"]["consensus"]["windows"] == 24
         # the contended arm really multiplexed >= 3 consumers through
         # ONE pipeline (the bench itself raises otherwise — assert the
         # shape here so a silent regression cannot pass)

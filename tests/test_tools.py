@@ -476,6 +476,61 @@ class TestBucketConsumerRegistryLint:
         assert not any("'consensus'" in f for f in findings)
 
 
+class TestLaneRegistryLint:
+    """check_metrics rule 9: sigcache.LANES is the closed QoS
+    lane-priority registry crypto/sched.py dispatches by — it must
+    cover CONSUMERS exactly (both directions) and every literal
+    lane= kwarg in the tree must name a registered lane."""
+
+    def test_registry_parses_and_orders_lanes(self):
+        mod = TestCheckMetrics._load()
+        lanes = mod.registered_lanes()
+        assert set(lanes) == mod.registered_consumers()
+        assert lanes["consensus"] == 0 and lanes["probe"] == 0
+        assert lanes["consensus"] < lanes["evidence"] \
+            < lanes["light"] < lanes["blocksync"] < lanes["crypto"]
+        assert lanes["light"] == lanes["lightserve"]
+
+    def test_repo_is_clean(self):
+        mod = TestCheckMetrics._load()
+        assert mod.run_lane_checks() == []
+        # every repo call site forwards a runtime-validated variable
+        # (the SCHED_LANE knobs, coalescer claimant lanes) — literal
+        # labels, when they appear, are linted by the tmp-tree test
+        assert isinstance(mod.lane_call_sites(), list)
+
+    def test_lint_flags_lane_registry_drift(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        sig = tmp_path / "sigcache.py"
+        sig.write_text(
+            "CONSUMERS = frozenset({'consensus', 'blocksync'})\n"
+            "LANES = {'consensus': 0, 'ghostlane': 7}\n")
+        site = tmp_path / "x.py"
+        site.write_text(
+            "def f(pipe):\n"
+            "    pipe.submit([], subsystem='blocksync',"
+            " lane='mystery')\n"
+            "    pipe.submit([], subsystem='blocksync',"
+            " lane='consensus')\n")
+        findings = mod.run_lane_checks(root=tmp_path,
+                                       sigcache_path=sig)
+        assert any("'blocksync'" in f and "no entry" in f
+                   for f in findings)
+        assert any("'ghostlane'" in f and "not a registered"
+                   in f for f in findings)
+        assert any("'mystery'" in f for f in findings)
+        assert not any("lane label 'consensus'" in f
+                       for f in findings)
+
+    def test_lint_flags_missing_registry(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        sig = tmp_path / "sigcache.py"
+        sig.write_text("CONSUMERS = frozenset({'consensus'})\n")
+        findings = mod.run_lane_checks(root=tmp_path,
+                                       sigcache_path=sig)
+        assert findings and "LANES not found" in findings[0]
+
+
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
     as a tier-1 test so a perf cliff fails CI before a round lands."""
@@ -704,6 +759,39 @@ class TestPerfGate:
         ok = mod.gate({"headline": 100.0,
                        "vote_verify_p99_ms": 45.0,
                        "bulk_verify_p99_ms": 380.0},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
+
+    def test_sched_extras_gate_direction(self, tmp_path):
+        """bulk_verify_throughput_ratio (QoS scheduler fairness floor:
+        contended bulk throughput over solo) gates in the default
+        higher-is-better direction — the scheduler may tax bulk at
+        most so far, and that ratio collapsing is the regression.  The
+        sched-OFF p99 and raw bulk sigs/s are same-run diagnostics for
+        the gated readings, so load_record drops them via SKIP."""
+        mod = self._load()
+        assert "bulk_verify_throughput_ratio" not in mod.LOWER_IS_BETTER
+        assert "bulk_verify_throughput_ratio" not in mod.SKIP
+        assert "vote_verify_p99_ms_sched_off" in mod.SKIP
+        assert "bulk_verify_sigs_per_s" in mod.SKIP
+        self._write(tmp_path, "BENCH_r01.json", 100.0,
+                    extra={"bulk_verify_throughput_ratio": 0.95,
+                           "vote_verify_p99_ms_sched_off": 300.0,
+                           "bulk_verify_sigs_per_s": 5000.0})
+        rec = mod.load_record(str(tmp_path / "BENCH_r01.json"))
+        assert rec["bulk_verify_throughput_ratio"] == 0.95
+        assert "vote_verify_p99_ms_sched_off" not in rec
+        assert "bulk_verify_sigs_per_s" not in rec
+        history = [dict(rec) for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "bulk_verify_throughput_ratio": 0.60},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["bulk_verify_throughput_ratio"]["status"] == \
+            "regressed"
+        ok = mod.gate({"headline": 100.0,
+                       "bulk_verify_throughput_ratio": 0.97},
                       history, tolerance=0.15, last_n=3, min_points=2)
         assert all(r["status"] == "ok" for r in ok)
 

@@ -285,3 +285,59 @@ class TestDeferredSigBatch:
         with pytest.raises(ErrInvalidSignature) as ei:
             batch.verify()
         assert ei.value.failed_ctx == 6
+
+
+class TestQosSealAdvisory:
+    def test_late_vote_seals_early_behind_bulk_burst(self):
+        """Regression for the QoS seal advisory: a single vote arriving
+        while a blocksync staging burst occupies the shared pipeline
+        must NOT ride out the full flush interval — qos_seal_due cuts
+        the accumulation short (cross-class work is queued), so the
+        vote resolves well under the consensus deadline while the bulk
+        windows are still grinding on the host path."""
+        from cometbft_tpu.crypto import dispatch as vd
+        from cometbft_tpu.crypto import sigcache
+        from tests.test_dispatch import make_items, serial_verdicts
+
+        sigcache.reset()
+        flush = 0.8
+        with vd.VerifyPipeline(depth=8, name="SealPipe") as pipe:
+            feeds = [make_items(12, seed=60 + i, msg=b"seal-bulk")
+                     for i in range(4)]
+            bulk = [pipe.submit(list(f), subsystem="blocksync",
+                                device_threshold=10**9)
+                    for f in feeds]
+            sv = StreamingVerifier(flush_interval=flush,
+                                   device_threshold=10**9,
+                                   pipeline=pipe, warmup=False)
+            sv.start()
+            try:
+                pk, msg, sig = make_sig(0, msg=b"late-vote")
+                t0 = time.monotonic()
+                fut = sv.submit(pk, msg, sig)
+                assert fut.result(timeout=30) is True
+                elapsed = time.monotonic() - t0
+            finally:
+                sv.stop()
+            for f, h in zip(feeds, bulk):
+                assert h.result(timeout=60)[1] == serial_verdicts(f)
+        assert sv.verified == 1
+        # without the advisory the vote waits out the whole 0.8s
+        # interval; the seal fires on the first poll tick instead
+        assert elapsed < flush / 2, elapsed
+
+    def test_idle_or_stopped_pipeline_never_seals(self):
+        """Edge cases of the advisory: an empty queue keeps batching
+        (the flush interval is the designed latency — sealing per-vote
+        whenever the pipeline goes idle would defeat coalescing), and
+        a stopped pipeline never advises (the own-class backpressure
+        case lives in tests/test_sched.py)."""
+        from cometbft_tpu.crypto import dispatch as vd
+
+        with vd.VerifyPipeline(depth=4, name="OwnClassPipe") as pipe:
+            items = [make_sig(i, msg=b"own-class") for i in range(6)]
+            assert not pipe.qos_seal_due("consensus")  # idle queue
+            h = pipe.submit([items[0]], subsystem="consensus",
+                            device_threshold=10**9)
+            h.result(timeout=30)
+        assert not pipe.qos_seal_due("consensus")  # stopped pipeline
