@@ -481,6 +481,66 @@ def bench_consensus_e2e() -> dict:
     return simbench.bench_consensus_e2e()
 
 
+def bench_e2e_fleet() -> dict:
+    """Fleet telemetry plane e2e (cometbft_tpu/fleetobs/): a real
+    multi-process testnet with a SIGKILL perturbation, then the
+    collector harvests every node's crash-safe spool + live fleetobs
+    RPC dump and merges them onto one clock axis.  Reports the share
+    of committed heights carrying cross-process flow edges, the solved
+    clock-offset spread, and the fleet critical-path device share.
+    Sizes via E2E_FLEET_VALS / E2E_FLEET_BLOCKS (defaults 3 x 4)."""
+    import tempfile
+
+    from cometbft_tpu.e2e import Manifest, Testnet
+    from cometbft_tpu.fleetobs import report
+
+    vals = max(2, int(os.environ.get("E2E_FLEET_VALS", "3")))
+    blocks = int(os.environ.get("E2E_FLEET_BLOCKS", "4"))
+    lines = ["load_tx_rate = 10", "run_blocks = %d" % blocks]
+    for i in range(vals):
+        lines.append("[node.validator%d]" % i)
+    lines.append('perturb = ["kill"]')     # the last validator dies
+    manifest = Manifest.parse("\n".join(lines) + "\n")
+    with tempfile.TemporaryDirectory(prefix="fleetbench-") as home:
+        net = Testnet(manifest, os.path.join(home, "net"),
+                      chain_id="bench-fleet")
+        net.setup()
+        net.start()
+        try:
+            net.wait_for_height(blocks, timeout=180)
+            net.run_perturbations()
+            tip = max(n.height() for n in net.nodes if n.running())
+            net.wait_for_height(tip + 2, timeout=180, nodes=net.nodes)
+            time.sleep(1.5)        # > one spool flush post-restart
+            capture = net.collect_telemetry()
+        finally:
+            net.stop()
+    fleet = report.fleet_report(capture)
+    cov = fleet["coverage"]
+    merged = fleet["merged"]
+    out = {
+        "e2e_fleet_height_coverage": cov["height_coverage"],
+        "e2e_fleet_clock_offset_spread_ms":
+            merged["clock_offset_spread_ms"],
+        "e2e_fleet_critical_path_device_share":
+            fleet["critical_path"]["summary"]["device_share"],
+        "detail": {
+            "nodes": sorted(capture["nodes"]),
+            "union_heights": cov["union_heights"],
+            "common_heights": cov["common_heights"],
+            "cross_flow_edges": cov["cross_flow_edges"],
+            "offset_methods": sorted(
+                {v["method"] for v in merged["offsets"].values()}),
+            "occupancy": fleet["occupancy"]["fleet"],
+        },
+    }
+    bench_e2e_fleet.last = out
+    return out
+
+
+bench_e2e_fleet.last = None
+
+
 def bench_commit_reverify(n_sigs: int | None = None,
                           iters: int | None = None) -> float:
     """Warm-cache commit re-verify rate: what the H+1 LastCommit
@@ -1467,6 +1527,35 @@ def main() -> None:
             k: _last_chaos.get(k) for k in ("partition_heal",
                                             "device_fault_drain",
                                             "device_flap_quarantine")}
+        _sync_carried()
+        persist()
+    # fleet telemetry plane (fleetobs/): all three numbers come from
+    # ONE bench_e2e_fleet() run — a real multi-process testnet with a
+    # SIGKILL, spool-harvested and merged onto one clock axis.
+    # Coverage gates higher-is-better (flow edges disappearing means
+    # the in-band trace context or the merge broke); the offset spread
+    # is LOWER_IS_BETTER and the device share is a reading (both
+    # registered in scripts/perf_gate.py).
+    run_extra("e2e_fleet_height_coverage",
+              lambda: bench_e2e_fleet()["e2e_fleet_height_coverage"],
+              "e2e_fleet_config",
+              "fleet telemetry e2e (docs/OBSERVABILITY.md): real"
+              " process testnet + kill perturbation, crash-safe spools"
+              " + live fleetobs dumps merged onto one clock axis;"
+              " share of committed heights with a cross-process flow"
+              " edge (E2E_FLEET_VALS x E2E_FLEET_BLOCKS, defaults"
+              " 3 x 4)")
+    if ("e2e_fleet_height_coverage" not in carried_keys
+            and isinstance(extra.get("e2e_fleet_height_coverage"),
+                           (int, float))
+            and isinstance(bench_e2e_fleet.last, dict)):
+        for key in ("e2e_fleet_clock_offset_spread_ms",
+                    "e2e_fleet_critical_path_device_share"):
+            val = bench_e2e_fleet.last.get(key)
+            if isinstance(val, (int, float)):
+                extra[key] = val
+                carried_keys.discard(key)
+        extra["e2e_fleet_detail"] = bench_e2e_fleet.last["detail"]
         _sync_carried()
         persist()
 

@@ -276,7 +276,10 @@ def write_config_file(path: str, cfg: Config) -> None:
 def load_config(root_dir: str) -> Config:
     """Read <root>/config/config.toml into a Config (missing file =
     defaults)."""
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python < 3.11: tomllib is vendored tomli
+        import tomli as tomllib
     cfg = default_config(root_dir)
     path = os.path.join(root_dir, "config", "config.toml")
     if not os.path.exists(path):

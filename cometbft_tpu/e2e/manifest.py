@@ -13,7 +13,10 @@ nodes start late, which get perturbed — and loads from TOML:
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomllib is vendored tomli
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 PERTURBATIONS = ("kill", "pause", "restart", "disconnect")

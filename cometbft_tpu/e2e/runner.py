@@ -24,6 +24,7 @@ import signal
 import subprocess
 import sys
 import time
+import urllib.parse
 import urllib.request
 
 from ..config import load_config, write_config_file
@@ -67,15 +68,31 @@ class TestnetNode:
         return f"{self.node_id}@127.0.0.1:{self.p2p_port}"
 
     def rpc(self, method: str, timeout: float = 5.0, **params):
-        qs = "&".join(f"{k}={v}" for k, v in params.items())
+        # urlencode, not f-string joins: params carrying &/=/space or
+        # base64 '+' must reach the server intact
         url = f"http://127.0.0.1:{self.rpc_port}/{method}"
-        if qs:
-            url += f"?{qs}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             body = json.loads(resp.read())
         if "error" in body and body["error"]:
             raise E2EError(f"{self.name} {method}: {body['error']}")
         return body["result"]
+
+    def rpc_retry(self, method: str, attempts: int = 5,
+                  backoff: float = 0.4, **params):
+        """Bounded retry-with-backoff around `rpc` for invariant
+        checks: a node mid-restart answers connection-refused for a
+        moment, which is a perturbation artifact, not a divergence."""
+        delay = backoff
+        for attempt in range(attempts):
+            try:
+                return self.rpc(method, **params)
+            except (OSError, E2EError):
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2
 
     def height(self) -> int:
         try:
@@ -88,7 +105,12 @@ class TestnetNode:
         # snapshot window = interval * keep ≈ 100 heights: a fast e2e
         # chain must not outrun a statesyncing peer's chunk fetches
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
-               "COMETBFT_TPU_KVSTORE_SNAPSHOT_INTERVAL": "10"}
+               "COMETBFT_TPU_KVSTORE_SNAPSHOT_INTERVAL": "10",
+               # fleet telemetry (libs/telspool.py): spool on a short
+               # interval so even a node SIGKILLed seconds into its
+               # life leaves flushed segments for the collector
+               "COMETBFT_TPU_TELSPOOL": "1",
+               "COMETBFT_TPU_TELSPOOL_INTERVAL_S": "0.5"}
         # the child duplicates the fd; close the parent's copy
         with open(self.log_path, "ab") as log:
             self.proc = subprocess.Popen(
@@ -182,6 +204,13 @@ class Testnet:
             cfg.p2p.persistent_peers = ",".join(
                 p.p2p_addr for p in self.nodes if p is not node)
             cfg.p2p.emulate_latency_ms = node.manifest.latency_ms
+            # instrumentation ON: the subprocess installs its seams
+            # (devprof/latledger/tracetl populate) and the fleetobs
+            # snapshot can spool the Prometheus exposition.  Each node
+            # needs its own free listener port on this shared host.
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = \
+                f"127.0.0.1:{_free_port()}"
             if self.fast:
                 # a proposal needs ~3 one-way hops (proposal + parts +
                 # votes) before the propose timeout may fire without
@@ -308,6 +337,17 @@ class Testnet:
             for kind in node.manifest.perturb:
                 self.perturb(node, kind)
 
+    # -- telemetry (fleetobs) ---------------------------------------------
+
+    def collect_telemetry(self) -> dict:
+        """Harvest the fleet capture — crash-safe spools from every
+        node home plus live fleetobs RPC dumps from the nodes that
+        answer — in the fleetobs/collect.py capture shape.  Survives
+        kill/pause/restart perturbations by construction: a dead node
+        contributes its spooled pre-kill segments."""
+        from ..fleetobs import collect
+        return collect.collect_testnet(self)
+
     # -- invariants (reference test/e2e/tests/block_test.go) --------------
 
     def check_block_identity(self) -> int:
@@ -317,13 +357,13 @@ class Testnet:
         if len(live) < 2:
             raise E2EError("not enough live nodes to compare")
         tip = min(n.height() for n in live)
-        base = max(int(n.rpc("status")["sync_info"]
+        base = max(int(n.rpc_retry("status")["sync_info"]
                        .get("earliest_block_height", 1)) for n in live)
         compared = 0
         for h in range(base, tip + 1):
             seen = {}
             for n in live:
-                meta = n.rpc("block", height=h)
+                meta = n.rpc_retry("block", height=h)
                 key = (meta["block_id"]["hash"],
                        meta["block"]["header"]["app_hash"])
                 seen[n.name] = key

@@ -1,0 +1,17 @@
+"""Fleet observability plane: cross-process telemetry collection.
+
+The seventh observability layer.  flightrec / tracetl / devprof /
+latledger / Prometheus each describe ONE interpreter; the e2e runner's
+real node subprocesses need their telemetry harvested (live RPC dumps
+plus the crash-safe spools libs/telspool.py persists), clock-aligned
+onto one fleet time axis (clocksync.py), and merged into the single
+Perfetto trace / critical-path / histogram readings the in-process
+layers already provide (merge.py, report.py).
+
+    capture = collect.collect_testnet(testnet)   # or load from JSON
+    fleet = report.fleet_report(capture)         # trace + readings
+"""
+
+from . import clocksync, collect, merge, report
+
+__all__ = ["clocksync", "collect", "merge", "report"]

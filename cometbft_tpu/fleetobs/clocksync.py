@@ -1,0 +1,178 @@
+"""NTP-style clock-offset estimation from p2p send/recv edge pairs.
+
+Every process records timeline events against its own
+``time.perf_counter()``, which resets per process — so per-process
+telemetry lives in disjoint CLOCK DOMAINS, one per (node, incarnation).
+Merging them onto one fleet axis needs one offset per domain, and the
+p2p layer already emits the measurements: every gossip message carries
+a trace context, and the sender's ``send`` event plus the receiver's
+``recv`` event for the same context are a one-way delay sample
+contaminated by exactly the offset difference we want.
+
+The estimator is the classic NTP midpoint argument.  For domains A and
+B let ``m_AB = min over edges A->B of (t_recv_B - t_send_A)`` (local
+clocks).  Writing ``O_X`` for the offset mapping X's clock onto the
+fleet axis and assuming the MINIMUM one-way delay is symmetric (same
+wire both ways — true by construction in the e2e runner's loopback
+mesh),
+
+    m_AB = d_min - (O_B - O_A)
+    m_BA = d_min + (O_B - O_A)
+    =>  O_B - O_A = (m_BA - m_AB) / 2,    d_min = (m_AB + m_BA) / 2
+
+Asymmetric ACTUAL latency only widens the residual: the recovered
+offset is always within the minimum one-way delay of the truth, which
+is the bound tests/test_fleetobs.py pins.  Relative offsets propagate
+by BFS from a reference domain, so any domain connected to the
+reference through bidirected edge pairs gets an edge-solved offset.
+Degenerate domains — no edges at all, or edges in only one direction —
+fall back to their spooled wall-clock anchor (``clock`` records, see
+libs/telspool.py): fleet time = wall time as that process saw it,
+accurate to NTP-on-the-host rather than to the wire.
+"""
+
+from __future__ import annotations
+
+METHOD_REFERENCE = "reference"
+METHOD_EDGES = "edges"
+METHOD_ANCHOR = "anchor"
+METHOD_NONE = "none"
+
+
+def pair_edges(events_by_domain: dict) -> list[tuple]:
+    """Pair cross-domain send/recv timeline events into
+    ``(src_domain, dst_domain, t_send, t_recv)`` edges.
+
+    ``events_by_domain`` maps a domain key to its tracetl event dicts
+    (the ``events()`` shape).  Pairing is by trace-context identity;
+    a context claimed by sends in MORE than one domain (a post-restart
+    ctx-seq collision) is ambiguous and dropped.
+    """
+    sends: dict[tuple, list] = {}
+    recvs: list[tuple] = []
+    for dom, evs in events_by_domain.items():
+        for e in evs:
+            ctx = e.get("ctx")
+            if not ctx or len(ctx) != 4:
+                continue
+            fid = tuple(ctx)
+            if e.get("ph") == "send":
+                sends.setdefault(fid, []).append((dom, e["t"]))
+            elif e.get("ph") == "recv":
+                recvs.append((fid, dom, e["t"]))
+    edges = []
+    for fid, dom, t_recv in recvs:
+        cands = sends.get(fid)
+        if not cands:
+            continue
+        src_doms = {d for d, _ in cands}
+        if len(src_doms) != 1:
+            continue                    # ambiguous across incarnations
+        src, t_send = cands[0]
+        if src == dom:
+            continue                    # self-delivery carries no info
+        edges.append((src, dom, t_send, t_recv))
+    return edges
+
+
+def min_deltas(edges: list[tuple]) -> dict:
+    """Per ordered domain pair, the minimum local-clock delta
+    ``t_recv - t_send`` over its edges."""
+    out: dict[tuple, float] = {}
+    for src, dst, t_send, t_recv in edges:
+        d = t_recv - t_send
+        k = (src, dst)
+        if k not in out or d < out[k]:
+            out[k] = d
+    return out
+
+
+def solve_offsets(domains, edges: list[tuple], anchors: dict,
+                  reference=None) -> dict:
+    """Solve one fleet-axis offset per domain.
+
+    ``domains``: iterable of domain keys.  ``edges``: `pair_edges`
+    output.  ``anchors``: domain -> {"wall": .., "perf": ..} — the
+    latest spooled clock anchor (absent entries allowed).  The fleet
+    axis is the REFERENCE domain's wall clock: its offset comes from
+    its own anchor, every edge-connected domain chains off it by the
+    midpoint estimate, and disconnected domains use their own anchor.
+
+    Returns domain -> {"offset": float, "method": str,
+    "delay_bound": float | None} where ``offset`` maps that domain's
+    perf_counter times onto the fleet axis and ``delay_bound`` is the
+    estimated minimum one-way delay to its BFS parent (the error bound
+    of the edge-solved offset).
+    """
+    domains = sorted(set(domains) | {d for e in edges for d in e[:2]})
+    if not domains:
+        return {}
+    mind = min_deltas(edges)
+    # undirected adjacency over pairs measured in BOTH directions
+    rel: dict[tuple, tuple] = {}
+    for (a, b), m_ab in mind.items():
+        if (b, a) not in mind or (a, b) in rel or (b, a) in rel:
+            continue
+        m_ba = mind[(b, a)]
+        rel[(a, b)] = ((m_ba - m_ab) / 2.0, (m_ab + m_ba) / 2.0)
+    adj: dict = {}
+    for (a, b), (off_b_minus_a, delay) in rel.items():
+        adj.setdefault(a, []).append((b, off_b_minus_a, delay))
+        adj.setdefault(b, []).append((a, -off_b_minus_a, delay))
+
+    def anchor_offset(dom):
+        a = anchors.get(dom)
+        if a and "wall" in a and "perf" in a:
+            return a["wall"] - a["perf"]
+        return None
+
+    if reference is None:
+        # the best-connected anchored domain keeps the BFS tree shallow
+        anchored = [d for d in domains if anchor_offset(d) is not None]
+        pool = anchored or domains
+        reference = max(pool, key=lambda d: (len(adj.get(d, ())), d))
+
+    out: dict = {}
+    ref_off = anchor_offset(reference)
+    out[reference] = {
+        "offset": ref_off if ref_off is not None else 0.0,
+        "method": METHOD_REFERENCE, "delay_bound": None}
+    frontier = [reference]
+    while frontier:
+        cur = frontier.pop(0)
+        for nxt, rel_off, delay in adj.get(cur, ()):
+            if nxt in out:
+                continue
+            out[nxt] = {"offset": out[cur]["offset"] + rel_off,
+                        "method": METHOD_EDGES, "delay_bound": delay}
+            frontier.append(nxt)
+    for dom in domains:
+        if dom in out:
+            continue
+        a_off = anchor_offset(dom)
+        if a_off is not None:
+            out[dom] = {"offset": a_off, "method": METHOD_ANCHOR,
+                        "delay_bound": None}
+        else:
+            # no edges AND no anchor: leave the domain on its local
+            # axis rather than inventing an alignment
+            out[dom] = {"offset": 0.0, "method": METHOD_NONE,
+                        "delay_bound": None}
+    return out
+
+
+def offset_spread_ms(offsets: dict, anchors: dict) -> float:
+    """Spread of the edge-solved corrections against the wall-clock
+    anchors, in ms — how far apart the processes' wall clocks were
+    from the wire's view.  0.0 with fewer than two comparable domains.
+    """
+    corrections = []
+    for dom, sol in offsets.items():
+        a = anchors.get(dom)
+        if sol["method"] not in (METHOD_EDGES, METHOD_REFERENCE) \
+                or not a or "wall" not in a or "perf" not in a:
+            continue
+        corrections.append(sol["offset"] - (a["wall"] - a["perf"]))
+    if len(corrections) < 2:
+        return 0.0
+    return (max(corrections) - min(corrections)) * 1000.0

@@ -1,0 +1,77 @@
+"""Harvest fleet telemetry: crash-safe spools + live RPC dumps.
+
+A CAPTURE is a plain JSON-serializable dict:
+
+    {"nodes": {name: {"spool": [records...], "live": {...} | None}},
+     "collected_at": wall-clock seconds}
+
+Spool records come from libs/telspool.read_spool over each node's
+``<home>/data/telspool`` directory — they survive SIGKILL, so a killed
+node still contributes every flush it completed.  The live half comes
+from the ``fleetobs`` RPC route (rpc/core.py), which snapshots the
+CURRENT incarnation's full rings plus a fresh clock anchor; a node
+that is down (or mid-restart) simply contributes spool-only, which is
+the whole point.
+
+The collector is duck-typed over the e2e runner's `Testnet` (nodes
+with ``name`` / ``home`` / ``rpc()`` / ``running()``) so simnet or ad
+hoc topologies can reuse it; `Testnet.collect_telemetry()` is the
+wired entry point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..libs import telspool
+
+SPOOL_SUBDIR = os.path.join("data", "telspool")
+
+
+def spool_dir_for(home: str) -> str:
+    return os.path.join(home, SPOOL_SUBDIR)
+
+
+def harvest_spool(home: str) -> list[dict]:
+    """Every recovered spool record under a node home; [] when the
+    node never spooled (knob off, or no flush completed)."""
+    return telspool.read_spool(spool_dir_for(home))
+
+
+def collect_node(name: str, home: str, rpc=None,
+                 rpc_timeout: float = 5.0) -> dict:
+    """One node's capture entry.  ``rpc`` is a callable
+    ``rpc(method, timeout=..) -> result`` (TestnetNode.rpc); live
+    collection failures degrade to spool-only, never raise."""
+    live = None
+    if rpc is not None:
+        try:
+            live = rpc("fleetobs", timeout=rpc_timeout)
+        except Exception:
+            live = None
+    return {"spool": harvest_spool(home), "live": live}
+
+
+def collect_testnet(testnet) -> dict:
+    """Capture across a Testnet: spools always, live dumps from the
+    nodes that answer RPC right now."""
+    nodes = {}
+    for node in testnet.nodes:
+        rpc = node.rpc if node.running() else None
+        nodes[node.name] = collect_node(node.name, node.home, rpc=rpc)
+    return {"nodes": nodes, "collected_at": time.time()}
+
+
+def save_capture(path: str, capture: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(capture, f)
+
+
+def load_capture(path: str) -> dict:
+    with open(path) as f:
+        capture = json.load(f)
+    if not isinstance(capture, dict) or "nodes" not in capture:
+        raise ValueError(f"{path} is not a fleetobs capture")
+    return capture

@@ -1,0 +1,242 @@
+"""Rebase per-process telemetry onto one fleet axis and merge it into
+the single-trace shapes the in-process layers already export.
+
+Input is a CAPTURE (fleetobs/collect.py): per logical node, the
+recovered spool records plus an optional live RPC dump.  Each record
+belongs to a clock domain — (node, incarnation) — and the merge:
+
+1. deduplicates ring events per domain by their ring seq (the spool
+   writes increments, the live dump overlaps the newest of them);
+2. solves one fleet-axis offset per domain (fleetobs/clocksync.py:
+   edge pairs where the p2p mesh provides them, spooled wall-clock
+   anchors where it does not);
+3. rebases every timeline/flightrec event and devprof/latledger
+   counter sample onto the fleet axis;
+4. folds all incarnations of one node into ONE replay timeline per
+   node — `tracetl.perfetto_trace` assigns pids by sorted node name,
+   so a node keeps its pid across restarts BY CONSTRUCTION — and
+   prefixes counter tracks per node ("node00:occupancy_pct/dev0").
+
+The result dict feeds fleetobs/report.py (critical path, histogram
+merge, occupancy, coverage) and scripts/fleet_report.py.
+"""
+
+from __future__ import annotations
+
+from ..libs import tracetl
+from . import clocksync
+
+
+def domain_str(key: tuple) -> str:
+    return "%s@%s" % key
+
+
+class ReplayTimeline:
+    """Duck-typed stand-in for tracetl.Timeline carrying already
+    rebased events — exactly the surface `perfetto_trace` reads."""
+
+    def __init__(self, node: str, events: list[dict],
+                 recorded: int | None = None, dropped: int = 0):
+        self.node = node
+        self._events = events
+        self.recorded = len(events) if recorded is None else recorded
+        self.dropped = dropped
+
+    def dump(self) -> dict:
+        return {"node": self.node, "recorded": self.recorded,
+                "dropped": self.dropped,
+                "capacity": max(len(self._events), 1),
+                "events": self._events}
+
+
+def _merge_ring_events(slot: dict, events: list[dict]) -> None:
+    """Dedup ring events by their per-incarnation ring seq; the spool
+    spools increments and the live dump overlaps the tail of them, so
+    last-write-wins on equal seq is a no-op."""
+    for e in events or ():
+        if isinstance(e, dict) and isinstance(e.get("seq"), int):
+            slot[e["seq"]] = e
+
+
+def domains_from_capture(capture: dict) -> dict:
+    """(node, incarnation) -> the domain's deduplicated telemetry:
+    ``tracetl`` / ``flightrec`` event lists, latest ``devprof`` /
+    ``latledger`` / ``metrics`` cumulative snapshots, ``anchors``
+    (spooled clock records, oldest first), and ``dropped`` tallies."""
+    domains: dict = {}
+
+    def slot(node: str, incarnation: str) -> dict:
+        return domains.setdefault((node, str(incarnation)), {
+            "tracetl": {}, "flightrec": {}, "anchors": [],
+            "devprof": None, "latledger": None, "metrics": None,
+            "tracetl_recorded": 0, "flightrec_recorded": 0,
+        })
+
+    for node, nd in sorted((capture.get("nodes") or {}).items()):
+        for rec in nd.get("spool") or ():
+            if not isinstance(rec, dict) or "incarnation" not in rec:
+                continue
+            d = slot(node, rec["incarnation"])
+            kind = rec.get("kind")
+            if kind == "clock":
+                d["anchors"].append({k: rec[k] for k in
+                                     ("wall", "perf", "mono")
+                                     if k in rec})
+            elif kind == "tracetl":
+                _merge_ring_events(d["tracetl"], rec.get("events"))
+                d["tracetl_recorded"] = max(d["tracetl_recorded"],
+                                            rec.get("recorded", 0))
+            elif kind == "flightrec":
+                _merge_ring_events(d["flightrec"], rec.get("events"))
+                d["flightrec_recorded"] = max(d["flightrec_recorded"],
+                                              rec.get("recorded", 0))
+            elif kind == "devprof":
+                d["devprof"] = {"snapshot": rec.get("snapshot"),
+                                "counters": rec.get("counters") or []}
+            elif kind == "latledger":
+                d["latledger"] = {"dump": rec.get("dump"),
+                                  "counters": rec.get("counters") or []}
+            elif kind == "metrics":
+                d["metrics"] = rec.get("exposition")
+        live = nd.get("live")
+        if isinstance(live, dict) and live.get("incarnation"):
+            d = slot(node, live["incarnation"])
+            clk = live.get("clock")
+            if isinstance(clk, dict):
+                d["anchors"].append({k: clk[k] for k in
+                                     ("wall", "perf", "mono")
+                                     if k in clk})
+            tl = live.get("tracetl")
+            if isinstance(tl, dict):
+                _merge_ring_events(d["tracetl"], tl.get("events"))
+                d["tracetl_recorded"] = max(d["tracetl_recorded"],
+                                            tl.get("recorded", 0))
+            fr = live.get("flightrec")
+            if isinstance(fr, dict):
+                _merge_ring_events(d["flightrec"], fr.get("events"))
+                d["flightrec_recorded"] = max(d["flightrec_recorded"],
+                                              fr.get("recorded", 0))
+            # the live dump is strictly newer than any spooled
+            # cumulative snapshot of the same incarnation
+            if isinstance(live.get("devprof"), dict):
+                d["devprof"] = live["devprof"]
+            if isinstance(live.get("latledger"), dict):
+                d["latledger"] = live["latledger"]
+            if live.get("metrics"):
+                d["metrics"] = live["metrics"]
+    return domains
+
+
+def _mono_to_perf(domain: dict) -> float:
+    """Shift mapping this domain's monotonic stamps (flightrec,
+    latledger counters) onto its perf_counter axis — zero without an
+    anchor (both clocks are CLOCK_MONOTONIC on the platforms this runs
+    on, so the residual is ns-scale)."""
+    for a in reversed(domain["anchors"]):
+        if "perf" in a and "mono" in a:
+            return a["perf"] - a["mono"]
+    return 0.0
+
+
+def _latest_anchor(domain: dict) -> dict | None:
+    for a in reversed(domain["anchors"]):
+        if "wall" in a and "perf" in a:
+            return a
+    return None
+
+
+def merge_capture(capture: dict, reference=None) -> dict:
+    """The full merge: offsets solved, events rebased, one replay
+    timeline per node, node-prefixed counter tracks, and the latest
+    cumulative snapshots carried through per node.
+
+    Returns ``{"trace", "offsets", "domains", "clock_offset_spread_ms",
+    "latledger", "devprof", "metrics"}`` — ``trace`` is the single
+    Perfetto trace; per-node dicts are keyed by node name with the
+    NEWEST incarnation's cumulative snapshot winning (pre-kill
+    incarnations contribute their ring events to the trace, while
+    counters/accounts restart with the process that owns them)."""
+    domains = domains_from_capture(capture)
+    events_by_domain = {k: sorted(d["tracetl"].values(),
+                                  key=lambda e: e["seq"])
+                        for k, d in domains.items()}
+    edges = clocksync.pair_edges(events_by_domain)
+    anchors = {k: a for k, d in domains.items()
+               if (a := _latest_anchor(d)) is not None}
+    offsets = clocksync.solve_offsets(domains.keys(), edges, anchors,
+                                      reference=reference)
+
+    per_node_events: dict[str, list] = {}
+    per_node_dropped: dict[str, int] = {}
+    counters: list[tuple] = []
+    latledger_by_node: dict = {}
+    devprof_by_node: dict = {}
+    metrics_by_node: dict = {}
+    # newest incarnation per node = the one with the latest wall anchor
+    newest: dict[str, tuple] = {}
+    for key, d in domains.items():
+        node = key[0]
+        a = _latest_anchor(d)
+        wall = a["wall"] if a else 0.0
+        if node not in newest or wall > newest[node][0]:
+            newest[node] = (wall, key)
+
+    for key, d in sorted(domains.items()):
+        node = key[0]
+        off = offsets[key]["offset"]
+        mono_shift = _mono_to_perf(d)
+        evs = per_node_events.setdefault(node, [])
+        for e in sorted(d["tracetl"].values(), key=lambda x: x["seq"]):
+            e2 = dict(e)
+            e2["t"] = e["t"] + off
+            evs.append(e2)
+        for e in sorted(d["flightrec"].values(),
+                        key=lambda x: x["seq"]):
+            # flightrec events join as instants, the ingest_flightrec
+            # convention, on the fleet axis
+            fields = {k: v for k, v in e.items()
+                      if k not in ("seq", "t", "kind")}
+            evs.append({"seq": e["seq"], "t": e["t"] + mono_shift + off,
+                        "ph": tracetl.PH_INSTANT, "sub": "flightrec",
+                        "name": e["kind"], **fields})
+        per_node_dropped[node] = per_node_dropped.get(node, 0) + max(
+            0, d["tracetl_recorded"] - len(d["tracetl"])) + max(
+            0, d["flightrec_recorded"] - len(d["flightrec"]))
+        if d["devprof"] is not None:
+            for s in d["devprof"].get("counters") or ():
+                if len(s) == 3:
+                    counters.append((s[0] + off,
+                                     "%s:%s" % (node, s[1]), s[2]))
+        if d["latledger"] is not None:
+            for s in d["latledger"].get("counters") or ():
+                if len(s) == 3:
+                    counters.append((s[0] + mono_shift + off,
+                                     "%s:%s" % (node, s[1]), s[2]))
+        if key == newest[node][1]:
+            if d["latledger"] is not None:
+                latledger_by_node[node] = d["latledger"].get("dump")
+            if d["devprof"] is not None:
+                devprof_by_node[node] = d["devprof"].get("snapshot")
+            if d["metrics"] is not None:
+                metrics_by_node[node] = d["metrics"]
+
+    replays = []
+    for node, evs in sorted(per_node_events.items()):
+        evs.sort(key=lambda e: e["t"])
+        # renumber: merged incarnations would repeat ring seqs
+        evs = [{**e, "seq": i} for i, e in enumerate(evs)]
+        replays.append(ReplayTimeline(
+            node, evs, recorded=len(evs) + per_node_dropped.get(node, 0),
+            dropped=per_node_dropped.get(node, 0)))
+    counters.sort(key=lambda s: s[0])
+    trace = tracetl.perfetto_trace(replays, counters=counters or None)
+    return {
+        "trace": trace,
+        "offsets": {domain_str(k): v for k, v in offsets.items()},
+        "domains": sorted(domain_str(k) for k in domains),
+        "clock_offset_spread_ms": round(
+            clocksync.offset_spread_ms(offsets, anchors), 3),
+        "latledger": latledger_by_node,
+        "devprof": devprof_by_node,
+        "metrics": metrics_by_node,
+    }

@@ -1,0 +1,174 @@
+"""Fleet-level readings over a merged capture: the cross-process
+equivalents of the single-process trace_report surfaces.
+
+- `critical_path` over the merged trace (tracetl.critical_path — the
+  exact segment-sum invariant holds on the rebased axis because the
+  sweep is a pure function of the trace, axis offsets included);
+- merged per-consumer latledger histograms (element-wise histogram
+  merge is associative/commutative by design, so per-node snapshots
+  fold into fleet-true quantile upper bounds);
+- fleet occupancy (busy/wall summed across every node's chips) and a
+  per-node SLO passthrough;
+- height coverage + cross-process flow-edge accounting — the honesty
+  metrics: how much of the chain the capture actually observed, and
+  whether causal edges really crossed process boundaries.
+"""
+
+from __future__ import annotations
+
+from ..libs import devprof as libdevprof
+from ..libs import tracetl
+from ..libs.latledger import LatHistogram
+from . import merge as libmerge
+
+
+def _hist_from_snapshot(snap: dict) -> LatHistogram | None:
+    try:
+        h = LatHistogram(tuple(snap["bounds"]))
+        counts = list(snap["counts"])
+        if len(counts) != len(h.counts):
+            return None
+        h.counts = counts
+        h.count = int(snap["count"])
+        h.sum = float(snap["sum"])
+        return h
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def merge_latledgers(latledger_by_node: dict) -> dict:
+    """Fold per-node ledger dumps into fleet per-consumer histograms
+    plus an SLO passthrough keyed by node."""
+    hists: dict[str, LatHistogram] = {}
+    requests: dict[str, int] = {}
+    nodes_seen: dict[str, int] = {}
+    slo = {}
+    for node, dump in sorted(latledger_by_node.items()):
+        if not isinstance(dump, dict):
+            continue
+        if dump.get("slo"):
+            slo[node] = dump["slo"]
+        for label, c in (dump.get("consumers") or {}).items():
+            snap = (c or {}).get("hist")
+            h = _hist_from_snapshot(snap) if snap else None
+            if h is None:
+                continue
+            if label not in hists:
+                hists[label] = h
+            else:
+                try:
+                    hists[label] = hists[label].merge(h)
+                except ValueError:
+                    # a mixed-build fleet may disagree on bucket
+                    # layouts; skip the odd one out, never raise
+                    continue
+            requests[label] = requests.get(label, 0) \
+                + int(c.get("requests", 0))
+            nodes_seen[label] = nodes_seen.get(label, 0) + 1
+    consumers = {}
+    for label, h in sorted(hists.items()):
+        consumers[label] = {
+            "count": h.count,
+            "sum_seconds": round(h.sum, 6),
+            "requests": requests.get(label, 0),
+            "nodes": nodes_seen.get(label, 0),
+            "p50_ms": round(h.quantile(0.50) * 1000.0, 3),
+            "p99_ms": round(h.quantile(0.99) * 1000.0, 3),
+        }
+    return {"consumers": consumers, "slo": slo}
+
+
+def fleet_occupancy(devprof_by_node: dict) -> dict:
+    """Per-node occupancy summaries plus the fleet aggregate (busy and
+    wall summed over every chip of every node)."""
+    per_node = {}
+    busy = wall = 0.0
+    for node, snap in sorted(devprof_by_node.items()):
+        if not isinstance(snap, dict):
+            continue
+        s = libdevprof.occupancy_summary(snap)
+        per_node[node] = s
+        busy += s.get("busy_seconds", 0.0)
+        wall += s.get("wall_seconds", 0.0)
+    return {"per_node": per_node,
+            "fleet": {"busy_seconds": round(busy, 6),
+                      "wall_seconds": round(wall, 6),
+                      "device_occupancy_fraction":
+                          round(busy / wall, 6) if wall else 0.0}}
+
+
+def _height_of_flow_id(fid: str) -> int | None:
+    parts = fid.rsplit("/", 3)
+    if len(parts) != 4:
+        return None
+    try:
+        return int(parts[1])
+    except ValueError:
+        return None
+
+
+def trace_coverage(trace: dict) -> dict:
+    """Commit coverage + cross-process flow-edge accounting straight
+    off the merged trace.  ``height_coverage`` is the share of
+    union-observed committed heights that EVERY node's telemetry
+    covers — 1.0 means no node lost a height's worth of rings to a
+    perturbation."""
+    pid_names = {}
+    commits: dict[int, set] = {}
+    flow_s: dict[str, set] = {}
+    flow_f: dict[str, set] = {}
+    for e in trace.get("traceEvents", []):
+        if not isinstance(e, dict):
+            continue
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = (e.get("args") or {}).get("name")
+        elif e.get("ph") == "i" and e.get("name") == "commit":
+            h = (e.get("args") or {}).get("height")
+            if isinstance(h, int):
+                commits.setdefault(h, set()).add(e.get("pid"))
+        elif e.get("ph") in ("s", "f") and isinstance(e.get("id"), str):
+            (flow_s if e["ph"] == "s" else flow_f).setdefault(
+                e["id"], set()).add(e.get("pid"))
+    node_pids = {pid for pid, name in pid_names.items()
+                 if name != "devprof"}
+    union = set(commits)
+    common = {h for h, pids in commits.items()
+              if node_pids and node_pids <= pids}
+    # a flow edge is CROSS-process when its send pid and recv pid differ
+    cross_by_height: dict[int, int] = {}
+    for fid in set(flow_s) & set(flow_f):
+        if flow_f[fid] - flow_s[fid]:
+            h = _height_of_flow_id(fid)
+            if h is not None:
+                cross_by_height[h] = cross_by_height.get(h, 0) + 1
+    common_with_edge = sum(1 for h in common
+                           if cross_by_height.get(h, 0) > 0)
+    return {
+        "nodes": sorted(n for n in pid_names.values()
+                        if n and n != "devprof"),
+        "union_heights": len(union),
+        "common_heights": len(common),
+        "height_coverage": round(len(common) / len(union), 6)
+        if union else 0.0,
+        "cross_flow_edges": sum(cross_by_height.values()),
+        "common_heights_with_cross_edge": common_with_edge,
+        "cross_edges_by_height": {
+            str(h): n for h, n in sorted(cross_by_height.items())},
+    }
+
+
+def fleet_report(capture: dict, reference=None) -> dict:
+    """The whole pipeline: merge, decompose, fold, count.  Returns the
+    merged artifacts under ``"merged"`` (trace included) plus the
+    fleet readings bench.py and scripts/fleet_report.py consume."""
+    merged = libmerge.merge_capture(capture, reference=reference)
+    cp = tracetl.critical_path(merged["trace"])
+    cov = trace_coverage(merged["trace"])
+    return {
+        "merged": merged,
+        "critical_path": cp,
+        "coverage": cov,
+        "latledger": merge_latledgers(merged["latledger"]),
+        "occupancy": fleet_occupancy(merged["devprof"]),
+        "clock_offset_spread_ms": merged["clock_offset_spread_ms"],
+    }

@@ -143,6 +143,10 @@ LOCK_RANKS: dict[str, int] = {
     "sigcache.stripe": 460,
     "part_set.block_cache": 470,
     "flowrate": 480,
+    # telemetry spool (libs/telspool.py): a flush HOLDS the spool lock
+    # across every observability ring's dump call below, so it ranks
+    # outside all of them
+    "telspool.spool": 485,
     # observability rings (leaf-most product locks: recordable from
     # under any of the above)
     "devprof.ring": 490,

@@ -1,0 +1,379 @@
+"""Crash-safe telemetry spool: the persistence seam under the fleet
+observability plane (cometbft_tpu/fleetobs/).
+
+Every observability layer before this one — flightrec (ring), tracetl
+(ring), devprof (accounts), latledger (histograms), Prometheus counters
+— lives and dies inside one interpreter.  The e2e runner's REAL node
+subprocesses get SIGKILLed mid-run by design (perturbations), and a
+killed ring is an erased ring.  The spool closes that gap with the WAL
+discipline consensus/wal.py already proved out: a background flusher
+periodically snapshots every installed telemetry source into
+length-framed, CRC-checked JSONL records appended to bounded, rotated
+segment files under the node's home dir.  A SIGKILL loses at most one
+flush interval of telemetry — never the file: replay tolerates a torn
+tail (the crash-mid-write suffix) by stopping at the first incomplete
+or corrupt frame of the NEWEST segment, exactly like WAL replay.
+
+Frame format (consensus/wal.py idiom):
+
+    crc32c(payload) u32 BE | len(payload) u32 BE | payload (JSON, utf-8)
+
+Record kinds (closed registry, scripts/check_metrics.py rule 10):
+
+    meta       once per segment: node, incarnation, pid, spool seq
+    clock      per flush: wall/perf_counter/monotonic triple — the
+               anchor that maps ring timestamps onto wall clock when a
+               node has no p2p edges to offset-solve against
+    flightrec  incremental flightrec events (cursor by seq)
+    tracetl    incremental timeline events (cursor by seq)
+    devprof    cumulative device-account snapshot (replay keeps latest)
+    latledger  cumulative ledger dump incl. mergeable histogram
+               snapshots (replay keeps latest)
+    metrics    Prometheus text exposition (replay keeps latest)
+
+Incremental vs cumulative: ring events are append-only facts, so the
+writer keeps a seq cursor per ring and spools only what is new each
+flush; account/histogram snapshots are already cumulative, so replay
+takes the last complete one and rotation never loses history that the
+latest snapshot still carries.  Rotation drops whole OLD segments
+(oldest-first) once the directory exceeds its budget — the newest
+segment, the only one a crash can tear, is never the one dropped.
+
+Clock domains: ring timestamps are perf_counter/monotonic, which reset
+per PROCESS.  Each writer mints an incarnation id (pid + start wall
+clock); every record carries it, and fleetobs/clocksync.py solves for
+one offset per (node, incarnation) domain, falling back to the spooled
+clock anchors when a domain has no p2p edges.
+
+Cost contract (flightrec discipline): with the spool off (default —
+``COMETBFT_TPU_TELSPOOL=0``) nothing is constructed and the node pays
+nothing.  With it on, the hot paths still pay nothing: flushing is a
+background daemon thread touching only the rings' public snapshot
+methods, at ``COMETBFT_TPU_TELSPOOL_INTERVAL_S`` cadence (default 2s).
+``COMETBFT_TPU_TELSPOOL_SEGMENT_BYTES`` (default 1 MiB) bounds one
+segment, ``COMETBFT_TPU_TELSPOOL_SEGMENTS`` (default 8) bounds the
+directory.  The spool lock ranks at 485 — OUTSIDE every observability
+ring (490-510) because a flush holds it across the rings' dump calls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+
+from . import lockrank
+from .crc32c import crc32c
+
+# the closed record-kind registry; scripts/check_metrics.py rule 10
+# lints every literal kind written through SpoolWriter against it
+RECORD_KINDS = (
+    "meta",
+    "clock",
+    "flightrec",
+    "tracetl",
+    "devprof",
+    "latledger",
+    "metrics",
+)
+
+DEFAULT_INTERVAL_S = float(os.environ.get(
+    "COMETBFT_TPU_TELSPOOL_INTERVAL_S", "2.0"))
+DEFAULT_SEGMENT_BYTES = int(os.environ.get(
+    "COMETBFT_TPU_TELSPOOL_SEGMENT_BYTES", str(1 << 20)))
+DEFAULT_SEGMENTS = int(os.environ.get(
+    "COMETBFT_TPU_TELSPOOL_SEGMENTS", "8"))
+
+SEGMENT_PREFIX = "spool-"
+SEGMENT_SUFFIX = ".tel"
+
+_FRAME_HEADER = struct.Struct(">II")     # crc32c(payload), len(payload)
+_MAX_RECORD_BYTES = 64 << 20             # sanity bound on one frame
+
+
+def enabled() -> bool:
+    """The master knob: spooling is opt-in (the e2e runner opts its
+    node subprocesses in via the environment)."""
+    return os.environ.get("COMETBFT_TPU_TELSPOOL", "0") not in ("0", "")
+
+
+def incarnation_id(pid: int | None = None,
+                   start_wall: float | None = None) -> str:
+    """One clock domain = one process incarnation: perf_counter and
+    monotonic reset across exec, so offsets are solved per-incarnation."""
+    pid = os.getpid() if pid is None else pid
+    start_wall = time.time() if start_wall is None else start_wall
+    return "%d-%d" % (pid, int(start_wall * 1000))
+
+
+# -- framing -----------------------------------------------------------------
+
+def encode_frame(payload: bytes) -> bytes:
+    return _FRAME_HEADER.pack(crc32c(payload), len(payload)) + payload
+
+
+def iter_frames(data: bytes):
+    """Yield complete, CRC-valid payloads from a segment's bytes,
+    stopping silently at the first torn or corrupt frame — the WAL
+    torn-tail contract.  Never raises on truncation."""
+    off = 0
+    n = len(data)
+    while off + _FRAME_HEADER.size <= n:
+        crc, length = _FRAME_HEADER.unpack_from(data, off)
+        if length > _MAX_RECORD_BYTES:
+            return
+        end = off + _FRAME_HEADER.size + length
+        if end > n:
+            return                      # torn tail: header without body
+        payload = data[off + _FRAME_HEADER.size:end]
+        if crc32c(payload) != crc:
+            return                      # corrupt (or torn inside header)
+        yield payload
+        off = end
+
+
+# -- reading -----------------------------------------------------------------
+
+def segment_paths(spool_dir: str) -> list[str]:
+    """Spool segments oldest-to-newest (lexicographic == numeric for
+    the zero-padded names)."""
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return []
+    return [os.path.join(spool_dir, n) for n in sorted(names)
+            if n.startswith(SEGMENT_PREFIX) and n.endswith(SEGMENT_SUFFIX)]
+
+
+def read_segment(path: str) -> list[dict]:
+    """Every complete record of one segment; [] when unreadable.
+    Records that frame intact but fail to parse as JSON objects are
+    skipped (same contract as torn frames: recover what is whole)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return []
+    out = []
+    for payload in iter_frames(data):
+        try:
+            rec = json.loads(payload)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def read_spool(spool_dir: str) -> list[dict]:
+    """All recovered records across a node's spool directory, segment
+    order (oldest first).  Torn tails and missing dirs are normal
+    operation, not errors."""
+    out = []
+    for path in segment_paths(spool_dir):
+        out.extend(read_segment(path))
+    return out
+
+
+# -- writing -----------------------------------------------------------------
+
+class SpoolWriter:
+    """Periodic snapshotter of a node's telemetry sources into rotated,
+    CRC-framed spool segments.
+
+    Sources are optional attributes (assign after construction, the
+    same per-object override pattern as consensus_state.recorder):
+    ``flight_recorder``, ``timeline``, ``devprof``, ``latledger``,
+    ``metrics_registry``.  Absent sources are simply skipped, so the
+    writer needs no knowledge of which layers a node enabled.
+    """
+
+    def __init__(self, spool_dir: str, node: str = "node",
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 max_segments: int = DEFAULT_SEGMENTS,
+                 interval_s: float = DEFAULT_INTERVAL_S):
+        if segment_bytes <= 0 or max_segments <= 0:
+            raise ValueError("segment_bytes and max_segments must be "
+                             "positive")
+        self.spool_dir = spool_dir
+        self.node = node
+        self.segment_bytes = segment_bytes
+        self.max_segments = max_segments
+        self.interval_s = interval_s
+        self.incarnation = incarnation_id()
+        # telemetry sources (assigned by the node after construction)
+        self.flight_recorder = None
+        self.timeline = None
+        self.devprof = None
+        self.latledger = None
+        self.metrics_registry = None
+
+        self._mtx = lockrank.RankedLock("telspool.spool")
+        self._fh = None
+        self._seg_written = 0
+        self._flightrec_cursor = 0
+        self._tracetl_cursor = 0
+        self._flushes = 0
+        self._records_written = 0
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(spool_dir, exist_ok=True)
+        # continue numbering past any previous incarnation's segments —
+        # a restart must never overwrite the pre-crash evidence
+        existing = segment_paths(spool_dir)
+        self._seg_seq = 0
+        if existing:
+            last = os.path.basename(existing[-1])
+            try:
+                self._seg_seq = int(
+                    last[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)])
+            except ValueError:
+                self._seg_seq = len(existing)
+
+    # -- segment lifecycle (under self._mtx) --------------------------------
+
+    def _open_segment(self) -> None:
+        self._seg_seq += 1
+        path = os.path.join(
+            self.spool_dir,
+            "%s%06d%s" % (SEGMENT_PREFIX, self._seg_seq, SEGMENT_SUFFIX))
+        self._fh = open(path, "ab")
+        self._seg_written = 0
+        self._write_record("meta", node=self.node, pid=os.getpid(),
+                           segment=self._seg_seq)
+        self._prune()
+
+    def _prune(self) -> None:
+        paths = segment_paths(self.spool_dir)
+        # never prune the newest (open) segment; drop oldest-first
+        while len(paths) > self.max_segments:
+            victim = paths.pop(0)
+            try:
+                os.unlink(victim)
+            except OSError:
+                break
+
+    def _write_record(self, kind: str, **fields) -> None:
+        if kind not in RECORD_KINDS:
+            raise ValueError(f"unknown spool record kind {kind!r}")
+        rec = {"kind": kind, "node": self.node,
+               "incarnation": self.incarnation, "t_wall": time.time()}
+        rec.update(fields)
+        frame = encode_frame(
+            json.dumps(rec, separators=(",", ":")).encode())
+        self._fh.write(frame)
+        self._seg_written += len(frame)
+        self._records_written += 1
+
+    # -- flushing -----------------------------------------------------------
+
+    def flush(self) -> int:
+        """Snapshot every installed source into the spool; returns the
+        number of records written.  Durable on return (flush + fsync),
+        so a SIGKILL after a flush loses nothing from it."""
+        with self._mtx:
+            if self._closed:
+                return 0
+            if self._fh is None:
+                self._open_segment()
+            wrote0 = self._records_written
+            # the clock anchor first: every flush re-pins the ring
+            # clocks to wall time, bounding anchor-fallback error to
+            # one flush interval of drift
+            self._write_record("clock", wall=time.time(),
+                               perf=time.perf_counter(),
+                               mono=time.monotonic())
+            fr = self.flight_recorder
+            if fr is not None:
+                evs = [e for e in fr.events()
+                       if e["seq"] >= self._flightrec_cursor]
+                if evs:
+                    self._flightrec_cursor = evs[-1]["seq"] + 1
+                    self._write_record(
+                        "flightrec", recorded=fr.recorded, events=evs)
+            tl = self.timeline
+            if tl is not None:
+                evs = [e for e in tl.events()
+                       if e["seq"] >= self._tracetl_cursor]
+                if evs:
+                    self._tracetl_cursor = evs[-1]["seq"] + 1
+                    self._write_record(
+                        "tracetl", timeline_node=tl.node,
+                        recorded=tl.recorded, events=evs)
+            dp = self.devprof
+            if dp is not None:
+                self._write_record(
+                    "devprof", snapshot=dp.snapshot(),
+                    counters=[list(s) for s in dp.counter_samples()])
+            ll = self.latledger
+            if ll is not None:
+                self._write_record(
+                    "latledger", dump=ll.dump(),
+                    counters=[list(s) for s in ll.counter_samples()])
+            reg = self.metrics_registry
+            if reg is not None:
+                self._write_record("metrics", exposition=reg.expose())
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._flushes += 1
+            wrote = self._records_written - wrote0
+            if self._seg_written >= self.segment_bytes:
+                self._fh.close()
+                self._fh = None         # next flush opens a fresh one
+            return wrote
+
+    def stats(self) -> dict:
+        with self._mtx:
+            return {"spool_dir": self.spool_dir,
+                    "incarnation": self.incarnation,
+                    "flushes": self._flushes,
+                    "records_written": self._records_written,
+                    "segment_seq": self._seg_seq,
+                    "interval_s": self.interval_s}
+
+    # -- background flusher -------------------------------------------------
+
+    def start(self) -> None:
+        """Launch the background flusher (daemon — it must never hold
+        interpreter shutdown hostage; `stop` does the final durable
+        flush on the graceful path)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"telspool-{self.node}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.flush()
+            except OSError:
+                # a full/areadonly disk must not kill the flusher;
+                # the next interval retries
+                continue
+
+    def stop(self) -> None:
+        """Final flush + thread join — the graceful-exit half of the
+        durability contract (atexit / SIGTERM via Node.on_stop).
+        Idempotent: the atexit hook and Node.on_stop may both fire."""
+        with self._mtx:
+            if self._closed:
+                return
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.flush()
+        except OSError:
+            pass
+        with self._mtx:
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -477,10 +477,18 @@ def critical_path(trace: dict) -> dict:
         if lo is None or hi <= lo:
             continue
         segs = _sweep(spans, lo, hi)
+        wall = round(hi - lo, 6)
+        rounded = {k: round(v, 6) for k, v in segs.items()}
+        # the residual segment absorbs per-segment rounding error so
+        # the partition sums EXACTLY to wall_seconds (the invariant
+        # fleet reports assert); may dip a microsecond below zero
+        rounded["gossip"] = round(
+            wall - sum(v for k, v in rounded.items() if k != "gossip"),
+            6)
         per_height.append({
             "height": h,
-            "wall_seconds": round(hi - lo, 6),
-            "segments": {k: round(v, 6) for k, v in segs.items()},
+            "wall_seconds": wall,
+            "segments": rounded,
         })
 
     by_seg = {seg: sorted(r["segments"][seg] for r in per_height)

@@ -359,6 +359,26 @@ class Node(BaseService):
         self.latledger_recorder = liblatledger.LatLedgerRecorder()
         self.consensus_state.latledger = self.latledger_recorder
 
+        # crash-safe telemetry spool (libs/telspool.py): opt-in via
+        # COMETBFT_TPU_TELSPOOL=1 (the e2e runner opts its subprocesses
+        # in).  The writer periodically persists every recorder above
+        # into CRC-framed segments under <home>/data/telspool so a
+        # SIGKILL perturbation loses at most one flush interval; the
+        # fleetobs collector harvests them plus the fleetobs RPC route
+        from ..libs import telspool as libtelspool
+        self.telspool_writer = None
+        if libtelspool.enabled():
+            import atexit
+            self.telspool_writer = libtelspool.SpoolWriter(
+                os.path.join(config.base.root_dir, "data", "telspool"),
+                node=self.node_key.id[:8])
+            self.telspool_writer.flight_recorder = self.flight_recorder
+            self.telspool_writer.timeline = self.timeline
+            self.telspool_writer.devprof = self.devprof_recorder
+            self.telspool_writer.latledger = self.latledger_recorder
+            self.consensus_state.telspool = self.telspool_writer
+            atexit.register(self.telspool_writer.stop)
+
         # device health circuit breaker (crypto/devhealth.py): always-on
         # and process-wide — every VerifyPipeline constructed after this
         # point (and mesh.maybe_split_verify) adopts it, so quarantines
@@ -437,6 +457,9 @@ class Node(BaseService):
             # ... and the crypto layers' request stamps through the
             # latency ledger's seam
             liblatledger.set_recorder(self.latledger_recorder)
+            if self.telspool_writer is not None:
+                # the spool's `metrics` records carry the exposition
+                self.telspool_writer.metrics_registry = registry
             self.metrics_server = MetricsServer(
                 registry, config.instrumentation.prometheus_listen_addr)
 
@@ -448,6 +471,8 @@ class Node(BaseService):
         self.pruner.start()
         if self.metrics_server is not None:
             self.metrics_server.start()
+        if self.telspool_writer is not None:
+            self.telspool_writer.start()
         self.switch.start()
         self._start_rpc()
         peers = [a.strip()
@@ -563,6 +588,9 @@ class Node(BaseService):
             self.signer_endpoint.close()
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if self.telspool_writer is not None:
+            # graceful-exit durability: the final flush happens here
+            self.telspool_writer.stop()
         self.event_bus.stop()
 
     def _start_rpc(self) -> None:
@@ -584,7 +612,8 @@ class Node(BaseService):
             config=self.config,
             tx_indexer=self.tx_indexer,
             block_indexer=self.block_indexer,
-            pruner=self.pruner)
+            pruner=self.pruner,
+            metrics_registry=getattr(self, "metrics_registry", None))
         if self.config.rpc.laddr:
             addr = self.config.rpc.laddr.replace("tcp://", "")
             self.rpc_server = RPCServer(env, addr)

@@ -50,8 +50,26 @@ def _pack_msg(channel_id: int, eof: bool, data: bytes) -> bytes:
     return pw.Writer().message_field(3, inner).bytes()
 
 
+def _pack_ctx(channel_id: int, ctx) -> bytes:
+    """Oneof field 4 (this repo's extension): a trace context
+    (libs/tracetl.py (origin, height, round, seq)) for the NEXT
+    msg-EOF on `channel_id`.  Real-TCP conns cannot ship the per-frame
+    context list the simnet transport carries out-of-band, so the
+    context rides the wire as its own tiny packet immediately ahead of
+    the message-EOF packet it describes — which is what makes
+    cross-PROCESS flow edges and NTP-style clock-offset solving
+    (fleetobs/clocksync.py) possible on real testnets."""
+    origin, height, round_, seq = ctx
+    inner = (pw.Writer().uvarint_field(1, channel_id)
+             .bytes_field(2, str(origin).encode())
+             .uvarint_field(3, int(height)).uvarint_field(4, int(round_))
+             .uvarint_field(5, int(seq)).bytes())
+    return pw.Writer().message_field(4, inner).bytes()
+
+
 def _unpack_packet(payload: bytes):
-    """-> ('ping'|'pong'|'msg', channel_id, eof, data)."""
+    """-> ('ping'|'pong'|'msg', channel_id, eof, data)
+    or ('ctx', channel_id, False, (origin, height, round, seq))."""
     r = pw.Reader(payload)
     while not r.at_end():
         f, w = r.read_tag()
@@ -77,6 +95,24 @@ def _unpack_packet(payload: bytes):
                 else:
                     rr.skip(ww)
             return ("msg", ch, eof, data)
+        if f == 4:
+            rr = pw.Reader(body)
+            ch, origin, height, round_, seq = 0, "", 0, 0, 0
+            while not rr.at_end():
+                ff, ww = rr.read_tag()
+                if ff == 1 and ww == pw.VARINT:
+                    ch = rr.read_uvarint()
+                elif ff == 2 and ww == pw.BYTES:
+                    origin = rr.read_bytes().decode("utf-8", "replace")
+                elif ff == 3 and ww == pw.VARINT:
+                    height = rr.read_uvarint()
+                elif ff == 4 and ww == pw.VARINT:
+                    round_ = rr.read_uvarint()
+                elif ff == 5 and ww == pw.VARINT:
+                    seq = rr.read_uvarint()
+                else:
+                    rr.skip(ww)
+            return ("ctx", ch, False, (origin, height, round_, seq))
         r.skip(w)
     raise MConnectionError("empty packet")
 
@@ -186,6 +222,9 @@ class MConnection(BaseService):
         self._flush_throttle = flush_throttle
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
+        # per-channel pending recv context from in-band ctx packets
+        # (real-TCP carry); only the recv routine's thread touches it
+        self._recv_pending_ctx: dict = {}
         self._send_signal = threading.Event()
         self._pong_pending = threading.Event()
         self._pong_deadline: float | None = None
@@ -290,6 +329,14 @@ class MConnection(BaseService):
                     if ch is None:
                         break
                     pkt, eof, ctx = ch.next_packet()
+                    if eof and ctx is not None \
+                            and self._write_with_ctx is None:
+                        # real TCP: the context travels in-band as its
+                        # own packet just ahead of the EOF it describes
+                        cpkt = _pack_ctx(ch.desc.id, ctx)
+                        batch.append(cpkt)
+                        batch_bytes += len(cpkt)
+                        self._send_monitor.update(len(cpkt))
                     batch.append(pkt)
                     if eof:
                         batch_ctxs.append(ctx)
@@ -364,6 +411,11 @@ class MConnection(BaseService):
         if kind == "pong":
             self._pong_deadline = None
             return
+        if kind == "ctx":
+            # in-band trace context: applies to this channel's next
+            # message EOF (the sender emits it immediately ahead)
+            self._recv_pending_ctx[ch_id] = data
+            return
         ch = self._channels.get(ch_id)
         if ch is None:
             raise MConnectionError(f"unknown channel {ch_id}")
@@ -373,7 +425,10 @@ class MConnection(BaseService):
         msg = ch.recv_packet(eof, data)
         if msg is not None:
             pop = self._pop_recv_ctx
-            tctx = pop() if pop is not None else None
+            if pop is not None:
+                tctx = pop()
+            else:
+                tctx = self._recv_pending_ctx.pop(ch_id, None)
             if self._recv_takes_ctx:
                 self._on_receive(ch_id, msg, tctx)
             else:

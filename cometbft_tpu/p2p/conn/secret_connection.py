@@ -30,12 +30,12 @@ try:
     from cryptography.hazmat.primitives.kdf.hkdf import HKDF
     HAVE_CRYPTOGRAPHY = True
 except ImportError:  # pragma: no cover - env-dependent
-    # the module must stay importable without the cryptography wheel
-    # (simnet and every p2p consumer reach Switch/MConnection through
-    # this package); only the actual TCP handshake needs the AEAD +
-    # X25519 primitives, and make() gates on the flag
+    # without the cryptography wheel make() runs on the pure-Python
+    # RFC 7748/8439 implementations in crypto/aead.py — same wire
+    # bytes, just slower (fine for loopback testnets)
     HAVE_CRYPTOGRAPHY = False
 
+from ...crypto import aead as _py_aead
 from ...crypto import ed25519
 
 DATA_LEN_SIZE = 4
@@ -125,13 +125,13 @@ class SecretConnection:
     def make(sock, priv_key) -> "SecretConnection":
         """Mutual-auth handshake (secret_connection.go
         MakeSecretConnection). priv_key: our long-term Ed25519 key."""
-        if not HAVE_CRYPTOGRAPHY:
-            raise SecretConnectionError(
-                "SecretConnection handshake requires the cryptography "
-                "package (X25519 + ChaCha20-Poly1305); in-process "
-                "peers can use simnet's transport instead")
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        if HAVE_CRYPTOGRAPHY:
+            eph_priv = X25519PrivateKey.generate()
+            eph_pub = eph_priv.public_key().public_bytes_raw()
+        else:
+            import os
+            eph_priv = os.urandom(32)
+            eph_pub = _py_aead.x25519_base(eph_priv)
 
         # 1. exchange ephemerals (plaintext)
         sock.sendall(eph_pub)
@@ -141,16 +141,21 @@ class SecretConnection:
         we_are_lo = eph_pub < remote_eph
         lo, hi = sorted((eph_pub, remote_eph))
 
-        shared = eph_priv.exchange(
-            X25519PublicKey.from_public_bytes(remote_eph))
+        if HAVE_CRYPTOGRAPHY:
+            shared = eph_priv.exchange(
+                X25519PublicKey.from_public_bytes(remote_eph))
+        else:
+            shared = _py_aead.x25519(eph_priv, remote_eph)
 
         # 2. derive: 2 x 32-byte keys + 32-byte challenge, transcript-
         # bound to both ephemerals via the HKDF salt
         recv_key, send_key, challenge = derive_secrets(
             shared, lo + hi, we_are_lo)
 
-        conn = SecretConnection(sock, ChaCha20Poly1305(recv_key),
-                                ChaCha20Poly1305(send_key), None)
+        aead_cls = (ChaCha20Poly1305 if HAVE_CRYPTOGRAPHY
+                    else _py_aead.ChaCha20Poly1305)
+        conn = SecretConnection(sock, aead_cls(recv_key),
+                                aead_cls(send_key), None)
 
         # 3. exchange long-term identity + signature over the challenge
         # (over the now-encrypted channel)

@@ -41,6 +41,9 @@ class Environment:
     tx_indexer: object = None
     block_indexer: object = None
     pruner: object = None
+    # Prometheus registry (libs/metrics.py Registry) when the node has
+    # instrumentation on — the fleetobs snapshot spools its exposition
+    metrics_registry: object = None
     # the light-client serving plane (cometbft_tpu/lightserve/):
     # created lazily on first light_sync/light_status call so every
     # Environment assembly (node, simnet, cmd inspect) serves the
@@ -386,6 +389,51 @@ class Environment:
             if n >= 0:
                 out["rows"] = out["rows"][-n:] if n else []
         return out
+
+    def fleetobs_handler(self) -> dict:
+        """Combined live telemetry snapshot for the fleet collector
+        (cometbft_tpu/fleetobs/collect.py): every observability layer
+        this node carries, in one read, plus the clock anchor and
+        incarnation id the cross-process merge rebases by.  Layers the
+        node did not enable come back null — the collector treats a
+        partial snapshot exactly like a partial spool."""
+        import os as _os
+        import time as _time
+
+        from ..libs import devprof as _dp
+        from ..libs import flightrec as _fr
+        from ..libs import latledger as _ll
+        from ..libs import tracetl as _tl
+        cs = self.consensus_state
+        rec = getattr(cs, "recorder", None) or _fr.recorder()
+        tl = getattr(cs, "timeline", None) or _tl.timeline()
+        dp = getattr(cs, "devprof", None) or _dp.recorder()
+        ll = getattr(cs, "latledger", None) or _ll.recorder()
+        sw = getattr(cs, "telspool", None)
+        reg = getattr(self, "metrics_registry", None)
+        if rec is None and tl is None and dp is None and ll is None:
+            raise RPCError(-32603, "no telemetry layers installed")
+        incarnation = sw.incarnation if sw is not None \
+            else "%d-live" % _os.getpid()
+        return {
+            "node": tl.node if tl is not None else "",
+            "incarnation": incarnation,
+            "clock": {"wall": _time.time(),
+                      "perf": _time.perf_counter(),
+                      "mono": _time.monotonic()},
+            "flightrec": rec.dump() if rec is not None else None,
+            "tracetl": tl.dump() if tl is not None else None,
+            "devprof": {"snapshot": dp.snapshot(),
+                        "counters": [list(s)
+                                     for s in dp.counter_samples()]}
+            if dp is not None else None,
+            "latledger": {"dump": ll.dump(),
+                          "counters": [list(s)
+                                       for s in ll.counter_samples()]}
+            if ll is not None else None,
+            "metrics": reg.expose() if reg is not None else None,
+            "telspool": sw.stats() if sw is not None else None,
+        }
 
     # -- abci --------------------------------------------------------------
     def abci_info(self) -> dict:
@@ -746,6 +794,7 @@ ROUTES = {
     "tracetl": "tracetl_handler",
     "devprof": "devprof_handler",
     "latency": "latency_handler",
+    "fleetobs": "fleetobs_handler",
     "abci_info": "abci_info",
     "abci_query": "abci_query",
     "broadcast_tx_async": "broadcast_tx_async",

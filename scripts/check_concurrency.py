@@ -164,6 +164,11 @@ KNOBS = {
     "COMETBFT_TPU_LATLEDGER",
     "COMETBFT_TPU_LATLEDGER_CAPACITY",
     "COMETBFT_TPU_LATLEDGER_SLO_BURN",
+    # libs/telspool.py — crash-safe telemetry spool (fleetobs plane)
+    "COMETBFT_TPU_TELSPOOL",
+    "COMETBFT_TPU_TELSPOOL_INTERVAL_S",
+    "COMETBFT_TPU_TELSPOOL_SEGMENT_BYTES",
+    "COMETBFT_TPU_TELSPOOL_SEGMENTS",
     # simnet
     "SIMNET_CONSENSUS_VALS",
     "SIMNET_CONSENSUS_BLOCKS",

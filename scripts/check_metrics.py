@@ -54,6 +54,13 @@ Checks:
      across cometbft_tpu/ (pipeline submit / verify_async re-laning)
      must name a registered lane — a misspelled lane would demote the
      caller to the default class with no error.
+ 10. the telemetry-spool record vocabulary (telspool.RECORD_KINDS) is
+     a CLOSED registry: every literal kind handed to
+     `*._write_record("<kind>", ...)` across cometbft_tpu/ must be
+     registered — the fleet collector routes spool records by kind, so
+     an unregistered kind would be silently dropped by every replay
+     (the writer raises on unknown kinds at runtime; this lint catches
+     the drift at review time, before a node ships it).
 
 Run directly (exits 1 on findings) or through tests/test_tools.py as a
 tier-1 test.
@@ -72,6 +79,7 @@ DEVPROF_PY = REPO / "cometbft_tpu" / "libs" / "devprof.py"
 DEVHEALTH_PY = REPO / "cometbft_tpu" / "crypto" / "devhealth.py"
 SIGCACHE_PY = REPO / "cometbft_tpu" / "crypto" / "sigcache.py"
 LATLEDGER_PY = REPO / "cometbft_tpu" / "libs" / "latledger.py"
+TELSPOOL_PY = REPO / "cometbft_tpu" / "libs" / "telspool.py"
 SNAKE = re.compile(r"[a-z][a-z0-9_]*\Z")
 REG_METHODS = ("counter", "gauge", "histogram")
 # the reference's own p2p metrics label a camelCase chID; renaming it
@@ -535,6 +543,73 @@ def run_lane_checks(root: Path | None = None,
     return findings
 
 
+def registered_record_kinds(path: Path | None = None) -> set:
+    """telspool.RECORD_KINDS — the closed spool-record vocabulary the
+    fleet collector routes by.  AST only, same no-import discipline as
+    every parser here."""
+    tree = ast.parse((path or TELSPOOL_PY).read_text())
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign):
+            target, value = node.targets[0], node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "RECORD_KINDS"):
+            continue
+        if isinstance(value, ast.Call) and value.args:
+            value = value.args[0]            # frozenset((...))
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            return {e.value for e in value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return set()
+
+
+def record_kind_call_sites(root: Path | None = None) -> list[dict]:
+    """[{file, lineno, value}] for every literal spool-record kind —
+    the first positional of `*._write_record("<kind>", ...)` — under
+    ``root`` (default cometbft_tpu/).  Variables forward kinds the
+    writer validates at runtime."""
+    root = root or (REPO / "cometbft_tpu")
+    sites = []
+    for py in sorted(root.rglob("*.py")):
+        tree = ast.parse(py.read_text())
+        rel = str(py.relative_to(root.parent if root.is_dir() else root))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_write_record"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            sites.append({"file": rel, "lineno": node.lineno,
+                          "value": node.args[0].value})
+    return sites
+
+
+def run_record_kind_checks(root: Path | None = None,
+                           telspool_path: Path | None = None
+                           ) -> list[str]:
+    """Rule 10 findings: every literal spool-record kind is registered
+    in telspool.RECORD_KINDS."""
+    kinds = registered_record_kinds(telspool_path)
+    if not kinds:
+        return ["telspool.RECORD_KINDS not found or empty "
+                "(rule 10 parser broken?)"]
+    findings = []
+    for s in record_kind_call_sites(root):
+        if s["value"] not in kinds:
+            findings.append(
+                f"{s['file']}:{s['lineno']}: spool record kind "
+                f"{s['value']!r} is not registered in "
+                "telspool.RECORD_KINDS — the fleet collector routes "
+                "records by kind, so replay would silently drop it")
+    return findings
+
+
 def run_checks() -> list[str]:
     """All findings as human-readable strings; empty means clean."""
     metrics = registered_metrics()
@@ -584,6 +659,7 @@ def run_checks() -> list[str]:
     findings.extend(run_label_checks())
     findings.extend(run_registry_checks())
     findings.extend(run_lane_checks())
+    findings.extend(run_record_kind_checks())
     return findings
 
 

@@ -1,0 +1,90 @@
+"""Render a fleet telemetry capture offline: the cross-process
+counterpart of scripts/trace_report.py.
+
+Input is the JSON capture Testnet.collect_telemetry() produces
+(fleetobs/collect.py shape: per node, recovered spool records plus an
+optional live RPC dump).  The pipeline is fleetobs/report.fleet_report:
+clock-offset solving, fleet-axis rebase, single merged Perfetto trace,
+fleet critical path, merged latledger histograms, occupancy, and the
+coverage/flow-edge honesty readings.
+
+Usage:
+    python scripts/fleet_report.py capture.json
+        fleet summary JSON on stdout
+    python scripts/fleet_report.py capture.json --trace-out fleet.trace.json
+        additionally writes the merged Perfetto trace (open in
+        https://ui.perfetto.dev)
+    python scripts/fleet_report.py capture.json --jsonl heights.jsonl
+        one JSON line per committed height (critical-path segments)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cometbft_tpu.fleetobs import collect, report  # noqa: E402
+from cometbft_tpu.libs import tracetl  # noqa: E402
+
+
+def summarize(fleet: dict) -> dict:
+    """The offline summary: everything except the (large) trace."""
+    cov = fleet["coverage"]
+    cp = fleet["critical_path"]["summary"]
+    return {
+        "nodes": cov["nodes"],
+        "domains": fleet["merged"]["domains"],
+        "offsets": fleet["merged"]["offsets"],
+        "clock_offset_spread_ms": fleet["clock_offset_spread_ms"],
+        "height_coverage": cov["height_coverage"],
+        "union_heights": cov["union_heights"],
+        "common_heights": cov["common_heights"],
+        "cross_flow_edges": cov["cross_flow_edges"],
+        "common_heights_with_cross_edge":
+            cov["common_heights_with_cross_edge"],
+        "critical_path": cp,
+        "latledger": fleet["latledger"],
+        "occupancy": fleet["occupancy"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fleet telemetry capture: merged-trace readings "
+                    "across real node processes")
+    ap.add_argument("capture", help="fleetobs capture JSON "
+                    "(Testnet.collect_telemetry output)")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="write the merged Perfetto trace here")
+    ap.add_argument("--jsonl", metavar="PATH",
+                    help="write one JSON line per committed height")
+    ap.add_argument("--summary-out", metavar="PATH",
+                    help="write the fleet summary JSON here "
+                         "(default: stdout)")
+    args = ap.parse_args(argv)
+
+    capture = collect.load_capture(args.capture)
+    fleet = report.fleet_report(capture)
+
+    if args.trace_out:
+        tracetl.write_trace(args.trace_out, fleet["merged"]["trace"])
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for rec in fleet["critical_path"]["per_height"]:
+                f.write(json.dumps(rec) + "\n")
+    out = json.dumps(summarize(fleet), indent=2, sort_keys=True)
+    if args.summary_out:
+        with open(args.summary_out, "w") as f:
+            f.write(out + "\n")
+    else:
+        print(out)
+    return 0 if fleet["coverage"]["union_heights"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

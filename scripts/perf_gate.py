@@ -45,7 +45,12 @@ LOWER_IS_BETTER = {"chaos_recovery_seconds",
                    # consensus vote tail short while bulk tenants share
                    # the pipeline — either p99 rising is queueing the
                    # decomposition must explain, not an improvement
-                   "vote_verify_p99_ms", "bulk_verify_p99_ms"}
+                   "vote_verify_p99_ms", "bulk_verify_p99_ms",
+                   # fleet clock-offset spread: the cross-process merge
+                   # solves per-process offsets from p2p send/recv
+                   # pairs — the spread widening means the edge solver
+                   # degraded toward wall-clock anchors
+                   "e2e_fleet_clock_offset_spread_ms"}
 # non-metric extras (configs, notes, lists) are skipped by the numeric
 # filter; these numerics are ratios/counters, not rates to gate on.
 # critical_path_device_share moved here when the signature-verdict
@@ -76,7 +81,15 @@ SKIP = {"rlc_batch", "headline_passes", "vs_baseline",
         # higher-is-better direction (priority lanes must not tax the
         # bulk tenant's throughput).  bulk_verify_sigs_per_s is the
         # raw numerator, machine-speed-dependent, so a reading.
-        "vote_verify_p99_ms_sched_off", "bulk_verify_sigs_per_s"}
+        "vote_verify_p99_ms_sched_off", "bulk_verify_sigs_per_s",
+        # the fleet-wide critical-path device share is a reading for
+        # the same reason critical_path_device_share is: optimisations
+        # that cut device dispatches LOWER it by design, so neither
+        # direction is a regression.  e2e_fleet_height_coverage DOES
+        # gate (default higher-is-better: heights losing their
+        # cross-process flow edges means the in-band trace context or
+        # the clock-aligned merge broke).
+        "e2e_fleet_critical_path_device_share"}
 
 
 def load_record(path: str) -> dict | None:

@@ -1,0 +1,169 @@
+"""RFC test vectors for the pure-Python X25519 + ChaCha20-Poly1305
+fallback (cometbft_tpu/crypto/aead.py) plus a SecretConnection
+handshake smoke over a socketpair proving make() works without the
+cryptography wheel."""
+
+import socket
+import threading
+
+import pytest
+
+from cometbft_tpu.crypto import aead
+
+
+# -- RFC 7748 section 5.2 / 6.1 vectors --------------------------------------
+
+def test_x25519_rfc7748_vector_1():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c")
+    out = bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552")
+    assert aead.x25519(k, u) == out
+
+
+def test_x25519_rfc7748_vector_2():
+    k = bytes.fromhex(
+        "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d")
+    u = bytes.fromhex(
+        "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493")
+    out = bytes.fromhex(
+        "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957")
+    assert aead.x25519(k, u) == out
+
+
+def test_x25519_rfc7748_iterated():
+    # RFC 7748 section 5.2: 1 and 1000 ladder iterations
+    k = u = (9).to_bytes(32, "little")
+    k = aead.x25519(k, u)
+    assert k == bytes.fromhex(
+        "422c8e7a6227d7bca1350b3e2bb7279f7897b87bb6854b783c60e80311ae3079")
+    u_prev = (9).to_bytes(32, "little")
+    for _ in range(999):
+        k, u_prev = aead.x25519(k, u_prev), k
+    assert k == bytes.fromhex(
+        "684cf59ba83309552800ef566f2f4d3c1c3887c49360e3875f2eb94d99532c51")
+
+
+def test_x25519_rfc7748_diffie_hellman():
+    # RFC 7748 section 6.1: both sides derive the same shared secret
+    a = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a")
+    b = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb")
+    a_pub = aead.x25519_base(a)
+    b_pub = aead.x25519_base(b)
+    assert a_pub == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    assert b_pub == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f")
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742")
+    assert aead.x25519(a, b_pub) == shared
+    assert aead.x25519(b, a_pub) == shared
+
+
+# -- RFC 8439 vectors ---------------------------------------------------------
+
+def test_chacha20_rfc8439_block():
+    # RFC 8439 section 2.3.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    import struct
+    block = aead._chacha20_block(struct.unpack("<8I", key), 1,
+                                 struct.unpack("<3I", nonce))
+    assert block == bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e")
+
+
+def test_poly1305_rfc8439_vector():
+    # RFC 8439 section 2.5.2
+    key = bytes.fromhex(
+        "85d6be7857556d337f4452fe42d506a80103808afb0db2fd4abff6af4149f51b")
+    msg = b"Cryptographic Forum Research Group"
+    assert aead.poly1305_mac(key, msg) == bytes.fromhex(
+        "a8061dc1305136c6c22b8baf0c0127a9")
+
+
+def test_aead_rfc8439_seal():
+    # RFC 8439 section 2.8.2
+    key = bytes(range(0x80, 0xa0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    pt = (b"Ladies and Gentlemen of the class of '99: If I could offer "
+          b"you only one tip for the future, sunscreen would be it.")
+    a = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    sealed = aead.ChaCha20Poly1305(key).encrypt(nonce, pt, a)
+    assert sealed[-16:] == bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    assert sealed[:-16] == bytes.fromhex(
+        "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+        "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+        "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+        "3ff4def08e4b7a9de576d26586cec64b6116")
+    assert aead.ChaCha20Poly1305(key).decrypt(nonce, sealed, a) == pt
+
+
+def test_aead_roundtrip_and_tamper():
+    key = b"k" * 32
+    box = aead.ChaCha20Poly1305(key)
+    nonce = b"\x00" * 12
+    sealed = box.encrypt(nonce, b"hello fleet", b"aad")
+    assert box.decrypt(nonce, sealed, b"aad") == b"hello fleet"
+    with pytest.raises(ValueError):
+        box.decrypt(nonce, sealed, b"other-aad")
+    bad = bytes([sealed[0] ^ 1]) + sealed[1:]
+    with pytest.raises(ValueError):
+        box.decrypt(nonce, bad, b"aad")
+    with pytest.raises(ValueError):
+        box.decrypt(nonce, sealed[:8], b"aad")
+
+
+def test_aead_empty_plaintext_none_aad():
+    box = aead.ChaCha20Poly1305(b"\x01" * 32)
+    nonce = b"\x02" * 12
+    sealed = box.encrypt(nonce, b"", None)
+    assert len(sealed) == 16
+    assert box.decrypt(nonce, sealed, None) == b""
+
+
+def test_key_and_nonce_validation():
+    with pytest.raises(ValueError):
+        aead.ChaCha20Poly1305(b"short")
+    box = aead.ChaCha20Poly1305(b"\x00" * 32)
+    with pytest.raises(ValueError):
+        box.encrypt(b"\x00" * 8, b"x", None)
+    with pytest.raises(ValueError):
+        aead.x25519(b"\x00" * 31, b"\x00" * 32)
+
+
+# -- SecretConnection over the fallback ---------------------------------------
+
+def test_secret_connection_handshake_fallback():
+    """make() succeeds end-to-end on whatever implementation the
+    environment provides — with no cryptography wheel installed this
+    exercises the pure-Python path over a real socketpair."""
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.p2p.conn import secret_connection as sc
+
+    a, b = socket.socketpair()
+    ka, kb = ed25519.PrivKey.generate(), ed25519.PrivKey.generate()
+    result = {}
+
+    def server():
+        conn = sc.SecretConnection.make(b, kb)
+        result["server"] = conn
+        assert conn.read() == b"ping from a"
+        conn.write(b"pong from b")
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    conn = sc.SecretConnection.make(a, ka)
+    conn.write(b"ping from a")
+    assert conn.read() == b"pong from b"
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert conn.remote_pubkey.bytes() == kb.pub_key().bytes()
+    assert result["server"].remote_pubkey.bytes() == ka.pub_key().bytes()
+    conn.close()
+    result["server"].close()

@@ -202,3 +202,114 @@ def test_manifest_pbts_knob():
     assert m.pbts is True
     m2 = Manifest.parse("[node.a]\nmode = \"validator\"\n")
     assert m2.pbts is False
+
+
+FLEET_MANIFEST = """
+load_tx_rate = 20
+run_blocks = 5
+
+[node.validator0]
+[node.validator1]
+[node.validator2]
+perturb = ["kill"]
+
+[node.validator3]
+"""
+
+
+@pytest.mark.slow
+def test_e2e_fleet_telemetry_capture(tmp_path):
+    """The fleetobs acceptance run: a 4-node testnet with a SIGKILL
+    perturbation yields ONE merged Perfetto trace containing all four
+    nodes (stable pid each, across the killed node's restart),
+    cross-process flow edges on every common committed height, devprof
+    counter tracks, and a fleet critical path whose segments sum
+    EXACTLY per height — with the killed node's pre-kill telemetry
+    recovered from its crash-safe spool."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    from cometbft_tpu.fleetobs import collect, report
+
+    manifest = Manifest.parse(FLEET_MANIFEST)
+    net = Testnet(manifest, str(tmp_path / "net"), chain_id="e2e-fleet")
+    net.setup()
+    net.start()
+    try:
+        net.wait_for_height(manifest.run_blocks, timeout=180)
+        net.run_perturbations()        # SIGKILL validator2, restart
+        tip = max(n.height() for n in net.nodes if n.running())
+        net.wait_for_height(tip + 2, timeout=180, nodes=net.nodes)
+        time.sleep(1.5)                # > one spool flush post-restart
+        capture = net.collect_telemetry()
+    finally:
+        net.stop()
+
+    # every node contributed spooled records; the collector also saved
+    # live dumps from whoever answered RPC
+    assert set(capture["nodes"]) == {n.name for n in net.nodes}
+    for name, nd in capture["nodes"].items():
+        kinds = {r.get("kind") for r in nd["spool"]}
+        assert {"meta", "clock", "tracetl"} <= kinds, (name, kinds)
+
+    # the SIGKILLed node's pre-kill incarnation survived on disk: its
+    # spool carries records from BOTH incarnations
+    killed = capture["nodes"]["validator2"]["spool"]
+    assert len({r["incarnation"] for r in killed}) >= 2
+
+    fleet = report.fleet_report(capture)
+    cov = fleet["coverage"]
+    trace = fleet["merged"]["trace"]
+
+    # ONE merged trace, all 4 nodes, one stable pid per node
+    names = sorted(n.name for n in net.nodes)
+    assert trace["metadata"]["nodes"] == names
+    pids = {e["pid"]: e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+            and e["args"]["name"] != "devprof"}
+    assert sorted(pids.values()) == names and len(pids) == 4
+
+    # cross-process flow edges on every common committed height
+    assert cov["common_heights"] >= 1, cov
+    assert cov["common_heights_with_cross_edge"] == \
+        cov["common_heights"], cov
+    assert cov["cross_flow_edges"] >= cov["common_heights"]
+
+    # devprof counter tracks, node-prefixed, on the shared axis
+    tracks = {e["name"] for e in trace["traceEvents"]
+              if e["ph"] == "C"}
+    assert tracks and all(":" in t for t in tracks), tracks
+
+    # fleet critical path: exact segment-sum partition per height
+    per_height = fleet["critical_path"]["per_height"]
+    assert per_height
+    for row in per_height:
+        assert abs(sum(row["segments"].values())
+                   - row["wall_seconds"]) < 1e-6, row
+
+    # pre-kill telemetry made it into the merge: both of the killed
+    # node's incarnations appear as solved clock domains
+    v2_domains = [k for k in fleet["merged"]["offsets"]
+                  if k.startswith("validator2@")]
+    assert len(v2_domains) >= 2, fleet["merged"]["offsets"]
+
+    # offsets were edge-solved for connected domains (not all anchors)
+    methods = {v["method"] for v in fleet["merged"]["offsets"].values()}
+    assert methods & {"reference", "edges"}, methods
+
+    # the offline CLI renders the same capture
+    cap_path = str(tmp_path / "capture.json")
+    collect.save_capture(cap_path, capture)
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "scripts", "fleet_report.py"),
+         cap_path],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["nodes"] == names

@@ -1,0 +1,441 @@
+"""Fleet observability plane (cometbft_tpu/fleetobs/): clock-offset
+solving from p2p edge pairs, capture merge onto one fleet axis, and the
+fleet report surfaces (critical path, histogram merge, occupancy,
+coverage)."""
+
+import json
+
+import pytest
+
+from cometbft_tpu.fleetobs import clocksync, collect, merge, report
+from cometbft_tpu.libs import latledger, telspool, tracetl
+
+A = ("a", "1-1000")
+B = ("b", "2-1000")
+C = ("c", "3-1000")
+
+
+def _send(dom_events, seq, t, ctx, name="block_part"):
+    dom_events.append({"seq": seq, "t": t, "ph": "send",
+                       "sub": "gossip", "name": name, "ctx": list(ctx)})
+
+
+def _recv(dom_events, seq, t, ctx, name="block_part"):
+    dom_events.append({"seq": seq, "t": t, "ph": "recv",
+                       "sub": "gossip", "name": name, "ctx": list(ctx)})
+
+
+# -- clocksync ---------------------------------------------------------------
+
+def test_offset_recovery_with_asymmetric_latency():
+    """Known skew, asymmetric actual delays (10ms vs 20ms): the NTP
+    midpoint recovers the offset within the min one-way delay bound."""
+    O_A, O_B = 900.0, 905.0         # true local->fleet offsets
+    ea, eb = [], []
+    ctx1 = ("a", 1, 0, 1)
+    _send(ea, 0, 1000.0 - O_A, ctx1)            # fleet t=1000.0
+    _recv(eb, 0, 1000.010 - O_B, ctx1)          # +10ms wire
+    ctx2 = ("b", 1, 0, 1)
+    _send(eb, 1, 1001.0 - O_B, ctx2)
+    _recv(ea, 1, 1001.020 - O_A, ctx2)          # +20ms back
+    edges = clocksync.pair_edges({A: ea, B: eb})
+    assert len(edges) == 2
+    anchors = {A: {"wall": 1000.0, "perf": 1000.0 - O_A}}
+    sol = clocksync.solve_offsets([A, B], edges, anchors)
+    assert sol[A]["method"] == clocksync.METHOD_REFERENCE
+    assert sol[A]["offset"] == pytest.approx(O_A)
+    assert sol[B]["method"] == clocksync.METHOD_EDGES
+    # midpoint estimate: off by half the delay asymmetry (5ms), and
+    # ALWAYS within the min one-way delay of the truth
+    assert sol[B]["offset"] == pytest.approx(O_B + 0.005, abs=1e-9)
+    assert abs(sol[B]["offset"] - O_B) <= 0.010
+    assert sol[B]["delay_bound"] == pytest.approx(0.015, abs=1e-9)
+
+
+def test_offset_chain_propagates_by_bfs():
+    """C has edges only to B; its offset chains through B's."""
+    O_A, O_B, O_C = 0.0, 3.0, -2.0
+    ea, eb, ec = [], [], []
+    for i, (src_e, dst_e, O_s, O_d, org) in enumerate([
+            (ea, eb, O_A, O_B, "a"), (eb, ea, O_B, O_A, "b"),
+            (eb, ec, O_B, O_C, "b"), (ec, eb, O_C, O_B, "c")]):
+        ctx = (org, 1, 0, 10 + i)
+        t = 100.0 + i
+        _send(src_e, 2 * i, t - O_s, ctx)
+        _recv(dst_e, 2 * i + 1, t + 0.001 - O_d, ctx)
+    edges = clocksync.pair_edges({A: ea, B: eb, C: ec})
+    sol = clocksync.solve_offsets(
+        [A, B, C], edges, {}, reference=A)
+    assert sol[A]["offset"] == 0.0
+    assert sol[B]["offset"] == pytest.approx(O_B, abs=1e-9)
+    assert sol[C]["offset"] == pytest.approx(O_C, abs=1e-9)
+    assert sol[C]["method"] == clocksync.METHOD_EDGES
+
+
+def test_no_edges_falls_back_to_anchor():
+    anchors = {A: {"wall": 500.0, "perf": 100.0},
+               B: {"wall": 600.0, "perf": 50.0}}
+    sol = clocksync.solve_offsets([A, B], [], anchors, reference=A)
+    assert sol[B] == {"offset": 550.0,
+                      "method": clocksync.METHOD_ANCHOR,
+                      "delay_bound": None}
+
+
+def test_one_direction_only_falls_back_to_anchor():
+    """Edges in one direction can't separate offset from delay — the
+    solver must NOT pretend they can."""
+    ea, eb = [], []
+    ctx = ("a", 1, 0, 1)
+    _send(ea, 0, 100.0, ctx)
+    _recv(eb, 0, 95.0, ctx)
+    edges = clocksync.pair_edges({A: ea, B: eb})
+    sol = clocksync.solve_offsets(
+        [A, B], edges, {B: {"wall": 10.0, "perf": 2.0}}, reference=A)
+    assert sol[B]["method"] == clocksync.METHOD_ANCHOR
+    assert sol[B]["offset"] == 8.0
+
+
+def test_no_edges_no_anchor_is_none_method():
+    sol = clocksync.solve_offsets([A, B], [], {}, reference=A)
+    assert sol[B] == {"offset": 0.0, "method": clocksync.METHOD_NONE,
+                      "delay_bound": None}
+
+
+def test_ambiguous_ctx_dropped():
+    """A ctx claimed by sends in two domains (post-restart ctx-seq
+    collision) must contribute no edge; self-delivery neither."""
+    ea, eb, ec = [], [], []
+    ctx = ("a", 1, 0, 7)
+    _send(ea, 0, 1.0, ctx)
+    _send(ec, 0, 1.5, ctx)          # collision: "a" restarted as C
+    _recv(eb, 0, 2.0, ctx)
+    own = ("b", 1, 0, 1)
+    _send(eb, 1, 3.0, own)
+    _recv(eb, 2, 3.1, own)          # self-delivery
+    assert clocksync.pair_edges({A: ea, B: eb, C: ec}) == []
+
+
+def test_offset_spread_reads_edge_solved_corrections():
+    offsets = {
+        A: {"offset": 900.0, "method": clocksync.METHOD_REFERENCE,
+            "delay_bound": None},
+        B: {"offset": 905.004, "method": clocksync.METHOD_EDGES,
+            "delay_bound": 0.01},
+        C: {"offset": 0.0, "method": clocksync.METHOD_NONE,
+            "delay_bound": None},
+    }
+    anchors = {A: {"wall": 1000.0, "perf": 100.0},    # correction 0
+               B: {"wall": 1000.0, "perf": 95.0}}     # correction +4ms
+    spread = clocksync.offset_spread_ms(offsets, anchors)
+    assert spread == pytest.approx(4.0, abs=0.01)
+    assert clocksync.offset_spread_ms(
+        {A: offsets[A]}, anchors) == 0.0
+
+
+# -- capture fixtures --------------------------------------------------------
+
+def _clock_rec(node, inc, wall, perf, mono=None):
+    return {"kind": "clock", "node": node, "incarnation": inc,
+            "t_wall": wall, "wall": wall, "perf": perf,
+            "mono": perf if mono is None else mono}
+
+
+def _tracetl_rec(node, inc, events, recorded=None):
+    return {"kind": "tracetl", "node": node, "incarnation": inc,
+            "t_wall": 0.0, "timeline_node": node,
+            "recorded": len(events) if recorded is None else recorded,
+            "events": events}
+
+
+def _consensus_events(height, t0, *, origin, peer_ctx=None, seq0=0):
+    """proposal -> device span -> commit on one node's local clock,
+    with a gossip send (and optionally a recv of peer_ctx)."""
+    evs = [
+        {"seq": seq0, "t": t0, "ph": "instant", "sub": "consensus",
+         "name": "proposal", "height": height},
+        {"seq": seq0 + 1, "t": t0 + 0.010, "ph": "span",
+         "sub": "pipeline", "name": "device", "dur": 0.020},
+        {"seq": seq0 + 2, "t": t0 + 0.005, "ph": "send",
+         "sub": "gossip", "name": "block_part",
+         "ctx": [origin, height, 0, height * 10]},
+        {"seq": seq0 + 3, "t": t0 + 0.040, "ph": "instant",
+         "sub": "consensus", "name": "commit", "height": height},
+    ]
+    if peer_ctx is not None:
+        evs.append({"seq": seq0 + 4, "t": t0 + 0.004, "ph": "recv",
+                    "sub": "gossip", "name": "block_part",
+                    "ctx": list(peer_ctx)})
+    return evs
+
+
+def _two_node_capture():
+    """Nodes a (two incarnations: spooled pre-kill + live) and b, with
+    bidirected gossip edges and a 5s true skew on b."""
+    O_a, O_b = 900.0, 905.0
+    cap = {"nodes": {
+        "a": {"spool": [], "live": None},
+        "b": {"spool": [], "live": None},
+    }, "collected_at": 2000.0}
+    # pre-kill incarnation of a: height 1, spool only
+    inc_a1 = "1-1"
+    cap["nodes"]["a"]["spool"] += [
+        _clock_rec("a", inc_a1, 1001.0, 1001.0 - O_a),
+        _tracetl_rec("a", inc_a1, _consensus_events(
+            1, 1000.0 - O_a, origin="a")),
+    ]
+    # post-restart incarnation of a: height 2, spool AND overlapping
+    # live dump (same ring events — dedup by seq must hold)
+    inc_a2 = "1-2"
+    evs_a2 = _consensus_events(
+        2, 1002.0 - O_a, origin="a", peer_ctx=("b", 2, 0, 20))
+    cap["nodes"]["a"]["spool"] += [
+        _clock_rec("a", inc_a2, 1003.0, 1003.0 - O_a),
+        _tracetl_rec("a", inc_a2, evs_a2),
+    ]
+    cap["nodes"]["a"]["live"] = {
+        "node": "a", "incarnation": inc_a2,
+        "clock": {"wall": 1004.0, "perf": 1004.0 - O_a,
+                  "mono": 1004.0 - O_a},
+        "tracetl": {"node": "a", "recorded": len(evs_a2),
+                    "events": evs_a2},
+        "flightrec": None, "devprof": None, "latledger": None,
+        "metrics": None,
+    }
+    # b: one incarnation, sees a's height-2 ctx and sends its own
+    inc_b = "2-1"
+    evs_b = _consensus_events(
+        2, 1002.001 - O_b, origin="b", peer_ctx=("a", 2, 0, 20),
+        seq0=0)
+    # make b's ctx seq distinct: origin "b" height 2 -> ctx seq 20
+    cap["nodes"]["b"]["spool"] += [
+        _clock_rec("b", inc_b, 1003.0, 1003.0 - O_b),
+        _tracetl_rec("b", inc_b, evs_b),
+    ]
+    return cap, (O_a, O_b)
+
+
+# -- merge -------------------------------------------------------------------
+
+def test_merge_stable_pid_per_node_across_restarts():
+    cap, _ = _two_node_capture()
+    out = merge.merge_capture(cap)
+    names = {e["pid"]: e["args"]["name"]
+             for e in out["trace"]["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {1: "a", 2: "b"}       # ONE pid per node, sorted
+    assert out["domains"] == ["a@1-1", "a@1-2", "b@2-1"]
+
+
+def test_merge_rebases_onto_fleet_axis():
+    """After the merge, a's and b's commit instants for height 2 land
+    within wire-delay of their true fleet times despite the 5s skew."""
+    cap, (O_a, O_b) = _two_node_capture()
+    out = merge.merge_capture(cap)
+    sol = {k: v for k, v in out["offsets"].items()}
+    assert sol["a@1-2"]["method"] in ("reference", "edges")
+    assert sol["b@2-1"]["method"] in ("reference", "edges")
+    # both domains' corrections agree to within the wire delay
+    spread = out["clock_offset_spread_ms"]
+    assert spread <= 10.0
+    commits = [e for e in out["trace"]["traceEvents"]
+               if e["ph"] == "i" and e["name"] == "commit"
+               and e["args"].get("height") == 2]
+    assert len(commits) == 2
+    ts = sorted(e["ts"] for e in commits)
+    # true fleet commit times differ by 1ms; rebased within ~10ms
+    assert ts[1] - ts[0] <= 10_000          # trace ts is in us
+
+
+def test_merge_dedups_spool_live_overlap():
+    cap, _ = _two_node_capture()
+    out = merge.merge_capture(cap)
+    a_events = [e for e in out["trace"]["traceEvents"]
+                if e.get("pid") == 1 and e["ph"] in ("X", "i")]
+    # height-2 events appear once despite spool + live overlap
+    h2_commits = [e for e in a_events
+                  if e["ph"] == "i" and e["name"] == "commit"
+                  and e["args"].get("height") == 2]
+    assert len(h2_commits) == 1
+
+
+def test_merge_flightrec_joins_as_instants():
+    cap = {"nodes": {"a": {"spool": [
+        _clock_rec("a", "1-1", 100.0, 10.0),
+        {"kind": "flightrec", "node": "a", "incarnation": "1-1",
+         "t_wall": 100.0, "recorded": 1, "events": [
+             {"seq": 0, "t": 9.5, "kind": "enter_new_round",
+              "height": 4, "round": 0}]},
+    ], "live": None}}}
+    out = merge.merge_capture(cap)
+    inst = [e for e in out["trace"]["traceEvents"]
+            if e["ph"] == "i" and e["name"] == "enter_new_round"]
+    assert len(inst) == 1
+    assert inst[0]["cat"] == "flightrec"
+    assert inst[0]["args"]["height"] == 4
+
+
+def test_merge_counter_tracks_are_node_prefixed():
+    cap = {"nodes": {"a": {"spool": [
+        _clock_rec("a", "1-1", 100.0, 10.0),
+        {"kind": "devprof", "node": "a", "incarnation": "1-1",
+         "t_wall": 100.0, "snapshot": {"devices": {}},
+         "counters": [[9.0, "occupancy_pct/dev0", 55.0]]},
+    ], "live": None}}}
+    out = merge.merge_capture(cap)
+    tracks = [e for e in out["trace"]["traceEvents"] if e["ph"] == "C"]
+    assert [e["name"] for e in tracks] == ["a:occupancy_pct/dev0"]
+    assert out["devprof"] == {"a": {"devices": {}}}
+
+
+def test_merge_newest_incarnation_wins_cumulative():
+    cap = {"nodes": {"a": {"spool": [
+        _clock_rec("a", "1-1", 100.0, 10.0),
+        {"kind": "metrics", "node": "a", "incarnation": "1-1",
+         "t_wall": 100.0, "exposition": "old"},
+        _clock_rec("a", "1-2", 200.0, 10.0),
+        {"kind": "metrics", "node": "a", "incarnation": "1-2",
+         "t_wall": 200.0, "exposition": "new"},
+    ], "live": None}}}
+    out = merge.merge_capture(cap)
+    assert out["metrics"] == {"a": "new"}
+
+
+# -- report ------------------------------------------------------------------
+
+def test_fleet_report_exact_segment_sum():
+    """The critical-path exact-partition invariant survives the
+    cross-process rebase: per height, segment sums equal the
+    proposal->commit wall exactly."""
+    cap, _ = _two_node_capture()
+    fleet = report.fleet_report(cap)
+    per_height = fleet["critical_path"]["per_height"]
+    assert per_height, "expected committed heights"
+    for row in per_height:
+        assert sum(row["segments"].values()) == \
+            pytest.approx(row["wall_seconds"], abs=1e-6), row
+    heights = [r["height"] for r in per_height]
+    assert 2 in heights
+    dev = next(r for r in per_height if r["height"] == 2)
+    assert dev["segments"]["device"] > 0.0
+
+
+def test_fleet_report_coverage_and_cross_edges():
+    cap, _ = _two_node_capture()
+    fleet = report.fleet_report(cap)
+    cov = fleet["coverage"]
+    assert cov["nodes"] == ["a", "b"]
+    assert cov["union_heights"] == 2        # heights 1 (a only) and 2
+    assert cov["common_heights"] == 1       # only height 2 on both
+    assert cov["height_coverage"] == pytest.approx(0.5)
+    assert cov["cross_flow_edges"] >= 2     # a->b and b->a at height 2
+    assert cov["common_heights_with_cross_edge"] == 1
+    assert cov["cross_edges_by_height"]["2"] >= 2
+
+
+def test_merge_latledgers_folds_histograms():
+    h1, h2 = latledger.LatHistogram(), latledger.LatHistogram()
+    for v in (0.001, 0.002, 0.004):
+        h1.observe(v)
+    for v in (0.008, 0.016):
+        h2.observe(v)
+    dumps = {
+        "a": {"consumers": {"verify": {"requests": 3,
+                                       "hist": h1.snapshot()}},
+              "slo": {"consumers": {}}},
+        "b": {"consumers": {"verify": {"requests": 2,
+                                       "hist": h2.snapshot()}},
+              "slo": {"consumers": {}}},
+    }
+    out = report.merge_latledgers(dumps)
+    v = out["consumers"]["verify"]
+    assert v["count"] == 5 and v["requests"] == 5 and v["nodes"] == 2
+    ref = h1.merge(h2)
+    assert v["p99_ms"] == pytest.approx(ref.quantile(0.99) * 1000, 3)
+    assert v["sum_seconds"] == pytest.approx(ref.sum)
+    assert set(out["slo"]) == {"a", "b"}
+
+
+def test_merge_latledgers_skips_mismatched_bounds():
+    h = latledger.LatHistogram((0.1, 0.2))
+    h.observe(0.15)
+    dumps = {"a": {"consumers": {"verify": {
+        "requests": 1, "hist": h.snapshot()}}},
+        "b": {"consumers": {"verify": {
+            "requests": 1,
+            "hist": latledger.LatHistogram().snapshot()}}}}
+    out = report.merge_latledgers(dumps)
+    # different layouts can't element-wise merge; first layout wins
+    # per label and the mismatched one is skipped, never raises
+    assert out["consumers"]["verify"]["count"] == 1
+
+
+def test_fleet_occupancy_sums_chips():
+    snap = {"devices": {"dev0": {
+        "busy_seconds": 3.0, "wall_seconds": 10.0,
+        "idle_seconds": {"staging": 1.0}}}}
+    snap2 = {"devices": {"dev0": {
+        "busy_seconds": 1.0, "wall_seconds": 10.0,
+        "idle_seconds": {}}}}
+    out = report.fleet_occupancy({"a": snap, "b": snap2})
+    assert out["fleet"]["busy_seconds"] == pytest.approx(4.0)
+    assert out["fleet"]["wall_seconds"] == pytest.approx(20.0)
+    assert out["fleet"]["device_occupancy_fraction"] == \
+        pytest.approx(0.2)
+    assert out["per_node"]["a"]["device_occupancy_fraction"] == \
+        pytest.approx(0.3)
+
+
+# -- collect -----------------------------------------------------------------
+
+def test_collect_node_harvests_spool_and_live(tmp_path):
+    home = tmp_path / "node0"
+    w = telspool.SpoolWriter(collect.spool_dir_for(str(home)),
+                             node="node0")
+    w.flush()
+    w.stop()
+
+    def rpc(method, **params):
+        assert method == "fleetobs"
+        return {"node": "node0", "incarnation": w.incarnation}
+
+    nd = collect.collect_node("node0", str(home), rpc=rpc)
+    assert [r["kind"] for r in nd["spool"]][:2] == ["meta", "clock"]
+    assert nd["live"]["incarnation"] == w.incarnation
+
+    def bad_rpc(method, **params):
+        raise OSError("connection refused")
+
+    nd = collect.collect_node("node0", str(home), rpc=bad_rpc)
+    assert nd["spool"] and nd["live"] is None
+
+
+def test_capture_save_load_roundtrip(tmp_path):
+    cap, _ = _two_node_capture()
+    path = str(tmp_path / "capture.json")
+    collect.save_capture(path, cap)
+    loaded = collect.load_capture(path)
+    assert loaded == json.loads(json.dumps(cap))
+    with open(str(tmp_path / "junk.json"), "w") as f:
+        f.write("[]")
+    with pytest.raises(ValueError):
+        collect.load_capture(str(tmp_path / "junk.json"))
+
+
+def test_fleet_report_feeds_summary_cli(tmp_path):
+    """scripts/fleet_report.py end to end on a synthetic capture."""
+    import subprocess
+    import sys
+    cap, _ = _two_node_capture()
+    path = str(tmp_path / "capture.json")
+    collect.save_capture(path, cap)
+    trace_out = str(tmp_path / "fleet.trace.json")
+    proc = subprocess.run(
+        [sys.executable, "scripts/fleet_report.py", path,
+         "--trace-out", trace_out],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["nodes"] == ["a", "b"]
+    assert summary["union_heights"] == 2
+    trace = json.load(open(trace_out))
+    assert trace["metadata"]["nodes"] == ["a", "b"]

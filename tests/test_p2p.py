@@ -228,6 +228,61 @@ class TestMConnection:
         finally:
             ma.stop(); mb.stop()
 
+    def test_trace_ctx_travels_in_band(self):
+        """A conn with no out-of-band ctx seam (real TCP) carries the
+        trace context as its own packet just ahead of the message EOF;
+        ctx-less messages deliver tctx=None and interleaving doesn't
+        smear a context onto the wrong message."""
+        descs = [ChannelDescriptor(0x01), ChannelDescriptor(0x02)]
+        pipe = _Loop()
+        got = []
+        err = []
+        ma = MConnection(pipe.side(True), descs, lambda ch, m: None,
+                         err.append, flush_throttle=0.001)
+        mb = MConnection(pipe.side(False), descs,
+                         lambda ch, m, tctx=None:
+                         got.append((ch, m, tctx)),
+                         err.append, flush_throttle=0.001)
+        ma.start(); mb.start()
+        try:
+            ctx = ("node-a", 7, 1, 42)
+            assert ma.send(0x01, b"with-ctx", tctx=ctx)
+            assert self.wait_until(lambda: len(got) == 1)
+            assert ma.send(0x01, b"plain")
+            assert ma.send(0x02, b"other-ch", tctx=("node-a", 7, 1, 43))
+            assert self.wait_until(lambda: len(got) == 3)
+            by_msg = {m: (ch, t) for ch, m, t in got}
+            assert by_msg[b"with-ctx"] == (0x01, ctx)
+            assert by_msg[b"plain"] == (0x01, None)
+            assert by_msg[b"other-ch"] == (0x02, ("node-a", 7, 1, 43))
+            assert not err
+        finally:
+            ma.stop(); mb.stop()
+
+    def test_trace_ctx_spanning_message(self):
+        """The ctx packet lands immediately ahead of the EOF packet,
+        so a multi-packet message still delivers exactly its own ctx."""
+        descs = [ChannelDescriptor(0x01)]
+        pipe = _Loop()
+        got = []
+        err = []
+        ma = MConnection(pipe.side(True), descs, lambda ch, m: None,
+                         err.append, flush_throttle=0.001)
+        mb = MConnection(pipe.side(False), descs,
+                         lambda ch, m, tctx=None:
+                         got.append((m, tctx)),
+                         err.append, flush_throttle=0.001)
+        ma.start(); mb.start()
+        try:
+            big = bytes(range(256)) * 40     # spans several packets
+            ctx = ("origin", 3, 0, 9)
+            assert ma.send(0x01, big, tctx=ctx)
+            assert self.wait_until(lambda: len(got) == 1)
+            assert got[0] == (big, ctx)
+            assert not err
+        finally:
+            ma.stop(); mb.stop()
+
 
 class TestTransportSwitch:
     def make_transport(self, seed, network="net-1"):

@@ -1,0 +1,249 @@
+"""Crash-safe telemetry spool (libs/telspool.py): framing, rotation
+bounds, the every-byte-offset torn-tail sweep (the WAL discipline the
+spool borrows), the closed record-kind registry, and restart
+continuation."""
+
+import json
+import os
+import struct
+
+import pytest
+
+from cometbft_tpu.libs import flightrec, latledger, telspool, tracetl
+from cometbft_tpu.libs.crc32c import crc32c
+
+
+def _write_spool(tmp_path, **kwargs):
+    return telspool.SpoolWriter(str(tmp_path / "spool"), node="n0",
+                                **kwargs)
+
+
+def _sources():
+    fr = flightrec.FlightRecorder(capacity=64)
+    tl = tracetl.Timeline("n0", capacity=64)
+    ll = latledger.LatLedgerRecorder(capacity=64)
+    return fr, tl, ll
+
+
+# -- framing -----------------------------------------------------------------
+
+def test_frame_roundtrip():
+    payloads = [json.dumps({"kind": "meta", "i": i}).encode()
+                for i in range(7)]
+    blob = b"".join(telspool.encode_frame(p) for p in payloads)
+    assert list(telspool.iter_frames(blob)) == payloads
+
+
+def test_frame_corrupt_middle_stops():
+    """A flipped byte mid-stream ends replay there — frames after a
+    corrupt one are unreachable (no resync), same as WAL."""
+    payloads = [b'{"a":%d}' % i for i in range(3)]
+    frames = [telspool.encode_frame(p) for p in payloads]
+    blob = bytearray(b"".join(frames))
+    blob[len(frames[0]) + 8] ^= 0xFF        # first payload byte of #2
+    assert list(telspool.iter_frames(bytes(blob))) == payloads[:1]
+
+
+def test_frame_insane_length_stops():
+    hdr = struct.pack(">II", 0, 1 << 30)
+    assert list(telspool.iter_frames(hdr + b"x" * 64)) == []
+
+
+def test_read_segment_skips_non_object_json(tmp_path):
+    good = json.dumps({"kind": "clock", "wall": 1.0}).encode()
+    bad = json.dumps([1, 2, 3]).encode()        # frames fine, not a dict
+    notjson = b"\xff\xfe{{{"
+    path = tmp_path / "spool-000001.tel"
+    path.write_bytes(telspool.encode_frame(bad)
+                     + telspool.encode_frame(notjson)
+                     + telspool.encode_frame(good))
+    recs = telspool.read_segment(str(path))
+    assert recs == [{"kind": "clock", "wall": 1.0}]
+
+
+# -- torn-tail sweep (test_storage.py WAL discipline) ------------------------
+
+def _frame_boundaries(buf):
+    offs = [0]
+    pos = 0
+    while pos + 8 <= len(buf):
+        _, length = struct.unpack_from(">II", buf, pos)
+        pos += 8 + length
+        offs.append(pos)
+    return offs
+
+
+def test_spool_torn_tail_every_byte_offset(tmp_path):
+    """SIGKILL-mid-write sweep: a segment truncated at EVERY byte
+    offset inside its final record replays to exactly the whole
+    records before it, and never raises."""
+    fr, tl, ll = _sources()
+    w = _write_spool(tmp_path)
+    w.flight_recorder, w.timeline, w.latledger = fr, tl, ll
+    fr.record("enter_new_round", height=1, round=0)
+    tl.instant("consensus", "proposal", height=1)
+    assert w.flush() >= 3                   # meta + clock + rings
+    w.stop()
+    [seg] = telspool.segment_paths(w.spool_dir)
+    pristine = open(seg, "rb").read()
+    bounds = _frame_boundaries(pristine)
+    assert bounds[-1] == len(pristine) and len(bounds) >= 4
+    whole = telspool.read_segment(seg)
+    for cut in range(bounds[-2], bounds[-1]):
+        torn = tmp_path / "torn.tel"
+        torn.write_bytes(pristine[:cut])
+        recs = telspool.read_segment(str(torn))
+        assert recs == whole[:-1], cut
+    # and a cut inside ANY earlier record keeps the prefix property
+    for i in range(1, len(bounds) - 1):
+        mid = (bounds[i - 1] + bounds[i]) // 2
+        torn = tmp_path / "torn.tel"
+        torn.write_bytes(pristine[:mid])
+        assert telspool.read_segment(str(torn)) == whole[:i - 1], i
+
+
+# -- writer ------------------------------------------------------------------
+
+def test_writer_records_carry_domain_fields(tmp_path):
+    fr, tl, ll = _sources()
+    w = _write_spool(tmp_path)
+    w.flight_recorder, w.timeline, w.latledger = fr, tl, ll
+    fr.record("commit", height=3, round=0)
+    w.flush()
+    w.stop()
+    recs = telspool.read_spool(w.spool_dir)
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "meta" and "clock" in kinds \
+        and "flightrec" in kinds and "latledger" in kinds
+    for r in recs:
+        assert r["node"] == "n0"
+        assert r["incarnation"] == w.incarnation
+        assert isinstance(r["t_wall"], float)
+    clock = next(r for r in recs if r["kind"] == "clock")
+    assert {"wall", "perf", "mono"} <= set(clock)
+
+
+def test_writer_incremental_ring_cursor(tmp_path):
+    """Ring kinds spool only what is new each flush; cumulative kinds
+    re-spool their whole snapshot."""
+    fr, tl, ll = _sources()
+    w = _write_spool(tmp_path)
+    w.flight_recorder, w.timeline = fr, tl
+    fr.record("a")
+    tl.instant("consensus", "proposal", height=1)
+    w.flush()
+    fr.record("b")
+    w.flush()
+    w.flush()                               # nothing new: no ring recs
+    w.stop()
+    recs = telspool.read_spool(w.spool_dir)
+    fr_recs = [r for r in recs if r["kind"] == "flightrec"]
+    assert [[e["kind"] for e in r["events"]] for r in fr_recs] \
+        == [["a"], ["b"]]
+    tl_recs = [r for r in recs if r["kind"] == "tracetl"]
+    assert len(tl_recs) == 1 and tl_recs[0]["timeline_node"] == "n0"
+    seqs = [e["seq"] for r in fr_recs for e in r["events"]]
+    assert seqs == sorted(set(seqs))        # no event spooled twice
+
+
+def test_writer_unknown_kind_rejected(tmp_path):
+    w = _write_spool(tmp_path)
+    w.flush()                               # opens the segment
+    with pytest.raises(ValueError, match="unknown spool record kind"):
+        w._write_record("bogus", x=1)
+    w.stop()
+
+
+def test_writer_rotation_bounds_directory(tmp_path):
+    """Rotation drops oldest-first and never exceeds max_segments; the
+    newest segment always survives."""
+    fr = flightrec.FlightRecorder(capacity=512)
+    w = _write_spool(tmp_path, segment_bytes=256, max_segments=3)
+    w.flight_recorder = fr
+    for i in range(24):
+        fr.record("evt", i=i, pad="x" * 64)
+        w.flush()
+    assert w._seg_seq > 3                   # rotation actually happened
+    paths = telspool.segment_paths(w.spool_dir)
+    assert 0 < len(paths) <= 3
+    assert paths[-1].endswith("%06d%s" % (w._seg_seq,
+                                          telspool.SEGMENT_SUFFIX))
+    w.stop()
+    assert len(telspool.segment_paths(w.spool_dir)) <= 3
+
+
+def test_writer_restart_continues_numbering(tmp_path):
+    """A restarted incarnation appends new segments AFTER the crashed
+    one's — pre-crash evidence is never overwritten — and replay sees
+    both incarnations."""
+    w1 = _write_spool(tmp_path)
+    w1.flush()
+    w1.stop()
+    first = telspool.segment_paths(w1.spool_dir)
+    w2 = telspool.SpoolWriter(w1.spool_dir, node="n0")
+    w2.incarnation = w1.incarnation + "-next"
+    w2.flush()
+    w2.stop()
+    paths = telspool.segment_paths(w1.spool_dir)
+    assert paths[: len(first)] == first
+    assert len(paths) == len(first) + 1
+    incs = {r["incarnation"] for r in telspool.read_spool(w1.spool_dir)}
+    assert incs == {w1.incarnation, w2.incarnation}
+
+
+def test_writer_stop_idempotent(tmp_path):
+    """atexit and Node.on_stop may both fire; the second stop must not
+    reopen a segment or write anything."""
+    w = _write_spool(tmp_path)
+    w.start()
+    w.stop()
+    n = w._records_written
+    paths = telspool.segment_paths(w.spool_dir)
+    w.stop()
+    assert w.flush() == 0
+    assert w._records_written == n
+    assert telspool.segment_paths(w.spool_dir) == paths
+
+
+def test_background_flusher_flushes(tmp_path):
+    fr = flightrec.FlightRecorder(capacity=16)
+    w = _write_spool(tmp_path, interval_s=0.02)
+    w.flight_recorder = fr
+    fr.record("tick")
+    w.start()
+    deadline = 200
+    while w.stats()["flushes"] == 0 and deadline:
+        import time
+        time.sleep(0.01)
+        deadline -= 1
+    w.stop()
+    assert w.stats()["flushes"] >= 1
+    kinds = {r["kind"] for r in telspool.read_spool(w.spool_dir)}
+    assert "flightrec" in kinds
+
+
+def test_enabled_knob(monkeypatch):
+    monkeypatch.delenv("COMETBFT_TPU_TELSPOOL", raising=False)
+    assert not telspool.enabled()
+    monkeypatch.setenv("COMETBFT_TPU_TELSPOOL", "0")
+    assert not telspool.enabled()
+    monkeypatch.setenv("COMETBFT_TPU_TELSPOOL", "1")
+    assert telspool.enabled()
+
+
+def test_incarnation_id_shape():
+    inc = telspool.incarnation_id(pid=42, start_wall=1700000000.5)
+    assert inc == "42-1700000000500"
+    assert telspool.incarnation_id() != "42-1700000000500"
+
+
+def test_read_spool_missing_dir_is_empty(tmp_path):
+    assert telspool.read_spool(str(tmp_path / "nope")) == []
+    assert telspool.segment_paths(str(tmp_path / "nope")) == []
+
+
+def test_writer_rejects_bad_bounds(tmp_path):
+    with pytest.raises(ValueError):
+        telspool.SpoolWriter(str(tmp_path / "s"), segment_bytes=0)
+    with pytest.raises(ValueError):
+        telspool.SpoolWriter(str(tmp_path / "s"), max_segments=0)

@@ -531,6 +531,47 @@ class TestLaneRegistryLint:
         assert findings and "LANES not found" in findings[0]
 
 
+class TestRecordKindLint:
+    """check_metrics rule 10: telspool.RECORD_KINDS is the closed
+    spool-record vocabulary the fleet collector routes by — every
+    literal kind handed to _write_record must be registered."""
+
+    def test_registry_parses(self):
+        mod = TestCheckMetrics._load()
+        kinds = mod.registered_record_kinds()
+        assert {"meta", "clock", "flightrec", "tracetl", "devprof",
+                "latledger", "metrics"} <= kinds
+
+    def test_repo_is_clean(self):
+        mod = TestCheckMetrics._load()
+        assert mod.run_record_kind_checks() == []
+        # the writer's flush path spools every layer by literal kind
+        sites = mod.record_kind_call_sites()
+        assert {s["value"] for s in sites} >= {"clock", "tracetl"}
+
+    def test_lint_flags_unregistered_kind(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        reg = tmp_path / "telspool.py"
+        reg.write_text("RECORD_KINDS = ('meta', 'clock')\n")
+        site = tmp_path / "x.py"
+        site.write_text(
+            "def f(w):\n"
+            "    w._write_record('clock', {})\n"
+            "    w._write_record('mystery', {})\n")
+        findings = mod.run_record_kind_checks(root=tmp_path,
+                                              telspool_path=reg)
+        assert any("'mystery'" in f for f in findings)
+        assert not any("'clock'" in f for f in findings)
+
+    def test_lint_flags_missing_registry(self, tmp_path):
+        mod = TestCheckMetrics._load()
+        reg = tmp_path / "telspool.py"
+        reg.write_text("OTHER = 1\n")
+        findings = mod.run_record_kind_checks(root=tmp_path,
+                                              telspool_path=reg)
+        assert findings and "RECORD_KINDS not found" in findings[0]
+
+
 class TestPerfGate:
     """scripts/perf_gate.py: the bench-trajectory regression gate runs
     as a tier-1 test so a perf cliff fails CI before a round lands."""
@@ -792,6 +833,43 @@ class TestPerfGate:
             "regressed"
         ok = mod.gate({"headline": 100.0,
                        "bulk_verify_throughput_ratio": 0.97},
+                      history, tolerance=0.15, last_n=3, min_points=2)
+        assert all(r["status"] == "ok" for r in ok)
+
+    def test_fleet_extras_gate_direction(self, tmp_path):
+        """The fleetobs extras: e2e_fleet_height_coverage gates in the
+        default higher-is-better direction (heights losing their
+        cross-process flow edges means the in-band trace context or
+        the clock-aligned merge broke); the clock-offset spread gates
+        lower-is-better (widening means the edge solver degraded
+        toward wall-clock anchors); the fleet critical-path device
+        share is a reading — SKIPped for the same reason
+        critical_path_device_share is."""
+        mod = self._load()
+        assert "e2e_fleet_height_coverage" not in mod.LOWER_IS_BETTER
+        assert "e2e_fleet_height_coverage" not in mod.SKIP
+        assert "e2e_fleet_clock_offset_spread_ms" in mod.LOWER_IS_BETTER
+        assert "e2e_fleet_critical_path_device_share" in mod.SKIP
+        self._write(tmp_path, "BENCH_r01.json", 100.0,
+                    extra={"e2e_fleet_height_coverage": 1.0,
+                           "e2e_fleet_clock_offset_spread_ms": 2.0,
+                           "e2e_fleet_critical_path_device_share": 0.3})
+        rec = mod.load_record(str(tmp_path / "BENCH_r01.json"))
+        assert rec["e2e_fleet_height_coverage"] == 1.0
+        assert "e2e_fleet_critical_path_device_share" not in rec
+        history = [dict(rec) for _ in range(3)]
+        rows = mod.gate({"headline": 100.0,
+                         "e2e_fleet_height_coverage": 0.5,
+                         "e2e_fleet_clock_offset_spread_ms": 9.0},
+                        history, tolerance=0.15, last_n=3,
+                        min_points=2)
+        by = {r["metric"]: r for r in rows}
+        assert by["e2e_fleet_height_coverage"]["status"] == "regressed"
+        assert by["e2e_fleet_clock_offset_spread_ms"]["status"] == \
+            "regressed"
+        ok = mod.gate({"headline": 100.0,
+                       "e2e_fleet_height_coverage": 1.0,
+                       "e2e_fleet_clock_offset_spread_ms": 1.5},
                       history, tolerance=0.15, last_n=3, min_points=2)
         assert all(r["status"] == "ok" for r in ok)
 
